@@ -1,0 +1,33 @@
+"""kubeai_tpu — a TPU-native inference operator + serving engine.
+
+A ground-up rebuild of the capabilities of kubeai-project/kubeai
+(reference: /root/reference, ~17k LoC Go operator) designed TPU-first:
+
+- The **control plane** (Model specs -> replica pods, load balancing,
+  autoscaling, OpenAI-compatible front end) mirrors the reference's
+  behavior (see SURVEY.md for the file:line map).
+- The **engine tier** is new: the reference shells out to CUDA vLLM /
+  Ollama containers; here the serving engine is native JAX/XLA with
+  pjit/`jax.sharding` tensor parallelism over ICI meshes, Pallas
+  attention kernels, paged KV-cache continuous batching, and ring
+  attention for long context.
+
+Subpackages:
+    api          Model spec ("CRD") types + OpenAI API types
+    config       system configuration (defaulting + validation)
+    controller   Model reconciler, pod planner, engine pod generators
+    runtime      object store (k8s-like, watchable) + local pod runtime
+    loadbalancer endpoint groups, LeastLoad, CHWBL prefix-hash
+    proxy        OpenAI HTTP server + retrying reverse proxy
+    autoscaler   moving-average autoscaler + leader election
+    messenger    pub/sub request transport
+    metrics      prometheus-style metrics registry
+    engine       the TPU serving engine (continuous batching)
+    models       JAX model implementations (Llama, Gemma, Mixtral, ...)
+    ops          Pallas kernels + op wrappers
+    parallel     mesh construction, shardings, ring attention
+    train        LoRA fine-tuning step (mesh-sharded)
+    utils        hashing, misc helpers
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,3 @@
+from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
+
+__all__ = ["SimpleMovingAverage"]

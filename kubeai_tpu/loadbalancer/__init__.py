@@ -1,0 +1,17 @@
+from kubeai_tpu.loadbalancer.chwbl import HashRing, chwbl_choose, load_ok
+from kubeai_tpu.loadbalancer.group import (
+    LEAST_LOAD,
+    PREFIX_HASH,
+    Endpoint,
+    EndpointGroup,
+)
+
+__all__ = [
+    "HashRing",
+    "chwbl_choose",
+    "load_ok",
+    "Endpoint",
+    "EndpointGroup",
+    "LEAST_LOAD",
+    "PREFIX_HASH",
+]

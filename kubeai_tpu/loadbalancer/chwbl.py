@@ -1,0 +1,93 @@
+"""Consistent Hashing with Bounded Loads (CHWBL) ring.
+
+Behavioral parity with the reference's prefix-hash strategy
+(ref: internal/loadbalancer/balance_chwbl.go): each endpoint is placed on
+a 64-bit xxhash ring `replication` times; a request key hashes to a ring
+position and we walk clockwise until we find an endpoint whose in-flight
+load is within `load_factor` of the (simulated, +1) mean load. Endpoints
+that can't serve the request's adapter are skipped; the first
+adapter-capable endpoint seen is the fallback if none meets the load bound.
+"""
+
+from __future__ import annotations
+
+import bisect
+from typing import Callable, Iterator
+
+from kubeai_tpu.utils.xxh import xxh64
+
+
+def load_ok(load: int, total_load: int, n_endpoints: int, load_factor: float) -> bool:
+    """Bounded-load check; the +1 simulates the incoming request's load
+    (ref: balance_chwbl.go:152-162)."""
+    if total_load == 0:
+        return True
+    avg = (total_load + 1) / n_endpoints
+    return load <= avg * load_factor
+
+
+class HashRing:
+    """Sorted xxhash64 ring with virtual-node replication."""
+
+    def __init__(self, replication: int = 256):
+        self.replication = replication
+        self._hash_to_name: dict[int, str] = {}
+        self._sorted: list[int] = []
+
+    def __len__(self) -> int:
+        return len(self._sorted)
+
+    def _replica_hashes(self, name: str) -> Iterator[int]:
+        for i in range(self.replication):
+            yield xxh64(f"{name}{i}")
+
+    def add(self, name: str) -> None:
+        for h in self._replica_hashes(name):
+            if h not in self._hash_to_name:
+                bisect.insort(self._sorted, h)
+            self._hash_to_name[h] = name
+
+    def remove(self, name: str) -> None:
+        for h in self._replica_hashes(name):
+            if self._hash_to_name.get(h) == name:
+                del self._hash_to_name[h]
+                i = bisect.bisect_left(self._sorted, h)
+                if i < len(self._sorted) and self._sorted[i] == h:
+                    self._sorted.pop(i)
+
+    def walk(self, key: str) -> Iterator[str]:
+        """Yield endpoint names in clockwise ring order starting at the
+        position of ``xxh64(key)``; one yield per ring slot (an endpoint
+        appears once per virtual node, matching the reference's walk)."""
+        n = len(self._sorted)
+        if n == 0:
+            return
+        start = bisect.bisect_left(self._sorted, xxh64(key))
+        if start >= n:
+            start = 0
+        for off in range(n):
+            yield self._hash_to_name[self._sorted[(start + off) % n]]
+
+
+def chwbl_choose(
+    ring: HashRing,
+    key: str,
+    load_factor: float,
+    adapter: str,
+    has_adapter: Callable[[str, str], bool],
+    endpoint_load: Callable[[str], int],
+    total_load: int,
+    n_endpoints: int,
+) -> str | None:
+    """Pick an endpoint name for *key*, honoring adapter capability and the
+    bounded-load condition; falls back to the first adapter-capable endpoint
+    (ref: balance_chwbl.go:14-84)."""
+    fallback: str | None = None
+    for name in ring.walk(key):
+        if adapter and not has_adapter(name, adapter):
+            continue
+        if fallback is None:
+            fallback = name
+        if load_ok(endpoint_load(name), total_load, n_endpoints, load_factor):
+            return name
+    return fallback

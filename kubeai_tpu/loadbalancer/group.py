@@ -1,0 +1,153 @@
+"""Per-model endpoint group: in-flight accounting + blocking endpoint await.
+
+Behavioral parity with the reference's endpoint group
+(ref: internal/loadbalancer/group.go): requests block until the group has
+at least one endpoint (the scale-from-zero cold-start path), a strategy
+picks an endpoint, its in-flight counter is incremented, and the caller
+gets a completion callback that decrements it. Go's closed-channel
+broadcast is expressed here as a Condition + generation counter.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from kubeai_tpu.loadbalancer.chwbl import HashRing, chwbl_choose
+
+LEAST_LOAD = "LeastLoad"
+PREFIX_HASH = "PrefixHash"
+
+
+@dataclass
+class Endpoint:
+    address: str
+    adapters: set[str] = field(default_factory=set)
+    in_flight: int = 0
+
+
+class EndpointGroup:
+    def __init__(self, chwbl_replication: int = 256):
+        self._lock = threading.Lock()
+        self._cond = threading.Condition(self._lock)
+        self._endpoints: dict[str, Endpoint] = {}
+        self._total_in_flight = 0
+        self._generation = 0
+        self._ring = HashRing(replication=chwbl_replication)
+
+    # -- balancing ---------------------------------------------------------
+
+    def get_best_addr(
+        self,
+        strategy: str = LEAST_LOAD,
+        prefix: str = "",
+        adapter: str = "",
+        mean_load_factor: float = 1.25,
+        timeout: float | None = None,
+        cancelled: threading.Event | None = None,
+    ):
+        """Block until an endpoint is available and return
+        ``(address, done_fn)``; ``done_fn`` must be called when the request
+        completes to release the in-flight slot.
+
+        Raises TimeoutError on deadline, and RuntimeError if *cancelled* is
+        set while waiting.
+        """
+        deadline = None if timeout is None else time.monotonic() + timeout
+        with self._cond:
+            await_change = False
+            while True:
+                while await_change or not self._endpoints:
+                    gen = self._generation
+                    remaining = None
+                    if deadline is not None:
+                        remaining = deadline - time.monotonic()
+                        if remaining <= 0:
+                            raise TimeoutError("timed out awaiting model endpoints")
+                    # Wake periodically to observe cancellation.
+                    self._cond.wait(min(remaining, 0.1) if remaining is not None else 0.1)
+                    if cancelled is not None and cancelled.is_set():
+                        raise RuntimeError("request cancelled while awaiting endpoints")
+                    if self._generation != gen:
+                        await_change = False
+
+                name = self._choose(strategy, prefix, adapter, mean_load_factor)
+                if name is None:
+                    # No endpoint can serve this request (e.g. adapter not
+                    # yet loaded anywhere) — wait for the endpoint set to
+                    # change (ref: group.go:78-80 recursion).
+                    await_change = True
+                    continue
+
+                ep = self._endpoints[name]
+                ep.in_flight += 1
+                self._total_in_flight += 1
+
+                def done(_name=name):
+                    with self._lock:
+                        e = self._endpoints.get(_name)
+                        if e is not None:
+                            e.in_flight -= 1
+                        self._total_in_flight -= 1
+
+                return ep.address, done
+
+    def _choose(self, strategy: str, prefix: str, adapter: str, mean_load_factor: float):
+        if strategy == PREFIX_HASH:
+            return chwbl_choose(
+                self._ring,
+                key=adapter + prefix,
+                load_factor=mean_load_factor,
+                adapter=adapter,
+                has_adapter=lambda n, a: a in self._endpoints[n].adapters,
+                endpoint_load=lambda n: self._endpoints[n].in_flight,
+                total_load=self._total_in_flight,
+                n_endpoints=len(self._endpoints),
+            )
+        if strategy == LEAST_LOAD:
+            best = None
+            for name, ep in self._endpoints.items():
+                if adapter and adapter not in ep.adapters:
+                    continue
+                if best is None or ep.in_flight < self._endpoints[best].in_flight:
+                    best = name
+            return best
+        raise ValueError(f"unknown load balancing strategy: {strategy!r}")
+
+    # -- membership --------------------------------------------------------
+
+    def reconcile_endpoints(self, observed: dict[str, Endpoint]) -> None:
+        """Converge group membership to *observed* (name -> Endpoint).
+        In-flight counts on surviving endpoints are preserved; counts on
+        removed endpoints drain naturally via their done callbacks
+        (ref: group.go:108-137)."""
+        with self._cond:
+            for name, obs in observed.items():
+                cur = self._endpoints.get(name)
+                if cur is not None:
+                    cur.adapters = set(obs.adapters)
+                else:
+                    self._endpoints[name] = Endpoint(
+                        address=obs.address, adapters=set(obs.adapters)
+                    )
+                    self._ring.add(name)
+            for name in list(self._endpoints):
+                if name not in observed:
+                    self._ring.remove(name)
+                    del self._endpoints[name]
+            if observed:
+                self._generation += 1
+                self._cond.notify_all()
+
+    def get_all_addrs(self) -> list[str]:
+        with self._lock:
+            return [ep.address for ep in self._endpoints.values()]
+
+    def total_in_flight(self) -> int:
+        with self._lock:
+            return self._total_in_flight
+
+    def endpoint_loads(self) -> dict[str, int]:
+        with self._lock:
+            return {name: ep.in_flight for name, ep in self._endpoints.items()}

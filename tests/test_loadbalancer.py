@@ -1,0 +1,192 @@
+import threading
+import time
+from collections import Counter
+
+import pytest
+
+from kubeai_tpu.loadbalancer import (
+    LEAST_LOAD,
+    PREFIX_HASH,
+    Endpoint,
+    EndpointGroup,
+    HashRing,
+    load_ok,
+)
+
+
+def make_group(addrs, adapters=None, replication=16):
+    g = EndpointGroup(chwbl_replication=replication)
+    observed = {
+        a: Endpoint(address=a, adapters=set((adapters or {}).get(a, ())))
+        for a in addrs
+    }
+    g.reconcile_endpoints(observed)
+    return g
+
+
+class TestLoadOK:
+    def test_zero_total_always_ok(self):
+        assert load_ok(100, 0, 1, 1.0)
+
+    def test_bounded(self):
+        # avg = (10+1)/2 = 5.5; threshold 5.5 * 1.0
+        assert load_ok(5, 10, 2, 1.0)
+        assert not load_ok(6, 10, 2, 1.0)
+        assert load_ok(6, 10, 2, 1.25)
+
+
+class TestHashRing:
+    def test_add_remove(self):
+        r = HashRing(replication=8)
+        r.add("a")
+        r.add("b")
+        assert len(r) == 16
+        r.remove("a")
+        assert len(r) == 8
+        assert set(r.walk("key")) == {"b"}
+
+    def test_walk_deterministic(self):
+        r = HashRing(replication=8)
+        for n in ["a", "b", "c"]:
+            r.add(n)
+        assert list(r.walk("k1")) == list(r.walk("k1"))
+
+    def test_distribution_roughly_uniform(self):
+        r = HashRing(replication=64)
+        for n in ["a", "b", "c", "d"]:
+            r.add(n)
+        firsts = Counter(next(iter(r.walk(f"key-{i}"))) for i in range(2000))
+        for n in ["a", "b", "c", "d"]:
+            assert 2000 * 0.10 < firsts[n] < 2000 * 0.45
+
+
+class TestLeastLoad:
+    def test_picks_min_inflight(self):
+        g = make_group(["a", "b"])
+        addr1, done1 = g.get_best_addr(LEAST_LOAD, timeout=1)
+        addr2, done2 = g.get_best_addr(LEAST_LOAD, timeout=1)
+        assert {addr1, addr2} == {"a", "b"}
+        done1()
+        addr3, done3 = g.get_best_addr(LEAST_LOAD, timeout=1)
+        assert addr3 == addr1  # the freed endpoint is least loaded again
+        done2()
+        done3()
+
+    def test_adapter_filter(self):
+        g = make_group(["a", "b"], adapters={"b": ["lora1"]})
+        for _ in range(3):
+            addr, done = g.get_best_addr(LEAST_LOAD, adapter="lora1", timeout=1)
+            assert addr == "b"
+
+
+class TestPrefixHash:
+    def test_same_prefix_same_endpoint_when_unloaded(self):
+        g = make_group(["a", "b", "c"])
+        picks = set()
+        for _ in range(5):
+            addr, done = g.get_best_addr(PREFIX_HASH, prefix="user-42", timeout=1)
+            done()
+            picks.add(addr)
+        assert len(picks) == 1
+
+    def test_bounded_load_spills_over(self):
+        g = make_group(["a", "b"])
+        # Saturate whichever endpoint the prefix maps to without releasing.
+        addrs = [g.get_best_addr(PREFIX_HASH, prefix="p", timeout=1)[0] for _ in range(8)]
+        assert len(set(addrs)) == 2, "bounded load should spill to second endpoint"
+
+    def test_adapter_fallback_ignores_load_bound(self):
+        g = make_group(["a", "b"], adapters={"a": ["x"]})
+        # Overload "a"; adapter-constrained requests must still go there.
+        holds = [g.get_best_addr(LEAST_LOAD, timeout=1) for _ in range(5)]
+        addr, done = g.get_best_addr(PREFIX_HASH, prefix="p", adapter="x", timeout=1)
+        assert addr == "a"
+
+
+class TestAwaitEndpoints:
+    def test_times_out_when_empty(self):
+        g = EndpointGroup()
+        with pytest.raises(TimeoutError):
+            g.get_best_addr(LEAST_LOAD, timeout=0.2)
+
+    def test_blocks_until_endpoint_appears(self):
+        g = EndpointGroup()
+        result = {}
+
+        def client():
+            result["addr"] = g.get_best_addr(LEAST_LOAD, timeout=5)[0]
+
+        t = threading.Thread(target=client)
+        t.start()
+        time.sleep(0.15)
+        assert "addr" not in result
+        g.reconcile_endpoints({"a": Endpoint(address="a")})
+        t.join(timeout=5)
+        assert result["addr"] == "a"
+
+    def test_cancellation(self):
+        g = EndpointGroup()
+        cancelled = threading.Event()
+        errs = []
+
+        def client():
+            try:
+                g.get_best_addr(LEAST_LOAD, timeout=10, cancelled=cancelled)
+            except RuntimeError as e:
+                errs.append(e)
+
+        t = threading.Thread(target=client)
+        t.start()
+        cancelled.set()
+        t.join(timeout=5)
+        assert errs
+
+
+class TestReconcile:
+    def test_inflight_preserved_across_reconcile(self):
+        g = make_group(["a"])
+        addr, done = g.get_best_addr(LEAST_LOAD, timeout=1)
+        g.reconcile_endpoints(
+            {"a": Endpoint(address="a"), "b": Endpoint(address="b")}
+        )
+        assert g.endpoint_loads() == {"a": 1, "b": 0}
+        done()
+        assert g.endpoint_loads() == {"a": 0, "b": 0}
+
+    def test_removed_endpoint_drain_keeps_total_consistent(self):
+        g = make_group(["a"])
+        addr, done = g.get_best_addr(LEAST_LOAD, timeout=1)
+        g.reconcile_endpoints({"b": Endpoint(address="b")})
+        done()  # endpoint "a" is gone; total still decremented
+        assert g.total_in_flight() == 0
+
+    def test_adapter_set_updated_in_place(self):
+        g = make_group(["a"])
+        g.reconcile_endpoints({"a": Endpoint(address="a", adapters={"x"})})
+        addr, done = g.get_best_addr(LEAST_LOAD, adapter="x", timeout=1)
+        assert addr == "a"
+
+
+class TestConcurrency:
+    def test_parallel_clients_balanced(self):
+        g = make_group(["a", "b", "c", "d"])
+        counts = Counter()
+        lock = threading.Lock()
+
+        def client(i):
+            addr, done = g.get_best_addr(LEAST_LOAD, timeout=5)
+            with lock:
+                counts[addr] += 1
+            time.sleep(0.001)
+            done()
+
+        threads = [threading.Thread(target=client, args=(i,)) for i in range(200)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert g.total_in_flight() == 0
+        assert sum(counts.values()) == 200
+        # Reasonable spread across 4 endpoints.
+        for addr in ["a", "b", "c", "d"]:
+            assert counts[addr] > 10

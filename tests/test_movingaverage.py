@@ -1,0 +1,41 @@
+import pytest
+
+from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
+
+
+def test_average_over_window():
+    avg = SimpleMovingAverage([0.0] * 4)
+    avg.next(4.0)
+    assert avg.calculate() == 1.0
+    avg.next(4.0)
+    avg.next(4.0)
+    avg.next(4.0)
+    assert avg.calculate() == 4.0
+
+
+def test_ring_overwrite():
+    avg = SimpleMovingAverage([0.0, 0.0])
+    for v in [1.0, 2.0, 3.0]:
+        avg.next(v)
+    # Window of 2: holds [3.0, 2.0]
+    assert avg.calculate() == 2.5
+
+
+def test_decays_to_zero():
+    # The scale-to-zero property: enough zero samples bring the mean to 0.
+    avg = SimpleMovingAverage([5.0] * 3)
+    for _ in range(3):
+        avg.next(0.0)
+    assert avg.calculate() == 0.0
+
+
+def test_seed_preserved_until_overwritten():
+    avg = SimpleMovingAverage([6.0, 6.0, 6.0])
+    assert avg.calculate() == 6.0
+    avg.next(0.0)
+    assert avg.calculate() == 4.0
+
+
+def test_empty_seed_rejected():
+    with pytest.raises(ValueError):
+        SimpleMovingAverage([])

@@ -1,0 +1,28 @@
+from kubeai_tpu.utils.xxh import xxh64
+
+
+def test_known_vectors():
+    # Published xxHash64 test vectors (seed 0).
+    assert xxh64(b"") == 0xEF46DB3751D8E999
+    assert xxh64(b"abc") == 0x44BC2CF5AD770999
+
+
+def test_str_and_bytes_agree():
+    assert xxh64("hello world") == xxh64(b"hello world")
+
+
+def test_long_input_paths():
+    # >=32 bytes exercises the 4-accumulator path; check determinism and
+    # sensitivity to single-byte changes across length regimes.
+    for n in [1, 3, 4, 7, 8, 15, 16, 31, 32, 33, 63, 64, 100, 1000]:
+        data = bytes(range(256)) * 4
+        a = xxh64(data[:n])
+        b = xxh64(data[:n])
+        assert a == b
+        if n > 0:
+            mutated = bytes([data[0] ^ 1]) + data[1:n]
+            assert xxh64(mutated) != a
+
+
+def test_seed_changes_hash():
+    assert xxh64(b"abc", seed=1) != xxh64(b"abc", seed=0)
