@@ -38,8 +38,11 @@ class HashRing:
         return len(self._sorted)
 
     def _replica_hashes(self, name: str) -> Iterator[int]:
+        # "/" separator keeps the input unambiguous: f"{name}{i}" would make
+        # "pod-1"+"23" collide with "pod-12"+"3" and corrupt the ring on
+        # remove (pod names can't contain "/").
         for i in range(self.replication):
-            yield xxh64(f"{name}{i}")
+            yield xxh64(f"{name}/{i}")
 
     def add(self, name: str) -> None:
         for h in self._replica_hashes(name):
@@ -83,11 +86,22 @@ def chwbl_choose(
     bounded-load condition; falls back to the first adapter-capable endpoint
     (ref: balance_chwbl.go:14-84)."""
     fallback: str | None = None
+    seen: set[str] = set()
     for name in ring.walk(key):
+        # The walk yields one name per ring slot; loads can't change while
+        # the group lock is held, so each distinct endpoint needs checking
+        # only once (first occurrence preserves ring order).
+        if name in seen:
+            continue
+        seen.add(name)
         if adapter and not has_adapter(name, adapter):
+            if len(seen) == n_endpoints:
+                break
             continue
         if fallback is None:
             fallback = name
         if load_ok(endpoint_load(name), total_load, n_endpoints, load_factor):
             return name
+        if len(seen) == n_endpoints:
+            break
     return fallback

@@ -65,10 +65,13 @@ class EndpointGroup:
                         remaining = deadline - time.monotonic()
                         if remaining <= 0:
                             raise TimeoutError("timed out awaiting model endpoints")
-                    # Wake periodically to observe cancellation.
-                    self._cond.wait(min(remaining, 0.1) if remaining is not None else 0.1)
-                    if cancelled is not None and cancelled.is_set():
-                        raise RuntimeError("request cancelled while awaiting endpoints")
+                    if cancelled is None:
+                        self._cond.wait(remaining)
+                    else:
+                        # Wake periodically to observe cancellation.
+                        self._cond.wait(min(remaining, 0.1) if remaining is not None else 0.1)
+                        if cancelled.is_set():
+                            raise RuntimeError("request cancelled while awaiting endpoints")
                     if self._generation != gen:
                         await_change = False
 

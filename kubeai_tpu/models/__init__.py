@@ -1,0 +1,3 @@
+from kubeai_tpu.models.base import ModelConfig
+
+__all__ = ["ModelConfig"]

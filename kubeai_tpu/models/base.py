@@ -1,0 +1,80 @@
+"""Model configuration shared by all model families."""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from dataclasses import dataclass
+
+from kubeai_tpu.ops.rope import RopeScaling
+
+
+@dataclass(frozen=True)
+class ModelConfig:
+    vocab_size: int = 32000
+    hidden_size: int = 4096
+    intermediate_size: int = 11008
+    num_layers: int = 32
+    num_heads: int = 32
+    num_kv_heads: int = 32
+    head_dim: int | None = None  # defaults to hidden_size // num_heads
+    rope_theta: float = 10000.0
+    rope_scaling: RopeScaling | None = None
+    rms_norm_eps: float = 1e-5
+    max_position: int = 8192
+    tie_word_embeddings: bool = False
+    # MoE (Mixtral-style); num_experts == 0 means dense.
+    num_experts: int = 0
+    num_experts_per_tok: int = 2
+    dtype: str = "bfloat16"
+
+    @property
+    def head_dim_(self) -> int:
+        return self.head_dim or self.hidden_size // self.num_heads
+
+    @classmethod
+    def from_hf(cls, config) -> "ModelConfig":
+        """Build from a transformers PretrainedConfig (Llama/Mistral/Mixtral/
+        Gemma/Qwen2-style field names)."""
+        get = lambda k, d=None: getattr(config, k, d)
+        scaling = None
+        rs = get("rope_scaling")
+        if isinstance(rs, dict) and rs.get("rope_type", rs.get("type")) == "llama3":
+            scaling = RopeScaling(
+                factor=rs.get("factor", 8.0),
+                low_freq_factor=rs.get("low_freq_factor", 1.0),
+                high_freq_factor=rs.get("high_freq_factor", 4.0),
+                original_max_position=rs.get("original_max_position_embeddings", 8192),
+            )
+        return cls(
+            vocab_size=config.vocab_size,
+            hidden_size=config.hidden_size,
+            intermediate_size=get("intermediate_size") or get("ffn_dim"),
+            num_layers=get("num_hidden_layers"),
+            num_heads=get("num_attention_heads"),
+            num_kv_heads=get("num_key_value_heads") or get("num_attention_heads"),
+            head_dim=get("head_dim"),
+            rope_theta=get("rope_theta", 10000.0),
+            rope_scaling=scaling,
+            rms_norm_eps=get("rms_norm_eps", 1e-5),
+            max_position=get("max_position_embeddings", 8192),
+            tie_word_embeddings=bool(get("tie_word_embeddings", False)),
+            num_experts=get("num_local_experts", 0) or 0,
+            num_experts_per_tok=get("num_experts_per_tok", 2) or 2,
+        )
+
+    @classmethod
+    def from_json_file(cls, path: str) -> "ModelConfig":
+        """Load from an HF-format config.json on disk (no transformers needed)."""
+        with open(os.path.join(path, "config.json") if os.path.isdir(path) else path) as f:
+            raw = json.load(f)
+
+        class _Obj:
+            def __init__(self, d):
+                self.__dict__.update(d)
+
+        return cls.from_hf(_Obj(raw))
+
+    def replace(self, **kw) -> "ModelConfig":
+        return dataclasses.replace(self, **kw)

@@ -1,0 +1,222 @@
+"""Llama-family decoder (Llama 2/3, Mistral, Qwen2-style) in functional JAX.
+
+Design notes (TPU-first, not a torch translation):
+- Parameters are a pytree of arrays with all layers **stacked on a leading
+  L axis** and the forward pass is a single `lax.scan` over layers — one
+  layer is traced/compiled once regardless of depth, and XLA pipelines the
+  weight streams.
+- One `apply()` serves prefill, decode, and training: the causal mask is
+  derived entirely from absolute `positions`, and the KV cache (when
+  given) is written by batched scatter at those positions. Static shapes
+  throughout; batch/sequence bucketing happens in the engine.
+- GQA is computed grouped (see kubeai_tpu.ops.attention) so KV stays at
+  Kv-head width in HBM.
+- Sharding is expressed separately (kubeai_tpu.parallel.sharding) as
+  PartitionSpec trees over a ("dp", "tp") mesh; this module is
+  sharding-agnostic and relies on XLA propagation.
+
+Replaces the engine tier the reference delegates to vLLM containers
+(ref: internal/modelcontroller/engine_vllm.go — config-only there).
+
+Pad semantics: prefill pads sit at positions >= the true length and write
+garbage K/V there; those slots are never attended (mask is key_pos <=
+query_pos and real queries stop at length-1) and are overwritten by decode
+steps before the sequence ever reaches them.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.ops.attention import attention
+from kubeai_tpu.ops.norms import rms_norm
+from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
+
+Params = dict[str, Any]
+
+
+# ---------------------------------------------------------------------------
+# Parameter creation / conversion
+
+
+def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
+    """Random-normal initialized parameters (tests, benches, training)."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    D, F, L = config.hidden_size, config.intermediate_size, config.num_layers
+    H, Kv, h = config.num_heads, config.num_kv_heads, config.head_dim_
+    V = config.vocab_size
+    keys = iter(jax.random.split(key, 16))
+
+    def w(k, *shape, scale=None):
+        scale = scale or (1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
+        return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
+
+    params: Params = {
+        "embed": w(next(keys), V, D, scale=0.02),
+        "final_norm": jnp.ones((D,), dtype),
+        "layers": {
+            "ln1": jnp.ones((L, D), dtype),
+            "ln2": jnp.ones((L, D), dtype),
+            "wq": w(next(keys), L, D, H * h),
+            "wk": w(next(keys), L, D, Kv * h),
+            "wv": w(next(keys), L, D, Kv * h),
+            "wo": w(next(keys), L, H * h, D),
+            "wg": w(next(keys), L, D, F),
+            "wu": w(next(keys), L, D, F),
+            "wd": w(next(keys), L, F, D),
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = w(next(keys), D, V, scale=0.02)
+    return params
+
+
+def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype=None) -> Params:
+    """Convert an HF Llama-style state dict (name -> numpy array) into our
+    stacked-layer pytree. Linear weights are transposed to [in, out]."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    L = config.num_layers
+
+    def get(name):
+        return np.asarray(state_dict[name])
+
+    def stack(fmt, transpose=True):
+        ws = [get(fmt.format(i)) for i in range(L)]
+        arr = np.stack([w.T if transpose else w for w in ws])
+        return jnp.asarray(arr, dtype)
+
+    params: Params = {
+        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
+        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "layers": {
+            "ln1": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+            "ln2": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
+            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+            "wg": stack("model.layers.{}.mlp.gate_proj.weight"),
+            "wu": stack("model.layers.{}.mlp.up_proj.weight"),
+            "wd": stack("model.layers.{}.mlp.down_proj.weight"),
+        },
+    }
+    if not config.tie_word_embeddings:
+        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
+    return params
+
+
+# ---------------------------------------------------------------------------
+# KV cache
+
+
+def init_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> Params:
+    """Slot-based contiguous KV cache: [L, B, max_len, Kv, head_dim]."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    shape = (config.num_layers, batch, max_len, config.num_kv_heads, config.head_dim_)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+# ---------------------------------------------------------------------------
+# Forward
+
+
+def apply(
+    params: Params,
+    config: ModelConfig,
+    tokens: jnp.ndarray,  # [B, S] int32
+    positions: jnp.ndarray,  # [B, S] int32 absolute positions
+    cache: Params | None = None,
+    logits_idx: jnp.ndarray | None = None,  # [B] gather one query index before lm_head
+):
+    """Run the decoder. Returns (logits, new_cache).
+
+    With a cache: new K/V are scattered into cache[:, b, positions[b, s]]
+    and attention spans the whole cache, masked to keys <= query position.
+    Without a cache (training / one-shot scoring): attention is causal over
+    the S new tokens only.
+
+    logits shape: [B, S, V], or [B, 1, V] if logits_idx is given.
+    """
+    B, S = tokens.shape
+    H, Kv, h = config.num_heads, config.num_kv_heads, config.head_dim_
+    inv_freq = jnp.asarray(rope_frequencies(h, config.rope_theta, config.rope_scaling))
+
+    x = params["embed"].astype(jnp.dtype(config.dtype))[tokens]
+
+    if cache is not None:
+        skv = cache["k"].shape[2]
+        key_positions = jnp.arange(skv)[None, None, :]  # [1, 1, Skv]
+    else:
+        key_positions = positions[:, None, :]  # [B, 1, S]
+    mask = key_positions <= positions[:, :, None]  # [B, S, Skv]
+
+    batch_idx = jnp.arange(B)[:, None]
+
+    def layer(x, w, k_cache_l, v_cache_l):
+        attn_in = rms_norm(x, w["ln1"], config.rms_norm_eps)
+        q = (attn_in @ w["wq"]).reshape(B, S, H, h)
+        k = (attn_in @ w["wk"]).reshape(B, S, Kv, h)
+        v = (attn_in @ w["wv"]).reshape(B, S, Kv, h)
+        q, k = apply_rope(q, k, positions, inv_freq)
+
+        if k_cache_l is not None:
+            k_full = k_cache_l.at[batch_idx, positions].set(k)
+            v_full = v_cache_l.at[batch_idx, positions].set(v)
+        else:
+            k_full, v_full = k, v
+
+        attn_out = attention(q, k_full, v_full, mask)
+        x = x + attn_out.reshape(B, S, H * h) @ w["wo"]
+
+        mlp_in = rms_norm(x, w["ln2"], config.rms_norm_eps)
+        gated = jax.nn.silu(mlp_in @ w["wg"]) * (mlp_in @ w["wu"])
+        x = x + gated @ w["wd"]
+        return x, (k_full, v_full)
+
+    if cache is not None:
+
+        def step(x, xs):
+            w, kc, vc = xs
+            return layer(x, w, kc, vc)
+
+        x, (new_k, new_v) = jax.lax.scan(step, x, (params["layers"], cache["k"], cache["v"]))
+        new_cache = {"k": new_k, "v": new_v}
+    else:
+
+        def step_nocache(x, w):
+            x, _ = layer(x, w, None, None)
+            return x, None
+
+        x, _ = jax.lax.scan(step_nocache, x, params["layers"])
+        new_cache = None
+
+    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    if logits_idx is not None:
+        x = x[batch_idx, logits_idx[:, None]]  # [B, 1, D]
+    if config.tie_word_embeddings:
+        logits = x @ params["embed"].astype(x.dtype).T
+    else:
+        logits = x @ params["lm_head"]
+    return logits.astype(jnp.float32), new_cache
+
+
+def prefill(params, config, tokens, cache, lengths=None):
+    """Prefill [B, S] left-aligned (right-padded) tokens into the cache.
+    Returns (last_token_logits [B, 1, V], cache); *lengths* [B] are the true
+    sequence lengths (default S)."""
+    B, S = tokens.shape
+    if lengths is None:
+        lengths = jnp.full((B,), S, jnp.int32)
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    return apply(params, config, tokens, pos, cache, logits_idx=lengths - 1)
+
+
+def decode_step(params, config, tokens, cache, lengths):
+    """One decode step for [B, 1] tokens at positions *lengths* [B].
+    Returns (logits [B, 1, V], cache)."""
+    return apply(params, config, tokens, lengths[:, None].astype(jnp.int32), cache)

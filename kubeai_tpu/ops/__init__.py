@@ -1,0 +1,4 @@
+from kubeai_tpu.ops.norms import rms_norm
+from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
+
+__all__ = ["rms_norm", "apply_rope", "rope_frequencies"]

@@ -1,0 +1,64 @@
+"""Attention ops — XLA reference implementation with GQA grouping.
+
+This is the portable compute path (CPU tests + TPU via XLA fusion). The
+Pallas flash-attention kernel in `kubeai_tpu.ops.flash_attention` overrides
+this on TPU for long prefills; decode attention stays here because a
+single-token query is bandwidth-bound and XLA already emits a good fused
+kernel for it.
+
+Shapes follow the engine convention:
+    q: [B, Sq, H, h]      (H = num query heads)
+    k,v: [B, Sk, Kv, h]   (Kv = num KV heads; GQA group size G = H // Kv)
+Grouped einsum avoids materializing repeated KV heads — on TPU this keeps
+the MXU matmuls large while HBM reads stay at Kv width.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_NEG_INF = -0.7 * float(jnp.finfo(jnp.float32).max)
+
+
+def attention(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    v: jnp.ndarray,
+    mask: jnp.ndarray | None = None,
+    scale: float | None = None,
+) -> jnp.ndarray:
+    """Scaled dot-product attention with GQA.
+
+    *mask* is boolean, broadcastable to [B, Sq, Sk]; True = attend.
+    Softmax is computed in float32.
+    """
+    B, Sq, H, h = q.shape
+    Kv = k.shape[2]
+    G = H // Kv
+    if scale is None:
+        scale = h**-0.5
+
+    qg = q.reshape(B, Sq, Kv, G, h)
+    logits = jnp.einsum(
+        "bqkgh,bskh->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
+    )
+    logits *= scale
+    if mask is not None:
+        # [B, Sq, Sk] -> [B, 1, 1, Sq, Sk]
+        logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)
+    weights = jnp.exp(logits - logits.max(axis=-1, keepdims=True))
+    weights = weights / weights.sum(axis=-1, keepdims=True)
+    out = jnp.einsum("bkgqs,bskh->bqkgh", weights, v.astype(jnp.float32))
+    return out.reshape(B, Sq, H, h).astype(q.dtype)
+
+
+def causal_mask(sq: int, sk: int, offset: int = 0) -> jnp.ndarray:
+    """[sq, sk] boolean causal mask; query i attends to keys <= i + offset."""
+    qi = jnp.arange(sq)[:, None] + offset
+    ki = jnp.arange(sk)[None, :]
+    return ki <= qi
+
+
+def length_mask(lengths: jnp.ndarray, sk: int) -> jnp.ndarray:
+    """[B, sk] boolean mask of valid key positions (< per-batch length)."""
+    return jnp.arange(sk)[None, :] < lengths[:, None]

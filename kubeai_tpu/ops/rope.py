@@ -1,0 +1,78 @@
+"""Rotary position embeddings (RoPE), including Llama-3 frequency scaling.
+
+Frequencies are computed once per call in float32 and applied with the
+half-rotation formulation used by HF Llama (rotate_half), so logits match
+the reference models bit-for-bit at float32.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass(frozen=True)
+class RopeScaling:
+    """Llama-3 style NTK-by-parts scaling parameters."""
+
+    factor: float = 8.0
+    low_freq_factor: float = 1.0
+    high_freq_factor: float = 4.0
+    original_max_position: int = 8192
+
+
+def rope_frequencies(
+    head_dim: int,
+    theta: float = 10000.0,
+    scaling: RopeScaling | None = None,
+) -> np.ndarray:
+    """Inverse frequencies [head_dim//2], float32, host-side."""
+    inv_freq = 1.0 / (
+        theta ** (np.arange(0, head_dim, 2, dtype=np.float64) / head_dim)
+    )
+    if scaling is not None:
+        low_wavelen = scaling.original_max_position / scaling.low_freq_factor
+        high_wavelen = scaling.original_max_position / scaling.high_freq_factor
+        wavelen = 2 * np.pi / inv_freq
+        # Per-band treatment: low-frequency bands are divided by factor,
+        # mid bands smoothly interpolated (Llama-3.1 rope scaling).
+        smooth = (scaling.original_max_position / wavelen - scaling.low_freq_factor) / (
+            scaling.high_freq_factor - scaling.low_freq_factor
+        )
+        scaled = np.where(
+            wavelen > low_wavelen,
+            inv_freq / scaling.factor,
+            np.where(
+                wavelen < high_wavelen,
+                inv_freq,
+                (1 - smooth) * inv_freq / scaling.factor + smooth * inv_freq,
+            ),
+        )
+        inv_freq = scaled
+    return inv_freq.astype(np.float32)
+
+
+def _rotate_half(x: jnp.ndarray) -> jnp.ndarray:
+    half = x.shape[-1] // 2
+    return jnp.concatenate([-x[..., half:], x[..., :half]], axis=-1)
+
+
+def apply_rope(
+    q: jnp.ndarray,
+    k: jnp.ndarray,
+    positions: jnp.ndarray,
+    inv_freq: jnp.ndarray,
+) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Apply RoPE to q,k of shape [B, S, heads, head_dim] at *positions* [B, S]."""
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [B, S, hd/2]
+    emb = jnp.concatenate([angles, angles], axis=-1)  # [B, S, hd]
+    cos = jnp.cos(emb)[:, :, None, :]
+    sin = jnp.sin(emb)[:, :, None, :]
+
+    def rot(x):
+        x32 = x.astype(jnp.float32)
+        return (x32 * cos + _rotate_half(x32) * sin).astype(x.dtype)
+
+    return rot(q), rot(k)

@@ -1,0 +1,18 @@
+from kubeai_tpu.parallel.mesh import make_mesh, single_device_mesh
+from kubeai_tpu.parallel.sharding import (
+    activation_spec,
+    cache_specs,
+    llama_param_specs,
+    named,
+    shard_tree,
+)
+
+__all__ = [
+    "make_mesh",
+    "single_device_mesh",
+    "llama_param_specs",
+    "cache_specs",
+    "activation_spec",
+    "shard_tree",
+    "named",
+]

@@ -1,0 +1,45 @@
+"""Device mesh construction for inference and training.
+
+Axes convention across the framework:
+    dp   — data parallel (independent batch slots / replicas-in-process)
+    tp   — tensor parallel over ICI (megatron-style head/ffn sharding)
+    sp   — sequence parallel (ring attention / long context)
+    ep   — expert parallel (MoE)
+A mesh always carries all requested axes; unused axes have size 1, so a
+single PartitionSpec vocabulary works for every topology. On real hardware
+`jax.experimental.mesh_utils.create_device_mesh` lays axes out so that tp
+rides ICI neighbors.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+from jax.sharding import Mesh
+
+
+def make_mesh(
+    tp: int = 1,
+    dp: int = 1,
+    sp: int = 1,
+    ep: int = 1,
+    devices=None,
+) -> Mesh:
+    """Build a Mesh with axes ("dp", "sp", "ep", "tp"). Sizes must multiply
+    to the device count (pass a subset of devices to use fewer)."""
+    devices = list(devices if devices is not None else jax.devices())
+    want = dp * sp * ep * tp
+    if want > len(devices):
+        raise ValueError(f"mesh {dp}x{sp}x{ep}x{tp} needs {want} devices, have {len(devices)}")
+    devices = devices[:want]
+    try:
+        from jax.experimental import mesh_utils
+
+        arr = mesh_utils.create_device_mesh((dp, sp, ep, tp), devices=devices)
+    except Exception:
+        arr = np.array(devices).reshape(dp, sp, ep, tp)
+    return Mesh(arr, ("dp", "sp", "ep", "tp"))
+
+
+def single_device_mesh() -> Mesh:
+    return make_mesh(devices=jax.devices()[:1])

@@ -1,0 +1,68 @@
+"""PartitionSpec trees for model parameters, caches, and activations.
+
+Megatron-style tensor parallelism expressed declaratively; XLA inserts the
+collectives (all-reduce after wo/wd, all-gather around the vocab-sharded
+embedding) — no hand-written NCCL-equivalent calls, per the scaling-book
+recipe: pick a mesh, annotate shardings, let the compiler do the rest.
+
+The `fsdp` argument additionally shards the non-tp dimension of each weight
+over the dp axis (ZeRO-3 style) for training / memory-constrained serving.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def llama_param_specs(config=None, fsdp: bool = False):
+    """PartitionSpec tree matching models.llama param trees.
+
+    tp sharding: attention heads + ffn intermediate dim; vocab-sharded
+    embedding and lm_head.
+    """
+    d = "dp" if fsdp else None
+    specs = {
+        "embed": P("tp", d),  # vocab-sharded
+        "final_norm": P(None),
+        "layers": {
+            "ln1": P(None, None),
+            "ln2": P(None, None),
+            "wq": P(None, d, "tp"),
+            "wk": P(None, d, "tp"),
+            "wv": P(None, d, "tp"),
+            "wo": P(None, "tp", d),
+            "wg": P(None, d, "tp"),
+            "wu": P(None, d, "tp"),
+            "wd": P(None, "tp", d),
+        },
+    }
+    if config is None or not config.tie_word_embeddings:
+        specs["lm_head"] = P(d, "tp")  # [D, V]: vocab-sharded output
+    return specs
+
+
+def cache_specs():
+    """KV cache [L, B, S, Kv, h]: batch over dp, KV heads over tp."""
+    return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}
+
+
+def activation_spec():
+    """[B, S, D] activations: batch over dp (sequence over sp when used)."""
+    return P("dp", "sp", None)
+
+
+def shard_tree(tree, specs, mesh: Mesh):
+    """Device-put a pytree according to a matching PartitionSpec tree."""
+    return jax.tree_util.tree_map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
+    )
+
+
+def named(specs, mesh: Mesh):
+    """PartitionSpec tree -> NamedSharding tree (for jit in/out shardings)."""
+    return jax.tree_util.tree_map(
+        lambda s: NamedSharding(mesh, s),
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )

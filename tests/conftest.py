@@ -7,7 +7,9 @@ driver's dryrun uses. Must be set before jax is imported anywhere.
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU even when a real TPU is attached (JAX_PLATFORMS may be pre-set
+# to the TPU platform in the environment): CI must not depend on hardware.
+os.environ["JAX_PLATFORMS"] = "cpu"
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
@@ -15,6 +17,16 @@ if "xla_force_host_platform_device_count" not in flags:
     ).strip()
 
 import pytest  # noqa: E402
+
+# The CPU backend's oneDNN fastmath path computes f32 matmuls at ~bf16
+# precision (observed ~1e-1 abs error vs f64); force full precision so
+# numerical comparisons against transformers are meaningful.
+import jax  # noqa: E402
+
+jax.config.update("jax_default_matmul_precision", "highest")
+# Belt and braces: if jax was imported before this conftest (plugin import
+# order), the env var above is too late — set the config directly too.
+jax.config.update("jax_platforms", "cpu")
 
 
 @pytest.fixture(scope="session")

@@ -1,0 +1,23 @@
+"""Regression tests for ring integrity under ambiguous names and churn."""
+
+from kubeai_tpu.loadbalancer.chwbl import HashRing
+
+
+def test_ambiguous_names_do_not_collide():
+    r = HashRing(replication=64)
+    r.add("pod-1")
+    r.add("pod-12")
+    assert len(r) == 128
+
+
+def test_ring_survives_churn():
+    r = HashRing(replication=64)
+    r.add("pod-1")
+    r.add("pod-12")
+    r.remove("pod-12")
+    assert len(r) == 64
+    assert set(r.walk("any")) == {"pod-1"}
+    r.add("pod-12")
+    r.remove("pod-1")
+    assert len(r) == 64
+    assert set(r.walk("any")) == {"pod-12"}
