@@ -1,0 +1,446 @@
+"""Continuous-batching inference engine (JetStream-style slots).
+
+The TPU-native replacement for the engine containers the reference
+orchestrates but never implements (ref: charts/kubeai/values.yaml:39-75
+engine image matrix; SURVEY.md §2.9). Architecture:
+
+- A fixed pool of **decode slots** backed by one big KV cache
+  [L, max_slots, max_seq_len, Kv, h] that lives on device and is donated
+  through every jitted step (no per-step copies).
+- **Prefill** pads the prompt to a power-of-two bucket and writes straight
+  into the admitted slot's cache rows via `llama.prefill_into` (one
+  compilation per bucket).
+- **Decode** runs all slots every step in a single jitted call that also
+  samples (per-slot temperature/top-k/top-p arrays) and advances per-slot
+  PRNG keys device-side; only the sampled token ids [max_slots] cross back
+  to the host per step.
+- A single scheduler thread owns the device state; HTTP handler threads
+  talk to it through queues. Stop handling (max_tokens, EOS, stop strings)
+  is host-side on the incrementally detokenized stream.
+"""
+
+from __future__ import annotations
+
+import logging
+import queue
+import threading
+import time
+from dataclasses import dataclass, field
+from functools import partial
+from typing import Any
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeai_tpu.engine.sampling import SamplingParams, sample
+from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+
+log = logging.getLogger("kubeai_tpu.engine")
+
+
+@dataclass
+class EngineConfig:
+    max_slots: int = 8
+    max_seq_len: int = 2048
+    prefill_buckets: tuple[int, ...] = (32, 64, 128, 256, 512, 1024)
+    max_queue: int = 512
+    # Cap on new tokens per request (request max_tokens is clamped to fit
+    # the slot: prompt_len + max_tokens <= max_seq_len).
+    default_max_tokens: int = 256
+
+
+@dataclass
+class FinishInfo:
+    reason: str  # "stop" | "length"
+    prompt_tokens: int
+    completion_tokens: int
+
+
+@dataclass
+class Request:
+    prompt_ids: list[int]
+    params: SamplingParams
+    out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
+    # events on `out`: ("token", id, text_delta) | ("done", FinishInfo) |
+    # ("error", message)
+    cancelled: threading.Event = field(default_factory=threading.Event)
+    arrival: float = field(default_factory=time.monotonic)
+
+
+@dataclass
+class _Slot:
+    req: Request
+    detok: IncrementalDetokenizer
+    prompt_len: int
+    generated: int = 0
+    committed_text: str = ""  # decodable text so far (incomplete UTF-8 held back)
+    delivered_chars: int = 0  # prefix of committed_text already sent to client
+    budget: int = 0  # max new tokens for this request
+
+    @property
+    def holdback(self) -> int:
+        """Chars withheld from streaming so a stop string spanning chunk
+        boundaries can be trimmed before the client sees it."""
+        stops = self.req.params.stop
+        return max((len(s) for s in stops), default=1) - 1
+
+
+class Engine:
+    """Single-model engine; one instance per process/replica."""
+
+    def __init__(
+        self,
+        model_config: ModelConfig,
+        params,
+        tokenizer,
+        engine_config: EngineConfig | None = None,
+        apply_fns=None,
+    ):
+        self.cfg = engine_config or EngineConfig()
+        self.model_config = model_config
+        self.params = params
+        self.tokenizer = tokenizer
+        self._queue: "queue.Queue[Request]" = queue.Queue(maxsize=self.cfg.max_queue)
+        self._slots: list[_Slot | None] = [None] * self.cfg.max_slots
+        self._n_active = 0
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._wake = threading.Event()
+
+        # Metrics (engine-side gauges the autoscaler can ingest).
+        self.m_queue = default_registry.gauge(
+            "kubeai_engine_queue_depth", "requests waiting for a slot"
+        )
+        self.m_active = default_registry.gauge(
+            "kubeai_engine_active_slots", "decode slots in use"
+        )
+        self.m_gen = default_registry.counter(
+            "kubeai_engine_generated_tokens_total", "tokens generated"
+        )
+        self.m_prefill = default_registry.counter(
+            "kubeai_engine_prefill_tokens_total", "prompt tokens prefilled"
+        )
+        self.m_ttft = default_registry.histogram(
+            "kubeai_engine_ttft_seconds", "time to first token"
+        )
+
+        self._init_device_state()
+        self._build_step_fns(apply_fns)
+
+    # -- device state ------------------------------------------------------
+
+    def _init_device_state(self):
+        B = self.cfg.max_slots
+        self._cache = llama.init_cache(self.model_config, B, self.cfg.max_seq_len)
+        self._lengths = jnp.zeros((B,), jnp.int32)
+        self._last_tokens = jnp.zeros((B,), jnp.int32)
+        self._active = jnp.zeros((B,), jnp.bool_)
+        self._keys = jax.random.split(jax.random.key(0), B)
+        self._temp = jnp.ones((B,), jnp.float32)
+        self._top_p = jnp.ones((B,), jnp.float32)
+        self._top_k = jnp.zeros((B,), jnp.int32)
+
+    def _build_step_fns(self, apply_fns=None):
+        mc = self.model_config
+        # The model vocab may be padded past the tokenizer's (tp
+        # divisibility, MXU tiling); padded columns carry zero weights and
+        # logit 0.0, which is very much sampleable — mask them out.
+        n_valid = min(getattr(self.tokenizer, "vocab_size", mc.vocab_size), mc.vocab_size)
+
+        def mask_pad(logits):
+            if n_valid < mc.vocab_size:
+                return logits.at[..., n_valid:].set(-jnp.inf)
+            return logits
+
+        def prefill_fn(params, tokens, length, slot, key, temp, top_p, top_k, cache):
+            logits, cache = llama.prefill_into(params, mc, tokens, cache, slot, length)
+            tok = sample(
+                mask_pad(logits[:, -1]),
+                key[None],
+                temp[None],
+                top_p[None],
+                top_k[None],
+            )[0]
+            return tok, cache
+
+        def decode_fn(params, cache, lengths, last_tokens, keys, active, temp, top_p, top_k):
+            logits, cache = llama.decode_step(params, mc, last_tokens[:, None], cache, lengths)
+            step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k)
+            new_lengths = jnp.where(active, lengths + 1, lengths)
+            return toks, cache, new_lengths, step_keys[:, 1]
+
+        if apply_fns is not None:  # test seam
+            self._prefill_jit, self._decode_jit = apply_fns(prefill_fn, decode_fn)
+        else:
+            self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(8,))
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+
+    # -- public API --------------------------------------------------------
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="engine-loop", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        self._wake.set()
+        if self._thread:
+            self._thread.join(timeout=10)
+
+    def submit(self, prompt_ids: list[int], params: SamplingParams) -> Request:
+        """Enqueue a request; raises queue.Full when saturated (the proxy
+        retries another replica on 503)."""
+        max_prompt = min(max(self.cfg.prefill_buckets), self.cfg.max_seq_len - 1)
+        if len(prompt_ids) > max_prompt:
+            raise ValueError(
+                f"prompt too long: {len(prompt_ids)} tokens > {max_prompt}"
+            )
+        req = Request(prompt_ids=prompt_ids, params=params)
+        self._queue.put_nowait(req)
+        self.m_queue.set(self._queue.qsize())
+        self._wake.set()
+        return req
+
+    def generate(self, prompt_ids: list[int], params: SamplingParams, timeout: float = 300):
+        """Blocking convenience wrapper: returns (token_ids, text, FinishInfo)."""
+        req = self.submit(prompt_ids, params)
+        ids: list[int] = []
+        chunks: list[str] = []
+        deadline = time.monotonic() + timeout
+        while True:
+            ev = req.out.get(timeout=max(0.0, deadline - time.monotonic()))
+            if ev[0] == "token":
+                if ev[1] >= 0:  # -1 marks a text-only flush of held-back chars
+                    ids.append(ev[1])
+                chunks.append(ev[2])
+            elif ev[0] == "done":
+                return ids, "".join(chunks), ev[1]
+            else:
+                raise RuntimeError(ev[1])
+
+    def queue_depth(self) -> int:
+        return self._queue.qsize()
+
+    def active_slots(self) -> int:
+        return self._n_active
+
+    # -- scheduler loop ----------------------------------------------------
+
+    def _loop(self):
+        log.info("engine loop started (slots=%d)", self.cfg.max_slots)
+        while self._running:
+            try:
+                admitted = self._admit_waiting()
+                if self._n_active == 0:
+                    if not admitted:
+                        self._wake.wait(timeout=0.05)
+                        self._wake.clear()
+                    continue
+                self._decode_once()
+            except Exception:
+                # A failed jitted step may have consumed donated buffers —
+                # the device state is unusable. Fail all in-flight requests
+                # and rebuild (elastic recovery; the pod stays alive).
+                log.exception("engine step failed; resetting device state")
+                self._recover()
+
+    def _recover(self):
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[i] = None
+                self._n_active -= 1
+                slot.req.out.put(("error", "engine reset after device error"))
+        self._n_active = 0
+        self.m_active.set(0)
+        self._init_device_state()
+
+    def _admit_waiting(self) -> bool:
+        admitted = False
+        while self._n_active < self.cfg.max_slots:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            self.m_queue.set(self._queue.qsize())
+            if req.cancelled.is_set():
+                continue
+            slot_idx = self._slots.index(None)
+            try:
+                self._prefill(slot_idx, req)
+                admitted = True
+            except Exception as e:  # surface engine errors to the client
+                log.exception("prefill failed")
+                req.out.put(("error", f"prefill failed: {e}"))
+                # The jitted prefill donates the cache; if it died mid-call
+                # the old buffer is gone and the device state must be
+                # rebuilt — escalate to _loop's recovery path.
+                kbuf = self._cache["k"]
+                if getattr(kbuf, "is_deleted", lambda: False)():
+                    raise
+        return admitted
+
+    def _bucket(self, n: int) -> int:
+        for b in self.cfg.prefill_buckets:
+            if n <= b:
+                return b
+        return self.cfg.prefill_buckets[-1]
+
+    def _prefill(self, slot_idx: int, req: Request):
+        ids = req.prompt_ids
+        bucket = self._bucket(len(ids))
+        padded = np.zeros((1, bucket), np.int32)
+        padded[0, : len(ids)] = ids
+        length = jnp.int32(len(ids))
+
+        sp = req.params
+        seed = sp.seed if sp.seed is not None else (time.monotonic_ns() & 0xFFFFFFFF)
+        key = jax.random.key(seed)
+
+        tok, self._cache = self._prefill_jit(
+            self.params,
+            jnp.asarray(padded),
+            length,
+            jnp.int32(slot_idx),
+            key,
+            jnp.float32(sp.temperature),
+            jnp.float32(sp.top_p),
+            jnp.int32(sp.top_k),
+            self._cache,
+        )
+        first_id = int(tok)
+
+        budget = min(
+            sp.max_tokens or self.cfg.default_max_tokens,
+            self.cfg.max_seq_len - len(ids) - 1,
+        )
+        slot = _Slot(
+            req=req,
+            detok=IncrementalDetokenizer(self.tokenizer),
+            prompt_len=len(ids),
+            budget=budget,
+        )
+        self._slots[slot_idx] = slot
+        self._n_active += 1
+        self.m_active.set(self._n_active)
+        self.m_prefill.inc(len(ids))
+        self.m_ttft.observe(time.monotonic() - req.arrival)
+
+        # Register slot in device state: position of the first generated
+        # token is prompt_len; decode will write it there.
+        self._lengths = self._lengths.at[slot_idx].set(len(ids))
+        self._last_tokens = self._last_tokens.at[slot_idx].set(first_id)
+        self._active = self._active.at[slot_idx].set(True)
+        self._keys = self._keys.at[slot_idx].set(jax.random.fold_in(key, 1))
+        self._temp = self._temp.at[slot_idx].set(sp.temperature)
+        self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
+        self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+
+        self._emit_token(slot_idx, first_id)
+
+    def _decode_once(self):
+        toks, self._cache, self._lengths, self._keys = self._decode_jit(
+            self.params,
+            self._cache,
+            self._lengths,
+            self._last_tokens,
+            self._keys,
+            self._active,
+            self._temp,
+            self._top_p,
+            self._top_k,
+        )
+        self._last_tokens = toks
+        tok_host = np.asarray(jax.device_get(toks))
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._emit_token(i, int(tok_host[i]))
+
+    def _emit_token(self, slot_idx: int, token_id: int):
+        """Deliver one generated token to the request; apply stop logic."""
+        slot = self._slots[slot_idx]
+        req = slot.req
+        if req.cancelled.is_set():
+            self._free(slot_idx, "stop", deliver=False)
+            return
+
+        slot.generated += 1
+        self.m_gen.inc()
+
+        eos = self.tokenizer.eos_id
+        if eos is not None and token_id == eos:
+            self._free(slot_idx, "stop")
+            return
+
+        # push() returns only newly-completed text (incomplete trailing
+        # UTF-8 held back), keeping per-token work O(delta).
+        slot.committed_text += slot.detok.push(token_id)
+        text = slot.committed_text
+
+        # Stop strings: nothing before delivered_chars can contain one
+        # (delivery always holds back max(len(stop))-1 chars), so search
+        # only the undelivered tail plus that overlap window.
+        search_from = max(0, slot.delivered_chars - slot.holdback)
+        for s in req.params.stop:
+            pos = text.find(s, search_from)
+            if pos != -1:
+                tail = text[slot.delivered_chars : pos]
+                slot.delivered_chars = pos
+                req.out.put(("token", token_id, tail))
+                self._free(slot_idx, "stop", flush=False)
+                return
+
+        emit_upto = max(len(text) - slot.holdback, slot.delivered_chars)
+        delta = text[slot.delivered_chars : emit_upto]
+        slot.delivered_chars = emit_upto
+        req.out.put(("token", token_id, delta))
+
+        if slot.generated >= slot.budget:
+            self._free(slot_idx, "length")
+
+    def _free(self, slot_idx: int, reason: str, deliver: bool = True, flush: bool = True):
+        slot = self._slots[slot_idx]
+        self._slots[slot_idx] = None
+        self._n_active -= 1
+        self.m_active.set(self._n_active)
+        self._active = self._active.at[slot_idx].set(False)
+        if deliver:
+            if flush:
+                # Deliver held-back chars; detok.text() additionally decodes
+                # any trailing incomplete UTF-8 to replacement chars
+                # (committed_text is always a prefix of it).
+                text = slot.detok.text()
+                tail = text[slot.delivered_chars :]
+                if tail:
+                    slot.req.out.put(("token", -1, tail))
+            slot.req.out.put(
+                ("done", FinishInfo(reason, slot.prompt_len, slot.generated))
+            )
+
+
+def build_test_engine(
+    engine_config: EngineConfig | None = None, seed: int = 0, model_config: ModelConfig | None = None
+) -> Engine:
+    """A tiny randomly-initialized byte-vocab engine for tests/dev — the
+    in-process analogue of the reference's mock engine seam."""
+    from kubeai_tpu.engine.tokenizer import ByteTokenizer
+
+    tok = ByteTokenizer()
+    mc = model_config or ModelConfig(
+        vocab_size=272,  # 259 used; padded up for friendly tiling
+        hidden_size=128,
+        intermediate_size=256,
+        num_layers=2,
+        num_heads=4,
+        num_kv_heads=2,
+        dtype="float32",
+        max_position=2048,
+    )
+    params = llama.init_params(mc, jax.random.key(seed))
+    ec = engine_config or EngineConfig(max_slots=4, max_seq_len=256, prefill_buckets=(16, 32, 64, 128))
+    return Engine(mc, params, tok, ec)

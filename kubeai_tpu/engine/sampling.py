@@ -1,0 +1,68 @@
+"""Device-side token sampling with per-slot parameters.
+
+All slots in the continuous-batching decode step sample in one fused call:
+per-slot temperature / top-k / top-p live in device arrays so the sampler
+is a single jitted kernel with no host branching. Greedy is temperature=0.
+
+top-k uses `lax.top_k` with a static MAX_TOP_K (full-vocab sort would
+serialize the TPU); requests asking for larger k are clamped.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import jax
+import jax.numpy as jnp
+
+MAX_TOP_K = 128
+
+
+@dataclass
+class SamplingParams:
+    """Host-side request sampling options (OpenAI API surface)."""
+
+    temperature: float = 1.0
+    top_p: float = 1.0
+    top_k: int = 0  # 0 = disabled
+    max_tokens: int = 256
+    stop: tuple[str, ...] = ()
+    seed: int | None = None
+    logprobs: bool = False
+
+
+def sample(
+    logits: jnp.ndarray,  # [B, V] float32
+    keys: jnp.ndarray,  # [B] PRNG keys (jax.random.key dtype)
+    temperature: jnp.ndarray,  # [B]
+    top_p: jnp.ndarray,  # [B]
+    top_k: jnp.ndarray,  # [B] int32; 0 = disabled
+) -> jnp.ndarray:
+    """Sample one token per slot. Returns [B] int32."""
+    B, V = logits.shape
+
+    # Work in the top-MAX_TOP_K candidate space; for top_k==0/top_p==1 the
+    # tail beyond MAX_TOP_K is negligible for any trained model, and greedy
+    # (temperature 0) uses the exact argmax below.
+    vals, idxs = jax.lax.top_k(logits, min(MAX_TOP_K, V))  # [B, K] sorted desc
+
+    k = jnp.where(top_k <= 0, MAX_TOP_K, jnp.minimum(top_k, MAX_TOP_K))
+    rank = jnp.arange(vals.shape[1])[None, :]
+    vals = jnp.where(rank < k[:, None], vals, -jnp.inf)
+
+    # top-p over the candidate distribution.
+    safe_temp = jnp.maximum(temperature, 1e-6)[:, None]
+    probs = jax.nn.softmax(vals / safe_temp, axis=-1)
+    cumprobs = jnp.cumsum(probs, axis=-1)
+    # Keep tokens whose *preceding* cumulative mass is < top_p (always keeps
+    # the first token).
+    keep = (cumprobs - probs) < top_p[:, None]
+    vals = jnp.where(keep, vals, -jnp.inf)
+
+    sampled_rank = jax.vmap(
+        lambda v, key, t: jax.random.categorical(key, v / jnp.maximum(t, 1e-6))
+    )(vals, keys, temperature)
+    sampled = jnp.take_along_axis(idxs, sampled_rank[:, None], axis=1)[:, 0]
+
+    greedy = jnp.argmax(logits, axis=-1)
+    return jnp.where(temperature <= 0.0, greedy, sampled).astype(jnp.int32)

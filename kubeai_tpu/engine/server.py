@@ -1,0 +1,382 @@
+"""OpenAI-compatible HTTP server wrapping the engine.
+
+The in-pod API surface the reference expects from its engine containers
+(vLLM-compatible; ref: internal/modelcontroller/engine_vllm.go probes on
+:8000, internal/vllmclient/client.go adapter RPCs):
+
+    GET  /health /healthz /readyz     liveness+readiness
+    GET  /metrics                     Prometheus text (queue depth etc.)
+    GET  /v1/models                   served model + loaded adapters
+    POST /v1/completions              (+ SSE streaming)
+    POST /v1/chat/completions         (+ SSE streaming)
+    POST /v1/load_lora_adapter        {lora_name, lora_path}
+    POST /v1/unload_lora_adapter      {lora_name}
+
+Implementation is stdlib ThreadingHTTPServer: each connection gets a
+thread that blocks on the engine's per-request event queue — the engine
+itself runs a single scheduler thread, so concurrency here is I/O-bound
+fan-in, which Python threads handle fine.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import queue
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeai_tpu.engine.core import Engine
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.metrics import default_registry
+
+log = logging.getLogger("kubeai_tpu.engine.server")
+
+
+class EngineServer:
+    def __init__(self, engine: Engine, model_name: str, host: str = "0.0.0.0", port: int = 8000):
+        self.engine = engine
+        self.model_name = model_name
+        self.adapters: dict[str, str] = {}  # name -> path
+        self._adapters_lock = threading.Lock()
+        handler = _make_handler(self)
+        self.httpd = ThreadingHTTPServer((host, port), handler)
+        self.port = self.httpd.server_port
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self.engine.start()
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("engine server for %s on :%d", self.model_name, self.port)
+
+    def stop(self):
+        self.httpd.shutdown()
+        self.engine.stop()
+
+    # Adapter registry; weight application lands with the LoRA runtime.
+    def load_adapter(self, name: str, path: str) -> tuple[bool, str]:
+        with self._adapters_lock:
+            if name in self.adapters and self.adapters[name] != path:
+                return False, f"adapter {name} already loaded from {self.adapters[name]}"
+            self.adapters[name] = path
+        loader = getattr(self.engine, "load_adapter", None)
+        if loader is not None:
+            try:
+                loader(name, path)
+            except Exception as e:
+                with self._adapters_lock:
+                    self.adapters.pop(name, None)
+                return False, str(e)
+        return True, "ok"
+
+    def unload_adapter(self, name: str) -> tuple[bool, str]:
+        with self._adapters_lock:
+            existed = self.adapters.pop(name, None) is not None
+        unloader = getattr(self.engine, "unload_adapter", None)
+        if existed and unloader is not None:
+            unloader(name)
+        # Idempotency-tolerant like the reference client
+        # (ref: internal/vllmclient/client.go:30-73).
+        return True, "ok" if existed else "adapter was not loaded"
+
+
+def _make_handler(srv: EngineServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug("%s " + fmt, self.address_string(), *args)
+
+        # ---- helpers ----
+
+        def _json(self, code: int, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _error(self, code: int, msg: str, etype: str = "invalid_request_error"):
+            self._json(code, {"error": {"message": msg, "type": etype}})
+
+        def _read_body(self):
+            n = int(self.headers.get("Content-Length", 0))
+            return self.rfile.read(n)
+
+        # ---- routes ----
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path in ("/health", "/healthz", "/readyz"):
+                self._json(200, {"status": "ok", "model": srv.model_name})
+            elif path == "/metrics":
+                body = default_registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/v1/models":
+                models = [{"id": srv.model_name, "object": "model", "owned_by": "kubeai-tpu"}]
+                for name in sorted(srv.adapters):
+                    models.append(
+                        {"id": name, "object": "model", "owned_by": "kubeai-tpu",
+                         "parent": srv.model_name}
+                    )
+                self._json(200, {"object": "list", "data": models})
+            else:
+                self._error(404, f"no route {path}")
+
+        def do_POST(self):
+            path = self.path.split("?")[0]
+            try:
+                body = json.loads(self._read_body() or b"{}")
+            except json.JSONDecodeError as e:
+                return self._error(400, f"invalid JSON: {e}")
+            try:
+                if path == "/v1/completions":
+                    self._completions(body, chat=False)
+                elif path == "/v1/chat/completions":
+                    self._completions(body, chat=True)
+                elif path == "/v1/load_lora_adapter":
+                    ok, msg = srv.load_adapter(body.get("lora_name", ""), body.get("lora_path", ""))
+                    self._json(200 if ok else 400, {"status": msg})
+                elif path == "/v1/unload_lora_adapter":
+                    ok, msg = srv.unload_adapter(body.get("lora_name", ""))
+                    self._json(200, {"status": msg})
+                else:
+                    self._error(404, f"no route {path}")
+            except BrokenPipeError:
+                pass
+            except Exception as e:  # pragma: no cover
+                log.exception("request failed")
+                try:
+                    self._error(500, str(e), "internal_error")
+                except Exception:
+                    pass
+
+        # ---- inference ----
+
+        def _parse_prompt(self, prompt):
+            """OpenAI `prompt` accepts a string, a token-id list, a
+            single-element list of either, or (unsupported here) a batch.
+            Returns (text, ids) with exactly one set, or (None, None) after
+            sending an error response."""
+            if isinstance(prompt, list):
+                if len(prompt) == 0:
+                    self._error(400, "prompt must not be empty")
+                    return None, None
+                if all(isinstance(x, int) for x in prompt):
+                    return None, list(prompt)
+                if len(prompt) > 1:
+                    self._error(400, "batched prompts are not supported")
+                    return None, None
+                prompt = prompt[0]
+                if isinstance(prompt, list):
+                    if not all(isinstance(x, int) for x in prompt):
+                        self._error(400, "prompt token ids must be integers")
+                        return None, None
+                    return None, list(prompt)
+            if not isinstance(prompt, str):
+                self._error(400, "prompt must be a string or token id list")
+                return None, None
+            return prompt, None
+
+        def _completions(self, body: dict, chat: bool):
+            tok = srv.engine.tokenizer
+            prompt_ids = None
+            if chat:
+                messages = body.get("messages")
+                if not isinstance(messages, list) or not messages:
+                    return self._error(400, "messages is required")
+                prompt_text = tok.apply_chat_template(messages, add_generation_prompt=True)
+            else:
+                prompt = body.get("prompt")
+                if prompt is None:
+                    return self._error(400, "prompt is required")
+                prompt_text, prompt_ids = self._parse_prompt(prompt)
+                if prompt_text is None and prompt_ids is None:
+                    return  # _parse_prompt already sent the error
+
+            stop = body.get("stop") or ()
+            if isinstance(stop, str):
+                stop = (stop,)
+            max_tokens = body.get("max_tokens", body.get("max_completion_tokens"))
+            if max_tokens is None:
+                # OpenAI defaults: completions=16, chat=engine default.
+                max_tokens = 16 if not chat else srv.engine.cfg.default_max_tokens
+            elif not isinstance(max_tokens, int) or max_tokens < 1:
+                return self._error(400, "max_tokens must be a positive integer")
+            params = SamplingParams(
+                temperature=float(body.get("temperature", 1.0)),
+                top_p=float(body.get("top_p", 1.0)),
+                top_k=int(body.get("top_k", 0)),
+                max_tokens=int(max_tokens),
+                stop=tuple(stop),
+                seed=body.get("seed"),
+            )
+            if prompt_ids is None:
+                prompt_ids = tok.encode(prompt_text)
+            try:
+                req = srv.engine.submit(prompt_ids, params)
+            except ValueError as e:
+                return self._error(400, str(e))
+            except queue.Full:
+                return self._error(503, "engine saturated", "overloaded_error")
+
+            rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
+            created = int(time.time())
+            if body.get("stream"):
+                self._stream_response(req, rid, created, chat)
+            else:
+                self._full_response(req, rid, created, chat)
+
+        def _full_response(self, req, rid, created, chat):
+            chunks, n_tokens, fin = [], 0, None
+            while True:
+                try:
+                    ev = req.out.get(timeout=600)
+                except queue.Empty:
+                    req.cancelled.set()
+                    return self._error(504, "generation timed out", "timeout_error")
+                if ev[0] == "token":
+                    chunks.append(ev[2])
+                elif ev[0] == "done":
+                    fin = ev[1]
+                    break
+                else:
+                    return self._error(500, ev[1], "internal_error")
+            text = "".join(chunks)
+            usage = {
+                "prompt_tokens": fin.prompt_tokens,
+                "completion_tokens": fin.completion_tokens,
+                "total_tokens": fin.prompt_tokens + fin.completion_tokens,
+            }
+            if chat:
+                choice = {
+                    "index": 0,
+                    "message": {"role": "assistant", "content": text},
+                    "finish_reason": fin.reason,
+                }
+                obj = "chat.completion"
+            else:
+                choice = {"index": 0, "text": text, "finish_reason": fin.reason}
+                obj = "text_completion"
+            self._json(200, {
+                "id": rid, "object": obj, "created": created,
+                "model": srv.model_name, "choices": [choice], "usage": usage,
+            })
+
+        def _stream_response(self, req, rid, created, chat):
+            self.send_response(200)
+            self.send_header("Content-Type", "text/event-stream")
+            self.send_header("Cache-Control", "no-cache")
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+
+            def send_chunk(payload: str):
+                data = f"data: {payload}\n\n".encode()
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
+            obj = "chat.completion.chunk" if chat else "text_completion"
+            if chat:
+                first = {"id": rid, "object": obj, "created": created, "model": srv.model_name,
+                         "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}]}
+                send_chunk(json.dumps(first))
+            try:
+                while True:
+                    ev = req.out.get(timeout=600)
+                    if ev[0] == "token":
+                        if not ev[2]:
+                            continue
+                        if chat:
+                            choice = {"index": 0, "delta": {"content": ev[2]}, "finish_reason": None}
+                        else:
+                            choice = {"index": 0, "text": ev[2], "finish_reason": None}
+                        send_chunk(json.dumps({
+                            "id": rid, "object": obj, "created": created,
+                            "model": srv.model_name, "choices": [choice],
+                        }))
+                    elif ev[0] == "done":
+                        fin = ev[1]
+                        choice = (
+                            {"index": 0, "delta": {}, "finish_reason": fin.reason}
+                            if chat
+                            else {"index": 0, "text": "", "finish_reason": fin.reason}
+                        )
+                        send_chunk(json.dumps({
+                            "id": rid, "object": obj, "created": created,
+                            "model": srv.model_name, "choices": [choice],
+                            "usage": {
+                                "prompt_tokens": fin.prompt_tokens,
+                                "completion_tokens": fin.completion_tokens,
+                                "total_tokens": fin.prompt_tokens + fin.completion_tokens,
+                            },
+                        }))
+                        send_chunk("[DONE]")
+                        self.wfile.write(b"0\r\n\r\n")
+                        self.wfile.flush()
+                        return
+                    else:
+                        send_chunk(json.dumps({"error": {"message": ev[1]}}))
+                        self.wfile.write(b"0\r\n\r\n")
+                        return
+            except (BrokenPipeError, ConnectionResetError):
+                req.cancelled.set()
+
+    return Handler
+
+
+# ---------------------------------------------------------------------------
+# CLI — the entrypoint engine pods run.
+
+
+def build_engine_from_args(args) -> tuple[Engine, str]:
+    from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+
+    ec = EngineConfig(
+        max_slots=args.max_slots,
+        max_seq_len=args.max_seq_len,
+    )
+    if args.model.startswith("test:"):
+        eng = build_test_engine(engine_config=ec)
+        return eng, args.served_model_name or args.model
+    # Real checkpoint path: HF-format directory with config.json +
+    # safetensors weights.
+    from kubeai_tpu.engine.weights import load_engine_from_path
+
+    eng = load_engine_from_path(args.model, ec, tp=args.tensor_parallel_size)
+    return eng, args.served_model_name or args.model
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("kubeai-tpu-engine")
+    parser.add_argument("--model", required=True, help="checkpoint dir or test:tiny")
+    parser.add_argument("--served-model-name", default=None)
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--max-slots", type=int, default=8)
+    parser.add_argument("--max-seq-len", type=int, default=2048)
+    parser.add_argument("--tensor-parallel-size", type=int, default=1)
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    engine, name = build_engine_from_args(args)
+    srv = EngineServer(engine, name, host=args.host, port=args.port)
+    srv.start()
+    log.info("serving %s", name)
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        srv.stop()
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,118 @@
+"""Tokenizer abstraction: HF tokenizers when available, byte-level fallback.
+
+The byte tokenizer exists so the whole stack (engine, server, operator,
+benchmarks, CI) runs hermetically with zero downloads — the same seam the
+reference gets from its mock engines (ref: hack/vllm-mock-metrics,
+test/integration fake backends).
+"""
+
+from __future__ import annotations
+
+import os
+
+
+class ByteTokenizer:
+    """Reversible byte-level tokenizer: token = byte value; specials above.
+
+    vocab: 0..255 bytes, 256 = BOS, 257 = EOS, 258 = PAD.
+    """
+
+    bos_id = 256
+    eos_id = 257
+    pad_id = 258
+    vocab_size = 259
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = list(text.encode("utf-8"))
+        return [self.bos_id] + ids if add_bos else ids
+
+    def decode(self, ids: list[int]) -> str:
+        data = bytes(i for i in ids if 0 <= i < 256)
+        return data.decode("utf-8", errors="replace")
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        parts = [f"<|{m['role']}|>\n{m['content']}\n" for m in messages]
+        if add_generation_prompt:
+            parts.append("<|assistant|>\n")
+        return "".join(parts)
+
+
+class HFTokenizer:
+    """Thin wrapper over transformers.AutoTokenizer (local files only)."""
+
+    def __init__(self, path: str):
+        from transformers import AutoTokenizer
+
+        self._tok = AutoTokenizer.from_pretrained(path, local_files_only=True)
+        self.bos_id = self._tok.bos_token_id
+        self.eos_id = self._tok.eos_token_id
+        self.pad_id = self._tok.pad_token_id or self.eos_id
+        self.vocab_size = len(self._tok)
+
+    def encode(self, text: str, add_bos: bool = True) -> list[int]:
+        ids = self._tok.encode(text, add_special_tokens=False)
+        if add_bos and self.bos_id is not None:
+            ids = [self.bos_id] + ids
+        return ids
+
+    def decode(self, ids: list[int]) -> str:
+        return self._tok.decode(ids, skip_special_tokens=True)
+
+    def apply_chat_template(self, messages: list[dict], add_generation_prompt: bool = True) -> str:
+        try:
+            return self._tok.apply_chat_template(
+                messages, tokenize=False, add_generation_prompt=add_generation_prompt
+            )
+        except Exception:
+            return ByteTokenizer.apply_chat_template(self, messages, add_generation_prompt)
+
+
+def load_tokenizer(path: str | None):
+    """Load the tokenizer for a model dir; byte-level fallback when the dir
+    has no tokenizer files (or path is None/'byte')."""
+    if path in (None, "byte"):
+        return ByteTokenizer()
+    has_tok = any(
+        os.path.exists(os.path.join(path, f))
+        for f in ("tokenizer.json", "tokenizer.model", "tokenizer_config.json")
+    )
+    if not has_tok:
+        return ByteTokenizer()
+    return HFTokenizer(path)
+
+
+class IncrementalDetokenizer:
+    """Streams text from a growing id list, emitting only complete UTF-8
+    chunks, with O(window) work per token: only a sliding token window
+    starting at the last confirmed boundary is re-decoded (a trailing
+    replacement char marks a split multi-byte sequence to hold back)."""
+
+    CONTEXT = 4  # confirmed tokens kept in the decode window so tokenizers
+    # that are context-sensitive at boundaries (SentencePiece leading-space
+    # handling) produce the same text as a full decode
+
+    def __init__(self, tokenizer):
+        self._tok = tokenizer
+        self._ids: list[int] = []
+        self._committed = ""
+        self._confirmed = 0  # ids committed into _committed
+
+    def _pending_delta(self) -> tuple[str, bool]:
+        ctx = max(0, self._confirmed - self.CONTEXT)
+        prefix = self._tok.decode(self._ids[ctx : self._confirmed]) if self._confirmed > ctx else ""
+        full = self._tok.decode(self._ids[ctx:])
+        return full[len(prefix) :], full.endswith("�")
+
+    def push(self, token_id: int) -> str:
+        self._ids.append(token_id)
+        delta, incomplete = self._pending_delta()
+        if incomplete:
+            return ""
+        self._committed += delta
+        self._confirmed = len(self._ids)
+        return delta
+
+    def text(self) -> str:
+        """Full text including any incomplete tail (as replacement chars)."""
+        delta, _ = self._pending_delta()
+        return self._committed + delta

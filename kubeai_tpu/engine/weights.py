@@ -1,0 +1,118 @@
+"""Checkpoint loading: HF-format directories -> sharded engine params.
+
+The counterpart of the reference's model-loader staging + engine weight
+load (ref: components/model-loader/load.sh downloads; the engine container
+does the actual load). Here loading and sharding are one step: safetensors
+are memory-mapped, converted per-tensor, and device_put directly with
+their target NamedSharding so a tp=N mesh never materializes the full
+model on one chip.
+"""
+
+from __future__ import annotations
+
+import glob
+import json
+import os
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeai_tpu.engine.core import Engine, EngineConfig
+from kubeai_tpu.engine.tokenizer import load_tokenizer
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.parallel import llama_param_specs, make_mesh, shard_tree
+
+
+def load_state_dict(path: str) -> dict[str, np.ndarray]:
+    """Load all *.safetensors (or pytorch_model.bin) under *path* into a
+    name->array dict. Arrays are lazily materialized numpy views."""
+    sd: dict[str, np.ndarray] = {}
+    st_files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+    if st_files:
+        from safetensors import safe_open
+
+        for f in st_files:
+            with safe_open(f, framework="np") as reader:
+                for name in reader.keys():
+                    sd[name] = reader.get_tensor(name)
+        return sd
+    bin_files = sorted(glob.glob(os.path.join(path, "pytorch_model*.bin")))
+    if bin_files:
+        import torch
+
+        for f in bin_files:
+            for name, t in torch.load(f, map_location="cpu", weights_only=True).items():
+                sd[name] = t.float().numpy() if t.dtype == torch.bfloat16 else t.numpy()
+        return sd
+    raise FileNotFoundError(f"no safetensors or pytorch_model.bin under {path}")
+
+
+def pad_vocab(params, config: ModelConfig, multiple: int) -> tuple[dict, ModelConfig]:
+    """Pad embedding/lm_head vocab dim to a multiple (tp divisibility +
+    friendly MXU tiling). Padded columns carry zero weights (logit 0.0);
+    the engine masks logits beyond the tokenizer vocab to -inf before
+    sampling so they can never be emitted."""
+    V = config.vocab_size
+    target = ((V + multiple - 1) // multiple) * multiple
+    if target == V:
+        return params, config
+    pad = target - V
+    params = dict(params)
+    params["embed"] = jnp.pad(params["embed"], ((0, pad), (0, 0)))
+    if "lm_head" in params:
+        params["lm_head"] = jnp.pad(params["lm_head"], ((0, 0), (0, pad)))
+    return params, config.replace(vocab_size=target)
+
+
+def load_engine_from_path(
+    path: str,
+    engine_config: EngineConfig | None = None,
+    tp: int = 1,
+    dtype: str = "bfloat16",
+) -> Engine:
+    """Build an Engine from an HF-format checkpoint directory."""
+    config = ModelConfig.from_json_file(path).replace(dtype=dtype)
+    sd = load_state_dict(path)
+    if "lm_head.weight" not in sd and not config.tie_word_embeddings:
+        config = config.replace(tie_word_embeddings=True)
+    params = llama.params_from_hf(sd, config)
+    params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
+
+    ec = engine_config or EngineConfig()
+    tokenizer = load_tokenizer(path)
+
+    if tp > 1:
+        mesh = make_mesh(tp=tp)
+        params = shard_tree(params, llama_param_specs(config), mesh)
+        # Cache + step functions inherit shardings via XLA propagation from
+        # the params; the engine jits inside this mesh context.
+        with mesh:
+            return Engine(config, params, tokenizer, ec)
+    return Engine(config, params, tokenizer, ec)
+
+
+def save_hf_checkpoint(path: str, config: ModelConfig, state_dict: dict[str, np.ndarray], tokenizer_src: str | None = None):
+    """Write a minimal HF-format checkpoint dir (config.json + one
+    safetensors file). Used by tests and the model-loader."""
+    os.makedirs(path, exist_ok=True)
+    cfg = {
+        "architectures": ["LlamaForCausalLM"],
+        "model_type": "llama",
+        "vocab_size": config.vocab_size,
+        "hidden_size": config.hidden_size,
+        "intermediate_size": config.intermediate_size,
+        "num_hidden_layers": config.num_layers,
+        "num_attention_heads": config.num_heads,
+        "num_key_value_heads": config.num_kv_heads,
+        "rope_theta": config.rope_theta,
+        "rms_norm_eps": config.rms_norm_eps,
+        "max_position_embeddings": config.max_position,
+        "tie_word_embeddings": config.tie_word_embeddings,
+    }
+    with open(os.path.join(path, "config.json"), "w") as f:
+        json.dump(cfg, f, indent=1)
+    from safetensors.numpy import save_file
+
+    save_file(state_dict, os.path.join(path, "model.safetensors"))

@@ -1,0 +1,9 @@
+from kubeai_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    default_registry,
+)
+
+__all__ = ["Counter", "Gauge", "Histogram", "Registry", "default_registry"]

@@ -1,0 +1,200 @@
+"""Prometheus-style metrics registry (text exposition format).
+
+Fills the role of the reference's OTel instruments + Prometheus exporter
+(ref: internal/metrics/metrics.go:16-79, internal/manager/otel.go:97-115)
+without external dependencies. The gauge
+``kubeai_inference_requests_active{request_model=...}`` is THE autoscaling
+signal, scraped peer-to-peer by the autoscaler — same name and label as
+the reference so dashboards/scrapers port over.
+"""
+
+from __future__ import annotations
+
+import threading
+from bisect import bisect_left
+
+
+def _fmt_labels(labels: dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(
+        f'{k}="{v.replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
+        for k, v in sorted(labels.items())
+    )
+    return "{" + inner + "}"
+
+
+class _Metric:
+    kind = "untyped"
+
+    def __init__(self, name: str, help_: str = ""):
+        self.name = name
+        self.help = help_
+        self._lock = threading.Lock()
+        self._values: dict[tuple[tuple[str, str], ...], float] = {}
+
+    def _key(self, labels: dict[str, str] | None):
+        return tuple(sorted((labels or {}).items()))
+
+    def collect(self) -> list[str]:
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+            for key, val in sorted(self._values.items()):
+                lines.append(f"{self.name}{_fmt_labels(dict(key))} {val}")
+            return lines
+
+
+class Counter(_Metric):
+    kind = "counter"
+
+    def inc(self, amount: float = 1.0, labels: dict[str, str] | None = None):
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Gauge(_Metric):
+    kind = "gauge"
+
+    def set(self, value: float, labels: dict[str, str] | None = None):
+        with self._lock:
+            self._values[self._key(labels)] = value
+
+    def add(self, amount: float, labels: dict[str, str] | None = None):
+        key = self._key(labels)
+        with self._lock:
+            self._values[key] = self._values.get(key, 0.0) + amount
+
+    def value(self, labels: dict[str, str] | None = None) -> float:
+        with self._lock:
+            return self._values.get(self._key(labels), 0.0)
+
+
+class Histogram(_Metric):
+    kind = "histogram"
+
+    DEFAULT_BUCKETS = (0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10)
+
+    def __init__(self, name: str, help_: str = "", buckets=DEFAULT_BUCKETS):
+        super().__init__(name, help_)
+        self.buckets = sorted(buckets)
+        self._obs: dict[tuple, list] = {}  # key -> [bucket_counts, sum, count]
+
+    def observe(self, value: float, labels: dict[str, str] | None = None):
+        key = self._key(labels)
+        # First bucket whose upper bound is >= value ("le" semantics);
+        # len(buckets) is the +Inf slot.
+        idx = bisect_left(self.buckets, value)
+        with self._lock:
+            entry = self._obs.setdefault(key, [[0] * (len(self.buckets) + 1), 0.0, 0])
+            entry[0][idx] += 1
+            entry[1] += value
+            entry[2] += 1
+
+    def collect(self) -> list[str]:
+        with self._lock:
+            lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
+            for key, (counts, total, n) in sorted(self._obs.items()):
+                labels = dict(key)
+                cum = 0
+                for b, c in zip(self.buckets + [float("inf")], counts):
+                    cum += c
+                    lb = dict(labels)
+                    lb["le"] = "+Inf" if b == float("inf") else repr(b)
+                    lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {cum}")
+                lines.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
+                lines.append(f"{self.name}_count{_fmt_labels(labels)} {n}")
+            return lines
+
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics: dict[str, _Metric] = {}
+
+    def counter(self, name: str, help_: str = "") -> Counter:
+        return self._get_or_create(name, help_, Counter)
+
+    def gauge(self, name: str, help_: str = "") -> Gauge:
+        return self._get_or_create(name, help_, Gauge)
+
+    def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
+        return self._get_or_create(name, help_, Histogram, lambda: Histogram(name, help_, buckets))
+
+    def _get_or_create(self, name, help_, cls, factory=None):
+        with self._lock:
+            m = self._metrics.get(name)
+            if m is None:
+                m = factory() if factory else cls(name, help_)
+                self._metrics[name] = m
+            elif not isinstance(m, cls):
+                raise TypeError(f"metric {name} already registered as {type(m).__name__}")
+            return m
+
+    def render(self) -> str:
+        with self._lock:
+            metrics = list(self._metrics.values())
+        lines: list[str] = []
+        for m in metrics:
+            lines.extend(m.collect())
+        return "\n".join(lines) + "\n"
+
+
+default_registry = Registry()
+
+# The autoscaling signal (name parity with the reference).
+ACTIVE_REQUESTS = "kubeai_inference_requests_active"
+
+
+def parse_prometheus_text(text: str) -> dict[str, list[tuple[dict[str, str], float]]]:
+    """Minimal Prometheus text parser: name -> [(labels, value)].
+    Counterpart of the reference autoscaler's expfmt scrape parsing
+    (ref: internal/modelautoscaler/metrics.go:36-71)."""
+    out: dict[str, list[tuple[dict[str, str], float]]] = {}
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        try:
+            if "{" in line:
+                name, rest = line.split("{", 1)
+                labelstr, valstr = rest.rsplit("}", 1)
+                labels = {}
+                for part in _split_labels(labelstr):
+                    if not part:
+                        continue
+                    k, v = part.split("=", 1)
+                    labels[k] = v.strip('"').replace('\\"', '"').replace("\\\\", "\\")
+                out.setdefault(name.strip(), []).append((labels, float(valstr)))
+            else:
+                name, valstr = line.rsplit(None, 1)
+                out.setdefault(name.strip(), []).append(({}, float(valstr)))
+        except ValueError:
+            continue
+    return out
+
+
+def _split_labels(s: str) -> list[str]:
+    parts, cur, in_q, esc = [], [], False, False
+    for ch in s:
+        if esc:
+            cur.append(ch)
+            esc = False
+        elif ch == "\\":
+            cur.append(ch)
+            esc = True
+        elif ch == '"':
+            cur.append(ch)
+            in_q = not in_q
+        elif ch == "," and not in_q:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    if cur:
+        parts.append("".join(cur))
+    return parts

@@ -40,13 +40,34 @@ class ModelConfig:
         get = lambda k, d=None: getattr(config, k, d)
         scaling = None
         rs = get("rope_scaling")
-        if isinstance(rs, dict) and rs.get("rope_type", rs.get("type")) == "llama3":
-            scaling = RopeScaling(
-                factor=rs.get("factor", 8.0),
-                low_freq_factor=rs.get("low_freq_factor", 1.0),
-                high_freq_factor=rs.get("high_freq_factor", 4.0),
-                original_max_position=rs.get("original_max_position_embeddings", 8192),
-            )
+        if isinstance(rs, dict):
+            rope_type = rs.get("rope_type", rs.get("type"))
+            if rope_type == "llama3":
+                scaling = RopeScaling(
+                    factor=rs.get("factor", 8.0),
+                    low_freq_factor=rs.get("low_freq_factor", 1.0),
+                    high_freq_factor=rs.get("high_freq_factor", 4.0),
+                    original_max_position=rs.get("original_max_position_embeddings", 8192),
+                )
+            elif rope_type in ("default", None):
+                pass
+            elif rope_type == "linear":
+                # Linear scaling divides every band by factor; expressed as
+                # llama3-style scaling with the "low frequency" (always
+                # scaled) band covering the whole spectrum: low_freq_factor
+                # huge makes low_wavelen ~0 so wavelen > low_wavelen for all
+                # bands.
+                scaling = RopeScaling(
+                    factor=rs.get("factor", 1.0),
+                    low_freq_factor=1e9,
+                    high_freq_factor=2e9,
+                    original_max_position=get("max_position_embeddings", 8192),
+                )
+            else:
+                raise ValueError(
+                    f"unsupported rope_scaling type {rope_type!r}; "
+                    "supported: llama3, linear"
+                )
         return cls(
             vocab_size=config.vocab_size,
             hidden_size=config.hidden_size,

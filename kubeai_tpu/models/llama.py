@@ -132,13 +132,16 @@ def apply(
     positions: jnp.ndarray,  # [B, S] int32 absolute positions
     cache: Params | None = None,
     logits_idx: jnp.ndarray | None = None,  # [B] gather one query index before lm_head
+    cache_rows: jnp.ndarray | None = None,  # [B] cache row per batch row
 ):
     """Run the decoder. Returns (logits, new_cache).
 
-    With a cache: new K/V are scattered into cache[:, b, positions[b, s]]
-    and attention spans the whole cache, masked to keys <= query position.
-    Without a cache (training / one-shot scoring): attention is causal over
-    the S new tokens only.
+    With a cache: new K/V are scattered into cache[:, row, positions[b, s]]
+    and attention spans the whole cache row, masked to keys <= query
+    position. *cache_rows* maps batch rows onto cache rows (continuous
+    batching prefills a single sequence into an arbitrary slot of the big
+    decode cache); default is row b = batch b. Without a cache (training /
+    one-shot scoring): attention is causal over the S new tokens only.
 
     logits shape: [B, S, V], or [B, 1, V] if logits_idx is given.
     """
@@ -156,6 +159,7 @@ def apply(
     mask = key_positions <= positions[:, :, None]  # [B, S, Skv]
 
     batch_idx = jnp.arange(B)[:, None]
+    rows = batch_idx if cache_rows is None else cache_rows[:, None]
 
     def layer(x, w, k_cache_l, v_cache_l):
         attn_in = rms_norm(x, w["ln1"], config.rms_norm_eps)
@@ -165,12 +169,17 @@ def apply(
         q, k = apply_rope(q, k, positions, inv_freq)
 
         if k_cache_l is not None:
-            k_full = k_cache_l.at[batch_idx, positions].set(k)
-            v_full = v_cache_l.at[batch_idx, positions].set(v)
+            k_full = k_cache_l.at[rows, positions].set(k)
+            v_full = v_cache_l.at[rows, positions].set(v)
+            if cache_rows is None:
+                k_att, v_att = k_full, v_full
+            else:
+                k_att, v_att = k_full[cache_rows], v_full[cache_rows]
         else:
             k_full, v_full = k, v
+            k_att, v_att = k, v
 
-        attn_out = attention(q, k_full, v_full, mask)
+        attn_out = attention(q, k_att, v_att, mask)
         x = x + attn_out.reshape(B, S, H * h) @ w["wo"]
 
         mlp_in = rms_norm(x, w["ln2"], config.rms_norm_eps)
@@ -214,6 +223,22 @@ def prefill(params, config, tokens, cache, lengths=None):
         lengths = jnp.full((B,), S, jnp.int32)
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     return apply(params, config, tokens, pos, cache, logits_idx=lengths - 1)
+
+
+def prefill_into(params, config, tokens, cache, slot, length):
+    """Prefill one sequence [1, S] directly into cache row *slot* (traced
+    int32 scalar). Returns (last_token_logits [1, 1, V], cache)."""
+    _, S = tokens.shape
+    pos = jnp.arange(S, dtype=jnp.int32)[None, :]
+    return apply(
+        params,
+        config,
+        tokens,
+        pos,
+        cache,
+        logits_idx=length[None] - 1 if length.ndim == 0 else length - 1,
+        cache_rows=jnp.reshape(slot, (1,)).astype(jnp.int32),
+    )
 
 
 def decode_step(params, config, tokens, cache, lengths):

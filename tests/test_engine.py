@@ -1,0 +1,217 @@
+"""Engine + OpenAI server tests: continuous batching over HTTP on CPU."""
+
+import json
+import threading
+import urllib.request
+
+import pytest
+
+from kubeai_tpu.engine.core import build_test_engine
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.engine.server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = build_test_engine()
+    srv = EngineServer(eng, "test-model", host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def post(srv, path, body, headers=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST",
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def get(srv, path):
+    with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}{path}", timeout=30) as resp:
+        return resp.status, resp.read().decode()
+
+
+class TestEngineCore:
+    def test_generate_greedy_deterministic(self, server):
+        eng = server.engine
+        p = SamplingParams(temperature=0.0, max_tokens=8)
+        ids1, _, fin = eng.generate(eng.tokenizer.encode("abc"), p)
+        ids2, _, _ = eng.generate(eng.tokenizer.encode("abc"), p)
+        assert ids1 == ids2
+        assert fin.completion_tokens <= 8
+
+    def test_seeded_sampling_reproducible(self, server):
+        eng = server.engine
+        p = SamplingParams(temperature=1.0, max_tokens=8, seed=7)
+        ids1, _, _ = eng.generate(eng.tokenizer.encode("xyz"), p)
+        ids2, _, _ = eng.generate(eng.tokenizer.encode("xyz"), p)
+        assert ids1 == ids2
+
+    def test_concurrent_requests_exceed_slots(self, server):
+        eng = server.engine
+        results = {}
+
+        def run(i):
+            results[i] = eng.generate(
+                eng.tokenizer.encode(f"req {i}"),
+                SamplingParams(temperature=0.5, max_tokens=6, seed=i),
+            )
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(9)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert len(results) == 9
+        for ids, text, fin in results.values():
+            assert fin.completion_tokens >= 1
+
+    def test_prompt_too_long_rejected(self, server):
+        eng = server.engine
+        with pytest.raises(ValueError):
+            eng.submit([1] * 10_000, SamplingParams())
+
+    def test_batched_matches_solo_greedy(self, server):
+        """Continuous batching must not change greedy results."""
+        eng = server.engine
+        p = SamplingParams(temperature=0.0, max_tokens=6)
+        solo = eng.generate(eng.tokenizer.encode("interference"), p)[0]
+
+        results = {}
+
+        def run(i):
+            if i == 0:
+                results[0] = eng.generate(eng.tokenizer.encode("interference"), p)[0]
+            else:
+                eng.generate(
+                    eng.tokenizer.encode(f"noise {i}"),
+                    SamplingParams(temperature=0.9, max_tokens=6, seed=i),
+                )
+
+        threads = [threading.Thread(target=run, args=(i,)) for i in range(4)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=120)
+        assert results[0] == solo
+
+
+class TestHTTP:
+    def test_health_and_models(self, server):
+        status, body = get(server, "/health")
+        assert status == 200 and json.loads(body)["status"] == "ok"
+        status, body = get(server, "/v1/models")
+        data = json.loads(body)
+        assert data["data"][0]["id"] == "test-model"
+
+    def test_completions(self, server):
+        status, body = post(
+            server,
+            "/v1/completions",
+            {"model": "test-model", "prompt": "hello", "max_tokens": 5, "temperature": 0},
+        )
+        assert status == 200
+        assert body["object"] == "text_completion"
+        assert body["usage"]["completion_tokens"] >= 1
+        assert body["choices"][0]["finish_reason"] in ("stop", "length")
+
+    def test_chat_completions(self, server):
+        status, body = post(
+            server,
+            "/v1/chat/completions",
+            {
+                "model": "test-model",
+                "messages": [{"role": "user", "content": "hi"}],
+                "max_tokens": 5,
+                "temperature": 0,
+            },
+        )
+        assert status == 200
+        assert body["choices"][0]["message"]["role"] == "assistant"
+
+    def test_streaming(self, server):
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{server.port}/v1/chat/completions",
+            data=json.dumps(
+                {
+                    "model": "test-model",
+                    "messages": [{"role": "user", "content": "stream me"}],
+                    "max_tokens": 5,
+                    "temperature": 0,
+                    "stream": True,
+                }
+            ).encode(),
+            headers={"Content-Type": "application/json"},
+            method="POST",
+        )
+        events = []
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            assert resp.headers["Content-Type"].startswith("text/event-stream")
+            for line in resp:
+                line = line.decode().strip()
+                if line.startswith("data: "):
+                    events.append(line[6:])
+        assert events[-1] == "[DONE]"
+        parsed = [json.loads(e) for e in events[:-1]]
+        assert parsed[0]["choices"][0]["delta"]["role"] == "assistant"
+        finals = [p for p in parsed if p["choices"][0].get("finish_reason")]
+        assert finals and "usage" in finals[-1]
+
+    def test_validation_errors(self, server):
+        status, body = post(server, "/v1/completions", {"model": "m"})
+        assert status == 400
+        status, body = post(server, "/v1/chat/completions", {"model": "m", "messages": []})
+        assert status == 400
+        status, body = post(server, "/v1/completions", {"prompt": "x" * 100_000})
+        assert status == 400
+
+    def test_metrics_exposition(self, server):
+        post(server, "/v1/completions", {"prompt": "metrics", "max_tokens": 2})
+        status, text = get(server, "/metrics")
+        assert status == 200
+        assert "kubeai_engine_generated_tokens_total" in text
+        assert "kubeai_engine_active_slots" in text
+
+    def test_adapter_endpoints(self, server):
+        status, body = post(
+            server, "/v1/load_lora_adapter", {"lora_name": "ad1", "lora_path": "/tmp/x"}
+        )
+        assert status == 200
+        status, body = get(server, "/v1/models")
+        ids = [m["id"] for m in json.loads(body)["data"]]
+        assert "ad1" in ids
+        status, body = post(server, "/v1/unload_lora_adapter", {"lora_name": "ad1"})
+        assert status == 200
+        # Idempotent unload.
+        status, body = post(server, "/v1/unload_lora_adapter", {"lora_name": "ad1"})
+        assert status == 200
+
+    def test_stop_string(self, server):
+        # Greedy output is deterministic; run once to learn the text, then
+        # use a substring of it as a stop sequence.
+        status, full = post(
+            server,
+            "/v1/completions",
+            {"prompt": "stopdemo", "max_tokens": 8, "temperature": 0},
+        )
+        text = full["choices"][0]["text"]
+        if len(text) >= 3:
+            stop = text[1:3]
+            status, body = post(
+                server,
+                "/v1/completions",
+                {"prompt": "stopdemo", "max_tokens": 8, "temperature": 0, "stop": stop},
+            )
+            assert status == 200
+            out = body["choices"][0]["text"]
+            assert stop not in out
+            assert out == text.split(stop)[0]
+            assert body["choices"][0]["finish_reason"] == "stop"

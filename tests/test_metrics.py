@@ -1,0 +1,53 @@
+from kubeai_tpu.metrics.registry import (
+    Counter,
+    Gauge,
+    Histogram,
+    Registry,
+    parse_prometheus_text,
+)
+
+
+def test_counter_and_gauge_render_and_parse():
+    reg = Registry()
+    c = reg.counter("requests_total", "total requests")
+    g = reg.gauge("kubeai_inference_requests_active", "active")
+    c.inc(labels={"model": "m1"})
+    c.inc(2, labels={"model": "m1"})
+    g.set(5, labels={"request_model": "m1"})
+    g.add(-2, labels={"request_model": "m1"})
+    text = reg.render()
+    parsed = parse_prometheus_text(text)
+    assert parsed["requests_total"] == [({"model": "m1"}, 3.0)]
+    assert parsed["kubeai_inference_requests_active"] == [({"request_model": "m1"}, 3.0)]
+
+
+def test_histogram_buckets():
+    reg = Registry()
+    h = reg.histogram("lat", buckets=(0.1, 1.0))
+    for v in [0.05, 0.5, 5.0]:
+        h.observe(v)
+    text = reg.render()
+    parsed = parse_prometheus_text(text)
+    buckets = {e[0]["le"]: e[1] for e in parsed["lat_bucket"]}
+    assert buckets["0.1"] == 1.0
+    assert buckets["1.0"] == 2.0
+    assert buckets["+Inf"] == 3.0
+    assert parsed["lat_count"][0][1] == 3.0
+
+
+def test_label_escaping_roundtrip():
+    reg = Registry()
+    g = reg.gauge("g")
+    g.set(1, labels={"path": 'a"b\\c'})
+    parsed = parse_prometheus_text(reg.render())
+    assert parsed["g"][0][0]["path"] == 'a"b\\c'
+
+
+def test_type_conflict_raises():
+    reg = Registry()
+    reg.counter("x")
+    try:
+        reg.gauge("x")
+        assert False
+    except TypeError:
+        pass

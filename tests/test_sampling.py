@@ -1,0 +1,73 @@
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from kubeai_tpu.engine.sampling import sample
+
+
+def _keys(n, seed=0):
+    return jax.random.split(jax.random.key(seed), n)
+
+
+def test_greedy_when_temperature_zero():
+    logits = jnp.asarray(np.random.default_rng(0).normal(size=(4, 100)), jnp.float32)
+    toks = sample(
+        logits,
+        _keys(4),
+        temperature=jnp.zeros(4),
+        top_p=jnp.ones(4),
+        top_k=jnp.zeros(4, jnp.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_top_k_one_is_greedy():
+    logits = jnp.asarray(np.random.default_rng(1).normal(size=(4, 50)), jnp.float32)
+    toks = sample(
+        logits,
+        _keys(4, 1),
+        temperature=jnp.ones(4),
+        top_p=jnp.ones(4),
+        top_k=jnp.ones(4, jnp.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_top_p_tiny_is_greedy():
+    logits = jnp.asarray(np.random.default_rng(2).normal(size=(4, 50)), jnp.float32)
+    toks = sample(
+        logits,
+        _keys(4, 2),
+        temperature=jnp.ones(4),
+        top_p=jnp.full(4, 1e-6),
+        top_k=jnp.zeros(4, jnp.int32),
+    )
+    np.testing.assert_array_equal(np.asarray(toks), np.asarray(jnp.argmax(logits, -1)))
+
+
+def test_samples_respect_top_k():
+    # Distribution with 3 dominant tokens; top_k=3 must never sample others.
+    base = np.full((1, 64), -20.0, np.float32)
+    base[0, [5, 9, 30]] = [2.0, 1.5, 1.0]
+    logits = jnp.asarray(np.repeat(base, 16, 0))
+    toks = sample(
+        logits,
+        _keys(16, 3),
+        temperature=jnp.ones(16) * 2.0,
+        top_p=jnp.ones(16),
+        top_k=jnp.full(16, 3, jnp.int32),
+    )
+    assert set(np.asarray(toks).tolist()) <= {5, 9, 30}
+
+
+def test_mixed_slots_independent():
+    # Slot 0 greedy, slot 1 stochastic — greedy slot must be exact argmax.
+    logits = jnp.asarray(np.random.default_rng(4).normal(size=(2, 40)), jnp.float32)
+    toks = sample(
+        logits,
+        _keys(2, 4),
+        temperature=jnp.asarray([0.0, 1.5]),
+        top_p=jnp.ones(2),
+        top_k=jnp.zeros(2, jnp.int32),
+    )
+    assert int(toks[0]) == int(jnp.argmax(logits[0]))

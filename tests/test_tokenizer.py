@@ -1,0 +1,56 @@
+"""Incremental detokenizer + tokenizer tests (incl. review regressions)."""
+
+from kubeai_tpu.engine.tokenizer import ByteTokenizer, IncrementalDetokenizer
+
+
+def test_byte_roundtrip():
+    tok = ByteTokenizer()
+    ids = tok.encode("héllo wörld", add_bos=False)
+    assert tok.decode(ids) == "héllo wörld"
+
+
+def test_incremental_holds_back_split_utf8():
+    """A multi-byte char split across pushes must be delivered whole, not
+    as replacement chars (review regression)."""
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    b = "é".encode("utf-8")  # 2 bytes
+    assert detok.push(b[0]) == ""  # incomplete: held back
+    assert detok.push(b[1]) == "é"
+    assert detok.text() == "é"
+
+
+def test_incremental_streams_ascii_immediately():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    out = "".join(detok.push(i) for i in tok.encode("abc", add_bos=False))
+    assert out == "abc"
+
+
+def test_incremental_permanent_invalid_byte():
+    """A genuinely invalid byte becomes a replacement char once a
+    subsequent valid char confirms it's not a prefix."""
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    assert detok.push(0xC3) == ""  # looks like a 2-byte prefix
+    out = detok.push(ord("x"))  # 0xC3 followed by 'x' is invalid
+    assert out == "�x"
+    assert detok.text() == "�x"
+
+
+def test_incremental_trailing_incomplete_in_text():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    detok.push(ord("a"))
+    detok.push(0xC3)  # dangling prefix
+    assert detok.text() == "a�"
+
+
+def test_incremental_matches_full_decode_long():
+    tok = ByteTokenizer()
+    detok = IncrementalDetokenizer(tok)
+    s = "日本語 text with mixed ünïcödé and ascii" * 3
+    ids = tok.encode(s, add_bos=False)
+    streamed = "".join(detok.push(i) for i in ids)
+    assert streamed == s
+    assert detok.text() == s
