@@ -1,0 +1,133 @@
+"""Headline benchmark — engine serving throughput on one TPU chip.
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+Measures steady-state output token throughput of the continuous-batching
+engine on a 1.3B-class Llama (bf16, random weights — tokens/s does not
+depend on weight values) under realistic concurrency. vs_baseline anchors
+against the only single-accelerator output-throughput number the
+reference publishes: 285.25 output tok/s (vLLM, Llama-3.2-11B on 1x L4;
+ref: docs/benchmarks/llama-3.2-11b-vision.md:12-30 / BASELINE.md). The
+model classes differ (1.3B vs 11B) so treat the ratio as an anchor, not
+an apples-to-apples comparison; later rounds add the 8B-class metric
+from BASELINE.json once quantized weights fit a single v5e chip.
+
+Usage: python bench.py [--tiny] [--json-only]
+"""
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
+
+REFERENCE_SINGLE_ACCEL_TOKS = 285.25
+
+
+def build_engine(tiny: bool):
+    import jax
+
+    from kubeai_tpu.engine.core import Engine, EngineConfig
+    from kubeai_tpu.engine.tokenizer import ByteTokenizer
+    from kubeai_tpu.models import llama
+    from kubeai_tpu.models.base import ModelConfig
+
+    if tiny:
+        mc = ModelConfig(
+            vocab_size=512, hidden_size=128, intermediate_size=256,
+            num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
+        )
+        ec = EngineConfig(max_slots=4, max_seq_len=256, prefill_buckets=(32, 64, 128))
+    else:
+        # 1.3B-class Llama in bf16.
+        mc = ModelConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=16, num_heads=16, num_kv_heads=8, dtype="bfloat16",
+        )
+        ec = EngineConfig(
+            max_slots=32, max_seq_len=1024, prefill_buckets=(128, 256, 512),
+            decode_chunk=16,
+        )
+    params = llama.init_params(mc, jax.random.key(0))
+    return Engine(mc, params, ByteTokenizer(), ec)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--tiny", action="store_true", help="CPU smoke mode")
+    parser.add_argument("--requests", type=int, default=None)
+    parser.add_argument("--max-tokens", type=int, default=None)
+    args = parser.parse_args()
+
+    import threading
+
+    import numpy as np
+
+    from kubeai_tpu.engine.sampling import SamplingParams
+
+    n_requests = args.requests or (8 if args.tiny else 64)
+    max_tokens = args.max_tokens or (8 if args.tiny else 128)
+    prompt_len = 16 if args.tiny else 128
+
+    eng = build_engine(args.tiny)
+    eng.start()
+
+    rng = np.random.default_rng(0)
+    prompts = [rng.integers(1, 200, prompt_len).tolist() for _ in range(n_requests)]
+    sp = SamplingParams(temperature=0.7, top_p=0.95, max_tokens=max_tokens, seed=1)
+
+    # Warmup: trigger prefill+decode compilation outside the timed window.
+    eng.generate(prompts[0], SamplingParams(temperature=0.0, max_tokens=4))
+
+    results = [None] * n_requests
+    ttfts = [None] * n_requests
+
+    def run(i):
+        req = eng.submit(prompts[i], sp)
+        t_submit = time.monotonic()
+        n_toks = 0
+        while True:
+            ev = req.out.get(timeout=600)
+            if ev[0] == "token":
+                if n_toks == 0:
+                    ttfts[i] = time.monotonic() - t_submit
+                if ev[1] >= 0:
+                    n_toks += 1
+            elif ev[0] == "done":
+                results[i] = ev[1]
+                return
+            else:
+                raise RuntimeError(ev[1])
+
+    threads = [threading.Thread(target=run, args=(i,)) for i in range(n_requests)]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+    eng.stop()
+
+    total_out = sum(r.completion_tokens for r in results)
+    toks_per_sec = total_out / elapsed
+    p50_ttft = sorted(t for t in ttfts if t is not None)[len(ttfts) // 2]
+
+    summary = {
+        "metric": "engine_output_tokens_per_sec_per_chip",
+        "value": round(toks_per_sec, 2),
+        "unit": "tok/s",
+        "vs_baseline": round(toks_per_sec / REFERENCE_SINGLE_ACCEL_TOKS, 3),
+    }
+    print(json.dumps(summary))
+    print(
+        f"# {n_requests} reqs x {max_tokens} max_tokens, prompt={prompt_len}, "
+        f"elapsed={elapsed:.1f}s, p50_ttft={p50_ttft*1000:.0f}ms, "
+        f"total_output_tokens={total_out}",
+        file=sys.stderr,
+    )
+
+
+if __name__ == "__main__":
+    main()

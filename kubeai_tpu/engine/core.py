@@ -51,6 +51,11 @@ class EngineConfig:
     # Cap on new tokens per request (request max_tokens is clamped to fit
     # the slot: prompt_len + max_tokens <= max_seq_len).
     default_max_tokens: int = 256
+    # Decode steps fused into one jitted lax.scan call: host<->device
+    # round-trips (expensive over remote-attached TPU) are amortized K x at
+    # the cost of up to K-1 wasted steps per finished sequence and
+    # admission latency quantized to one chunk.
+    decode_chunk: int = 8
 
 
 @dataclass
@@ -167,18 +172,30 @@ class Engine:
             )[0]
             return tok, cache
 
+        K = self.cfg.decode_chunk
+
         def decode_fn(params, cache, lengths, last_tokens, keys, active, temp, top_p, top_k):
-            logits, cache = llama.decode_step(params, mc, last_tokens[:, None], cache, lengths)
-            step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-            toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k)
-            new_lengths = jnp.where(active, lengths + 1, lengths)
-            return toks, cache, new_lengths, step_keys[:, 1]
+            """K fused decode+sample steps; returns token ids [K, B]."""
+
+            def body(carry, _):
+                cache, lengths, last, keys = carry
+                logits, cache = llama.decode_step(params, mc, last[:, None], cache, lengths)
+                step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k)
+                toks = jnp.where(active, toks, last)
+                lengths = jnp.where(active, lengths + 1, lengths)
+                return (cache, lengths, toks, step_keys[:, 1]), toks
+
+            (cache, lengths, last, keys), toks_seq = jax.lax.scan(
+                body, (cache, lengths, last_tokens, keys), None, length=K
+            )
+            return toks_seq, cache, lengths, last, keys
 
         if apply_fns is not None:  # test seam
             self._prefill_jit, self._decode_jit = apply_fns(prefill_fn, decode_fn)
         else:
             self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(8,))
-            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1,))
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2, 3, 4))
 
     # -- public API --------------------------------------------------------
 
@@ -233,22 +250,30 @@ class Engine:
     # -- scheduler loop ----------------------------------------------------
 
     def _loop(self):
+        """Pipelined scheduler: dispatch decode chunk N+1 before processing
+        chunk N's tokens, hiding the host<->device round-trip behind device
+        compute. Admissions chain onto the latest dispatched state; a chunk
+        dispatched while a slot was still running an earlier request is
+        reconciled via the per-dispatch slot snapshot."""
         log.info("engine loop started (slots=%d)", self.cfg.max_slots)
+        pending = None  # (toks_device_ref, [(slot_idx, _Slot), ...])
         while self._running:
             try:
                 admitted = self._admit_waiting()
-                if self._n_active == 0:
-                    if not admitted:
-                        self._wake.wait(timeout=0.05)
-                        self._wake.clear()
-                    continue
-                self._decode_once()
+                dispatched = self._dispatch_chunk() if self._n_active > 0 else None
+                if pending is not None:
+                    self._process_chunk(*pending)
+                pending = dispatched
+                if pending is None and not admitted and self._n_active == 0:
+                    self._wake.wait(timeout=0.05)
+                    self._wake.clear()
             except Exception:
                 # A failed jitted step may have consumed donated buffers —
                 # the device state is unusable. Fail all in-flight requests
                 # and rebuild (elastic recovery; the pod stays alive).
                 log.exception("engine step failed; resetting device state")
                 self._recover()
+                pending = None
 
     def _recover(self):
         for i, slot in enumerate(self._slots):
@@ -261,7 +286,7 @@ class Engine:
         self._init_device_state()
 
     def _admit_waiting(self) -> bool:
-        admitted = False
+        admitted: list[tuple[int, Any]] = []  # (slot_idx, first_token_ref)
         while self._n_active < self.cfg.max_slots:
             try:
                 req = self._queue.get_nowait()
@@ -272,8 +297,8 @@ class Engine:
                 continue
             slot_idx = self._slots.index(None)
             try:
-                self._prefill(slot_idx, req)
-                admitted = True
+                tok_ref = self._prefill(slot_idx, req)
+                admitted.append((slot_idx, tok_ref))
             except Exception as e:  # surface engine errors to the client
                 log.exception("prefill failed")
                 req.out.put(("error", f"prefill failed: {e}"))
@@ -283,7 +308,13 @@ class Engine:
                 kbuf = self._cache["k"]
                 if getattr(kbuf, "is_deleted", lambda: False)():
                     raise
-        return admitted
+        if admitted:
+            # One host sync for all first tokens of this admission batch.
+            toks = jax.device_get([t for _, t in admitted])
+            for (slot_idx, _), tok in zip(admitted, toks):
+                if self._slots[slot_idx] is not None:
+                    self._emit_token(slot_idx, int(tok))
+        return bool(admitted)
 
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -313,7 +344,6 @@ class Engine:
             jnp.int32(sp.top_k),
             self._cache,
         )
-        first_id = int(tok)
 
         budget = min(
             sp.max_tokens or self.cfg.default_max_tokens,
@@ -332,19 +362,21 @@ class Engine:
         self.m_ttft.observe(time.monotonic() - req.arrival)
 
         # Register slot in device state: position of the first generated
-        # token is prompt_len; decode will write it there.
+        # token is prompt_len; decode will write it there. The first token
+        # stays a device ref — the caller batches the host sync.
         self._lengths = self._lengths.at[slot_idx].set(len(ids))
-        self._last_tokens = self._last_tokens.at[slot_idx].set(first_id)
+        self._last_tokens = self._last_tokens.at[slot_idx].set(tok)
         self._active = self._active.at[slot_idx].set(True)
         self._keys = self._keys.at[slot_idx].set(jax.random.fold_in(key, 1))
         self._temp = self._temp.at[slot_idx].set(sp.temperature)
         self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
         self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+        return tok
 
-        self._emit_token(slot_idx, first_id)
-
-    def _decode_once(self):
-        toks, self._cache, self._lengths, self._keys = self._decode_jit(
+    def _dispatch_chunk(self):
+        """Dispatch one decode chunk (async) and snapshot which request
+        occupied each slot at dispatch time."""
+        toks_seq, self._cache, self._lengths, self._last_tokens, self._keys = self._decode_jit(
             self.params,
             self._cache,
             self._lengths,
@@ -355,11 +387,18 @@ class Engine:
             self._top_p,
             self._top_k,
         )
-        self._last_tokens = toks
-        tok_host = np.asarray(jax.device_get(toks))
-        for i, slot in enumerate(self._slots):
-            if slot is not None:
-                self._emit_token(i, int(tok_host[i]))
+        snapshot = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+        return toks_seq, snapshot
+
+    def _process_chunk(self, toks_seq, snapshot):
+        tok_host = np.asarray(jax.device_get(toks_seq))  # [K, B]
+        for k in range(tok_host.shape[0]):
+            for i, slot_obj in snapshot:
+                # Emit only while the slot still belongs to the request it
+                # held at dispatch time (it may finish mid-chunk, or have
+                # been freed and re-admitted since dispatch).
+                if self._slots[i] is slot_obj:
+                    self._emit_token(i, int(tok_host[k, i]))
 
     def _emit_token(self, slot_idx: int, token_id: int):
         """Deliver one generated token to the request; apply stop logic."""

@@ -1,0 +1,74 @@
+"""Mesh-sharded training step (fine-tuning / LoRA support path).
+
+The reference is inference-only, but a TPU-native framework serving LoRA
+adapters (ref: proposals/lora-adapters.md, internal/modelcontroller/
+adapters.go) needs a way to produce them; this module provides the
+sharded next-token training step used by the fine-tune entrypoint and by
+the driver's multi-chip dryrun. Shardings: params fsdp(dp)+tp, batch over
+dp, sequence over sp; optax adamw states inherit param shardings.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.parallel.sharding import llama_param_specs, shard_tree
+
+
+def loss_fn(params, config: ModelConfig, tokens, targets, mask):
+    """Mean next-token cross-entropy over mask=1 positions.
+    tokens/targets/mask: [B, S] (targets already shifted by caller)."""
+    B, S = tokens.shape
+    positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    positions = jax.lax.with_sharding_constraint(positions, P("dp", "sp"))
+    logits, _ = llama.apply(params, config, tokens, positions)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
+    mask = mask.astype(jnp.float32)
+    return -(ll * mask).sum() / jnp.maximum(mask.sum(), 1.0)
+
+
+def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.0):
+    return optax.adamw(lr, weight_decay=weight_decay)
+
+
+def train_step(params, opt_state, batch, config: ModelConfig, optimizer):
+    """One SGD step. batch = {"tokens", "targets", "mask"} each [B, S].
+    Returns (loss, params, opt_state). Pure function — jit it with donated
+    params/opt_state under the target mesh."""
+    loss, grads = jax.value_and_grad(loss_fn)(
+        params, config, batch["tokens"], batch["targets"], batch["mask"]
+    )
+    updates, opt_state = optimizer.update(grads, opt_state, params)
+    params = optax.apply_updates(params, updates)
+    return loss, params, opt_state
+
+
+def init_sharded_training(config: ModelConfig, mesh, seed: int = 0, lr: float = 1e-4):
+    """Init params + optimizer state sharded over *mesh* (fsdp over dp,
+    megatron tp). Returns (params, opt_state, optimizer, jitted_step)."""
+    optimizer = make_optimizer(lr)
+    specs = llama_param_specs(config, fsdp=True)
+
+    params = llama.init_params(config, jax.random.key(seed), dtype=jnp.float32)
+    params = shard_tree(params, specs, mesh)
+    with mesh:
+        opt_state = jax.jit(optimizer.init)(params)
+
+    data_sharding = NamedSharding(mesh, P("dp", "sp"))
+
+    @partial(jax.jit, donate_argnums=(0, 1))
+    def step(params, opt_state, batch):
+        batch = {
+            k: jax.lax.with_sharding_constraint(v, P("dp", "sp")) for k, v in batch.items()
+        }
+        return train_step(params, opt_state, batch, config, optimizer)
+
+    return params, opt_state, optimizer, step, data_sharding
