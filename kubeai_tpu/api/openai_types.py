@@ -1,0 +1,101 @@
+"""Typed OpenAI API request surface with unknown-field preservation.
+
+The reference achieves engine-arg passthrough by decoding into typed Go
+structs that stash unrecognized JSON (`Unknown jsontext.Value ",unknown"`,
+ref: api/openai/v1/chat_completions.go:513-514). The idiomatic Python
+equivalent: requests stay as the parsed dict (so every field round-trips
+byte-for-byte up to JSON re-encoding) behind typed accessor wrappers that
+implement the GetModel/SetModel/Prefix interface
+(ref: api/openai/v1 model interfaces, apiutils/request.go:207-225).
+"""
+
+from __future__ import annotations
+
+import json
+from typing import Any
+
+
+class _Body:
+    """Base wrapper: the raw dict is the source of truth."""
+
+    def __init__(self, data: dict[str, Any]):
+        if not isinstance(data, dict):
+            raise ValueError("request body must be a JSON object")
+        self.data = data
+
+    def get_model(self) -> str:
+        return str(self.data.get("model", ""))
+
+    def set_model(self, model: str) -> None:
+        self.data["model"] = model
+
+    def prefix(self, n: int) -> str:
+        return ""
+
+    @property
+    def stream(self) -> bool:
+        return bool(self.data.get("stream"))
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(self.data).encode()
+
+
+class ChatCompletionRequest(_Body):
+    @property
+    def messages(self) -> list[dict]:
+        return self.data.get("messages") or []
+
+    def prefix(self, n: int) -> str:
+        """First user message's text, first n chars
+        (ref: chat_completions.go:525-543)."""
+        for msg in self.messages:
+            if msg.get("role") == "user":
+                content = msg.get("content")
+                if isinstance(content, str):
+                    return content[:n]
+                if isinstance(content, list):  # content parts
+                    for part in content:
+                        if isinstance(part, dict) and part.get("type") == "text":
+                            return str(part.get("text", ""))[:n]
+                return ""
+        return ""
+
+
+class CompletionRequest(_Body):
+    def prefix(self, n: int) -> str:
+        prompt = self.data.get("prompt")
+        if isinstance(prompt, str):
+            return prompt[:n]
+        if isinstance(prompt, list) and prompt and isinstance(prompt[0], str):
+            return prompt[0][:n]
+        return ""
+
+
+class EmbeddingRequest(_Body):
+    pass
+
+
+class RerankRequest(_Body):
+    pass
+
+
+class TranscriptionRequest(_Body):
+    """Multipart transcription requests carry the model as a form field;
+    apiutils strips it before proxying (ref: apiutils/request.go:109-165)."""
+
+
+# Path suffix -> wrapper type (ref: apiutils/request.go:167-205).
+BODY_TYPES = {
+    "/v1/chat/completions": ChatCompletionRequest,
+    "/v1/completions": CompletionRequest,
+    "/v1/embeddings": EmbeddingRequest,
+    "/v1/rerank": RerankRequest,
+    "/v1/audio/transcriptions": TranscriptionRequest,
+}
+
+
+def body_for_path(path: str, data: dict) -> _Body:
+    for suffix, cls in BODY_TYPES.items():
+        if path.endswith(suffix):
+            return cls(data)
+    raise LookupError(f"unsupported inference path {path!r}")

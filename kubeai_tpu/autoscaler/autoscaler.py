@@ -1,0 +1,174 @@
+"""Moving-average autoscaler driven by peer-scraped active-request gauges
+and engine-side queue depth.
+
+Parity: internal/modelautoscaler (autoscaler.go:20-169, metrics.go:15-71,
+state.go:32-65) — a leader-gated ticker scrapes /metrics of every
+operator replica, sums `kubeai_inference_requests_active` per model,
+feeds per-model fixed-window moving averages, and scales to
+ceil(avg / targetRequests). Averages persist to a state object so scale
+state survives restarts. Extension over the reference: engine-side
+`kubeai_engine_queue_depth` gauges are added to the signal so queued-but-
+unproxied work (cold starts, saturation) also drives scaling.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+import urllib.request
+from dataclasses import dataclass, field
+
+from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
+from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS, default_registry, parse_prometheus_text
+from kubeai_tpu.runtime.store import AlreadyExists, NotFound, ObjectMeta, Store
+
+log = logging.getLogger("kubeai_tpu.autoscaler")
+
+KIND_STATE = "AutoscalerState"
+ENGINE_QUEUE_METRIC = "kubeai_engine_queue_depth"
+
+
+@dataclass
+class AutoscalerState:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    # model -> average at last save (ref: state.go modelAverages)
+    averages: dict[str, float] = field(default_factory=dict)
+
+
+def scrape_metrics(addr: str, timeout: float = 3.0) -> dict[str, float]:
+    """GET metrics from one peer; returns model -> active count
+    (ref: metrics.go:36-71)."""
+    url = addr if addr.startswith("http") else f"http://{addr}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        text = resp.read().decode()
+    return parse_scraped_text(text)
+
+
+def parse_scraped_text(text: str) -> dict[str, float]:
+    parsed = parse_prometheus_text(text)
+    out: dict[str, float] = {}
+    for labels, value in parsed.get(ACTIVE_REQUESTS, []):
+        model = labels.get("request_model", "")
+        if model:
+            out[model] = out.get(model, 0.0) + value
+    return out
+
+
+class Autoscaler:
+    def __init__(
+        self,
+        store: Store,
+        model_client,
+        load_balancer,
+        election,
+        interval_seconds: float = 10.0,
+        average_window_count: int = 60,
+        fixed_self_metric_addrs: list[str] | None = None,
+        state_name: str = "kubeai-autoscaler-state",
+        namespace: str = "default",
+        engine_queue_scrape=None,
+    ):
+        self.store = store
+        self.model_client = model_client
+        self.lb = load_balancer
+        self.election = election
+        self.interval = interval_seconds
+        self.window = average_window_count
+        self.fixed_addrs = fixed_self_metric_addrs or []
+        self.state_name = state_name
+        self.namespace = namespace
+        self.engine_queue_scrape = engine_queue_scrape
+        self._averages: dict[str, SimpleMovingAverage] = {}
+        self._running = False
+        self._thread: threading.Thread | None = None
+        self._load_state()
+
+    # -- persistence (ref: state.go) ---------------------------------------
+
+    def _load_state(self):
+        try:
+            state = self.store.get(KIND_STATE, self.state_name, self.namespace)
+            for model, avg in state.averages.items():
+                self._averages[model] = SimpleMovingAverage([avg] * self.window)
+            log.info("preloaded autoscaler state for %d models", len(state.averages))
+        except NotFound:
+            pass
+
+    def _save_state(self):
+        averages = {m: a.calculate() for m, a in self._averages.items()}
+        try:
+            state = self.store.get(KIND_STATE, self.state_name, self.namespace)
+            state.averages = averages
+            self.store.update(KIND_STATE, state, check_version=False)
+        except NotFound:
+            try:
+                self.store.create(
+                    KIND_STATE,
+                    AutoscalerState(
+                        meta=ObjectMeta(name=self.state_name, namespace=self.namespace),
+                        averages=averages,
+                    ),
+                )
+            except AlreadyExists:
+                pass
+
+    # -- loop --------------------------------------------------------------
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="autoscaler", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        while self._running:
+            time.sleep(self.interval)
+            if not self.election.is_leader.is_set():
+                continue  # leader-gated (ref: autoscaler.go:96-99)
+            try:
+                self.tick()
+            except Exception:
+                log.exception("autoscaler tick failed")
+
+    def tick(self):
+        models = self.model_client.list_all_models()
+        actives = self.aggregate_metrics()
+        for model in models:
+            if model.spec.autoscaling_disabled:
+                continue
+            name = model.meta.name
+            avg = self._averages.get(name)
+            if avg is None:
+                avg = SimpleMovingAverage([0.0] * self.window)
+                self._averages[name] = avg
+            signal = actives.get(name, 0.0)
+            if self.engine_queue_scrape is not None:
+                signal += self.engine_queue_scrape(name)
+            avg.next(signal)
+            mean = avg.calculate()
+            import math
+
+            desired = math.ceil(mean / max(model.spec.target_requests, 1))
+            self.model_client.scale(name, desired)
+        self._save_state()
+
+    def aggregate_metrics(self) -> dict[str, float]:
+        """Sum active requests across every operator replica
+        (ref: aggregateAllMetrics, metrics.go:15-34)."""
+        addrs = self.fixed_addrs or self.lb.get_self_ips()
+        totals: dict[str, float] = {}
+        if not addrs:
+            # Single-process mode: read our own registry directly.
+            return parse_scraped_text(default_registry.render())
+        for addr in addrs:
+            try:
+                for model, v in scrape_metrics(addr).items():
+                    totals[model] = totals.get(model, 0.0) + v
+            except Exception as e:
+                log.warning("scrape %s failed: %s", addr, e)
+        return totals

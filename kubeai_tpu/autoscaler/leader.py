@@ -1,0 +1,97 @@
+"""Lease-based leader election.
+
+Parity: internal/leader/election.go:16-86 — a coordination lease object
+(here: a ConfigMap-like Lease record in the store) renewed on an
+interval; `is_leader` is the atomic flag the autoscaler gates on.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass, field
+
+from kubeai_tpu.runtime.store import AlreadyExists, Conflict, NotFound, ObjectMeta, Store
+
+KIND_LEASE = "Lease"
+
+
+@dataclass
+class Lease:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    holder: str = ""
+    renew_time: float = 0.0
+    duration_seconds: float = 15.0
+
+
+class Election:
+    def __init__(self, store: Store, identity: str, lease_name: str = "kubeai-tpu.kubeai.org", duration: float = 15.0, namespace: str = "default"):
+        self.store = store
+        self.identity = identity
+        self.lease_name = lease_name
+        self.duration = duration
+        self.namespace = namespace
+        self.is_leader = threading.Event()
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="leader-election", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+        # Release the lease if held.
+        if self.is_leader.is_set():
+            try:
+                self.store.mutate(
+                    KIND_LEASE,
+                    self.lease_name,
+                    lambda l: (setattr(l, "holder", ""), setattr(l, "renew_time", 0.0)),
+                    self.namespace,
+                )
+            except NotFound:
+                pass
+            self.is_leader.clear()
+
+    def _loop(self):
+        interval = self.duration / 3
+        while self._running:
+            try:
+                self._try_acquire_or_renew()
+            except Exception:
+                self.is_leader.clear()
+            time.sleep(interval)
+
+    def _try_acquire_or_renew(self):
+        now = time.time()
+        try:
+            lease = self.store.get(KIND_LEASE, self.lease_name, self.namespace)
+        except NotFound:
+            lease = Lease(
+                meta=ObjectMeta(name=self.lease_name, namespace=self.namespace),
+                holder=self.identity,
+                renew_time=now,
+                duration_seconds=self.duration,
+            )
+            try:
+                self.store.create(KIND_LEASE, lease)
+                self.is_leader.set()
+            except AlreadyExists:
+                self.is_leader.clear()
+            return
+
+        expired = now - lease.renew_time > lease.duration_seconds
+        if lease.holder == self.identity or expired or not lease.holder:
+            lease.holder = self.identity
+            lease.renew_time = now
+            try:
+                self.store.update(KIND_LEASE, lease)
+                self.is_leader.set()
+            except Conflict:
+                self.is_leader.clear()
+        else:
+            self.is_leader.clear()

@@ -1,0 +1,3 @@
+from kubeai_tpu.config.system import System, load_system_config
+
+__all__ = ["System", "load_system_config"]

@@ -1,0 +1,219 @@
+"""System configuration (parity: internal/config/system.go:13-260).
+
+One YAML/dict config with defaulting + validation. TPU-first: the default
+resource profiles carry `google.com/tpu` requests and GKE TPU node
+selectors (the reference only ships these in Helm values,
+ref: charts/kubeai/values-gke.yaml:18-41 — here they are first-class).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+
+@dataclass
+class ResourceProfile:
+    requests: dict[str, str] = field(default_factory=dict)
+    limits: dict[str, str] = field(default_factory=dict)
+    node_selector: dict[str, str] = field(default_factory=dict)
+    tolerations: list[dict] = field(default_factory=list)
+    affinity: dict = field(default_factory=dict)
+    scheduler_name: str = ""
+    runtime_class_name: str = ""
+    image_name: str = ""
+    # TPU topology (drives multi-host slice orchestration; new vs reference)
+    tpu_topology: str = ""  # e.g. "2x4"
+    hosts_per_replica: int = 1  # >1 => multi-host slice gang
+
+
+@dataclass
+class CacheProfile:
+    shared_filesystem_storage_class: str = ""
+    shared_filesystem_storage: str = "100Gi"
+
+
+@dataclass
+class EngineImages:
+    default: str = ""
+    profiles: dict[str, str] = field(default_factory=dict)  # profile name -> image
+
+    def for_profile(self, profile_name: str) -> str:
+        return self.profiles.get(profile_name, self.default)
+
+
+@dataclass
+class Autoscaling:
+    interval_seconds: float = 10.0
+    time_window_seconds: float = 600.0
+    state_config_map_name: str = "kubeai-autoscaler-state"
+
+    def consecutive_scale_downs_for(self, scale_down_delay_seconds: float) -> int:
+        """How many consecutive scale-down decisions before acting
+        (parity: internal/config/system.go:138-140)."""
+        import math
+
+        return int(math.ceil(scale_down_delay_seconds / self.interval_seconds))
+
+    @property
+    def average_window_count(self) -> int:
+        import math
+
+        return int(math.ceil(self.time_window_seconds / self.interval_seconds))
+
+
+@dataclass
+class MessageStream:
+    requests_url: str = ""
+    responses_url: str = ""
+    max_handlers: int = 1
+
+
+@dataclass
+class ModelRollouts:
+    surge: int = 1
+
+
+@dataclass
+class SecretNames:
+    huggingface: str = "kubeai-huggingface"
+    aws: str = "kubeai-aws"
+    gcp: str = "kubeai-gcp"
+    alibaba: str = "kubeai-alibaba"
+
+
+@dataclass
+class ModelServerPods:
+    service_account_name: str = ""
+    image_pull_secrets: list[str] = field(default_factory=list)
+    json_patches: list[dict] = field(default_factory=list)
+    security_context: dict = field(default_factory=dict)
+
+
+@dataclass
+class System:
+    secret_names: SecretNames = field(default_factory=SecretNames)
+    resource_profiles: dict[str, ResourceProfile] = field(default_factory=dict)
+    cache_profiles: dict[str, CacheProfile] = field(default_factory=dict)
+    engine_images: dict[str, EngineImages] = field(default_factory=dict)
+    model_loader_image: str = "kubeai-tpu/model-loader:latest"
+    autoscaling: Autoscaling = field(default_factory=Autoscaling)
+    streams: list[MessageStream] = field(default_factory=list)
+    messaging_error_max_backoff_seconds: float = 30.0
+    model_rollouts: ModelRollouts = field(default_factory=ModelRollouts)
+    model_server_pods: ModelServerPods = field(default_factory=ModelServerPods)
+    metrics_addr: str = ":8080"
+    api_addr: str = ":8000"
+    allow_pod_address_override: bool = False
+    fixed_self_metric_addrs: list[str] = field(default_factory=list)
+    leader_election_lease_seconds: float = 15.0
+
+    def default_and_validate(self) -> "System":
+        # Default engine images (parity with the reference matrix shape,
+        # TPU-first contents).
+        defaults = {
+            "TPUEngine": EngineImages(default="kubeai-tpu/engine:latest"),
+            "VLLM": EngineImages(
+                default="vllm/vllm-openai:latest",
+                profiles={"google-tpu": "vllm/vllm-tpu:latest"},
+            ),
+            "OLlama": EngineImages(default="ollama/ollama:latest"),
+            "FasterWhisper": EngineImages(
+                default="fedirz/faster-whisper-server:latest-cpu"
+            ),
+            "Infinity": EngineImages(default="michaelf34/infinity:latest"),
+        }
+        for name, imgs in defaults.items():
+            self.engine_images.setdefault(name, imgs)
+        if not self.resource_profiles:
+            self.resource_profiles = default_tpu_profiles()
+        for name, prof in self.resource_profiles.items():
+            if prof.hosts_per_replica < 1:
+                raise ValueError(f"resourceProfile {name}: hostsPerReplica must be >= 1")
+        if self.autoscaling.interval_seconds <= 0:
+            raise ValueError("autoscaling.interval must be > 0")
+        if self.autoscaling.time_window_seconds < self.autoscaling.interval_seconds:
+            raise ValueError("autoscaling.timeWindow must be >= interval")
+        if self.model_rollouts.surge < 0:
+            raise ValueError("modelRollouts.surge must be >= 0")
+        return self
+
+
+def default_tpu_profiles() -> dict[str, ResourceProfile]:
+    """TPU-first resource profiles (cf. ref charts/kubeai/values-gke.yaml:
+    18-41, which defines google-tpu-v5e-{1x1,2x2,2x4} as config data)."""
+
+    def tpu(accel: str, topo: str, chips: int, hosts: int = 1) -> ResourceProfile:
+        return ResourceProfile(
+            requests={"google.com/tpu": str(chips)},
+            limits={"google.com/tpu": str(chips)},
+            node_selector={
+                "cloud.google.com/gke-tpu-accelerator": accel,
+                "cloud.google.com/gke-tpu-topology": topo,
+            },
+            tpu_topology=topo,
+            hosts_per_replica=hosts,
+        )
+
+    return {
+        "cpu": ResourceProfile(requests={"cpu": "1", "memory": "2Gi"}),
+        "tpu-v5e-1x1": tpu("tpu-v5-lite-podslice", "1x1", 1),
+        "tpu-v5e-2x2": tpu("tpu-v5-lite-podslice", "2x2", 4),
+        "tpu-v5e-2x4": tpu("tpu-v5-lite-podslice", "2x4", 8),
+        # Multi-host v5e-16: two 8-chip hosts gang-scheduled per replica.
+        "tpu-v5e-4x4": tpu("tpu-v5-lite-podslice", "4x4", 4, hosts=4),
+        "tpu-v5p-2x2x1": tpu("tpu-v5p-slice", "2x2x1", 4),
+        "tpu-v6e-1x1": tpu("tpu-v6e-slice", "1x1", 1),
+        "tpu-v6e-2x2": tpu("tpu-v6e-slice", "2x2", 4),
+    }
+
+
+def _snake(k: str) -> str:
+    out = []
+    for ch in k:
+        if ch.isupper():
+            out.append("_")
+            out.append(ch.lower())
+        else:
+            out.append(ch)
+    return "".join(out)
+
+
+def _build(cls, data):
+    """Recursively build a dataclass from a (camelCase or snake_case) dict."""
+    import dataclasses
+
+    if not dataclasses.is_dataclass(cls) or not isinstance(data, dict):
+        return data
+    fields = {f.name: f for f in dataclasses.fields(cls)}
+    kwargs = {}
+    for k, v in data.items():
+        name = _snake(k)
+        f = fields.get(name)
+        if f is None:
+            raise ValueError(f"unknown config field {k!r} for {cls.__name__}")
+        ftype = f.type if isinstance(f.type, type) else None
+        if ftype is not None and dataclasses.is_dataclass(ftype):
+            kwargs[name] = _build(ftype, v)
+        elif name == "resource_profiles":
+            kwargs[name] = {n: _build(ResourceProfile, p) for n, p in v.items()}
+        elif name == "cache_profiles":
+            kwargs[name] = {n: _build(CacheProfile, p) for n, p in v.items()}
+        elif name == "engine_images":
+            kwargs[name] = {n: _build(EngineImages, p) for n, p in v.items()}
+        elif name == "streams":
+            kwargs[name] = [_build(MessageStream, s) for s in v]
+        else:
+            kwargs[name] = v
+    return cls(**kwargs)
+
+
+def load_system_config(path: str | None = None, data: dict | None = None) -> System:
+    """Load from YAML file or dict (CONFIG_PATH equivalent,
+    ref: cmd/main.go:40-46)."""
+    if path is not None:
+        import yaml
+
+        with open(path) as f:
+            data = yaml.safe_load(f) or {}
+    sys_ = _build(System, data or {})
+    return sys_.default_and_validate()

@@ -1,0 +1,34 @@
+"""Engine pod-spec generators.
+
+Each engine turns (Model, resolved config) into a Pod spec — the seam the
+reference implements per-engine (ref: internal/modelcontroller/
+engine_{vllm,ollama,fasterwhisper,infinity}.go). TPUEngine is new: this
+framework's own JAX serving engine, including multi-host TPU slice
+orchestration the reference never implements (SURVEY.md §2.9).
+"""
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.controller.engines.common import ModelPodConfig
+from kubeai_tpu.controller.engines.fasterwhisper import faster_whisper_pod_for_model
+from kubeai_tpu.controller.engines.infinity import infinity_pod_for_model
+from kubeai_tpu.controller.engines.ollama import ollama_pod_for_model
+from kubeai_tpu.controller.engines.tpu import tpu_engine_pod_for_model
+from kubeai_tpu.controller.engines.vllm import vllm_pod_for_model
+
+GENERATORS = {
+    mt.ENGINE_TPU: tpu_engine_pod_for_model,
+    mt.ENGINE_VLLM: vllm_pod_for_model,
+    mt.ENGINE_OLLAMA: ollama_pod_for_model,
+    mt.ENGINE_FASTER_WHISPER: faster_whisper_pod_for_model,
+    mt.ENGINE_INFINITY: infinity_pod_for_model,
+}
+
+
+def pod_for_model(model, cfg: ModelPodConfig):
+    gen = GENERATORS.get(model.spec.engine)
+    if gen is None:
+        raise ValueError(f"no pod generator for engine {model.spec.engine!r}")
+    return gen(model, cfg)
+
+
+__all__ = ["pod_for_model", "ModelPodConfig", "GENERATORS"]

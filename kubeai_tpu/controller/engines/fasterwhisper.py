@@ -1,0 +1,31 @@
+"""FasterWhisper engine pod generator (speech-to-text).
+
+Parity: internal/modelcontroller/engine_fasterwhisper.go:12-147 —
+configuration is env-driven (WHISPER__MODEL etc.).
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.api.core_types import Container, Pod
+from kubeai_tpu.controller.engines.common import (
+    MODEL_PORT,
+    ModelPodConfig,
+    base_pod,
+    default_probes,
+)
+
+
+def faster_whisper_pod_for_model(model, cfg: ModelPodConfig) -> Pod:
+    src = cfg.source
+    model_ref = src.huggingface_repo if src.scheme == "hf" else "/model"
+    if cfg.cache_mount_path:
+        model_ref = cfg.cache_mount_path
+    env = {
+        "WHISPER__MODEL": model_ref,
+        "WHISPER__INFERENCE_DEVICE": "auto",
+        "WHISPER__PORT": str(MODEL_PORT),
+        "ENABLE_UI": "false",
+    }
+    container = Container(env=env, args=list(model.spec.args))
+    default_probes(container, startup_seconds=3600)
+    return base_pod(model, cfg, container)

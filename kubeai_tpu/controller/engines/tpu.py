@@ -1,0 +1,68 @@
+"""TPUEngine pod generator — this framework's native JAX engine.
+
+The genuinely new engine tier (SURVEY.md §2.9/§7.6): pods run
+`python -m kubeai_tpu.engine.server` against `google.com/tpu` resources.
+Single-host podslices mirror the reference's TPU profile shape
+(ref: charts/kubeai/values-gke.yaml:18-41); multi-host slices — which the
+reference never implements — are expressed as `hosts_per_replica` pods
+per replica sharing a headless-service subdomain, with TPU_WORKER_ID /
+TPU_WORKER_HOSTNAMES bootstrap env so jax.distributed can form the slice
+mesh.
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.api.core_types import Container, Pod
+from kubeai_tpu.controller.engines.common import (
+    MODEL_PORT,
+    ModelPodConfig,
+    base_pod,
+    default_probes,
+)
+
+
+def tpu_engine_pod_for_model(model, cfg: ModelPodConfig) -> Pod:
+    src = cfg.source
+    if src.scheme == "hf":
+        model_arg = f"hf://{src.huggingface_repo}"
+    elif src.scheme == "pvc":
+        model_arg = "/model"
+    elif src.scheme == "file":
+        model_arg = "/model"
+    elif src.scheme in ("s3", "gs", "oss"):
+        # Weights staged to local SSD by the loader init container / cache.
+        model_arg = cfg.cache_mount_path or "/model"
+    else:
+        raise ValueError(f"TPUEngine does not support {src.scheme}:// sources")
+    if cfg.cache_mount_path:
+        model_arg = cfg.cache_mount_path
+
+    chips = int(cfg.profile.requests.get("google.com/tpu", "0") or 0) * cfg.profile_count
+    args = [
+        "--model", model_arg,
+        "--served-model-name", model.meta.name,
+        "--port", str(MODEL_PORT),
+        "--tensor-parallel-size", str(max(chips, 1) * max(cfg.profile.hosts_per_replica, 1)),
+    ]
+    args += list(model.spec.args)
+
+    container = Container(
+        command=["python", "-m", "kubeai_tpu.engine.server"],
+        args=args,
+        env={"PYTHONUNBUFFERED": "1"},
+    )
+    default_probes(container)
+    pod = base_pod(model, cfg, container)
+
+    if cfg.profile.hosts_per_replica > 1:
+        # Multi-host slice: the controller stamps per-replica pod sets with
+        # worker ranks; pods resolve peers via the per-model headless
+        # service subdomain.
+        svc = f"model-{model.meta.name}-slice"
+        pod.spec.subdomain = svc
+        container.env["TPU_HOSTS_PER_REPLICA"] = str(cfg.profile.hosts_per_replica)
+        container.env["TPU_SLICE_SUBDOMAIN"] = svc
+        # TPU_WORKER_ID and TPU_WORKER_HOSTNAMES are filled per-pod by the
+        # controller when it expands one replica into hosts_per_replica
+        # pods (see controller.reconcile_pods).
+    return pod

@@ -1,0 +1,122 @@
+"""LoadBalancer: pod watcher -> per-model endpoint groups.
+
+Parity: internal/loadbalancer/load_balancer.go:53-202 — watches Pods,
+keeps a group per model with ready endpoints (address from pod IP or the
+model-pod-ip/port override annotations when allowed — the test/dev seam),
+adapter sets from pod labels, and tracks KubeAI self-pod IPs for the
+autoscaler's peer scrape.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD, Pod, pod_is_ready
+from kubeai_tpu.loadbalancer.group import Endpoint, EndpointGroup
+from kubeai_tpu.runtime.store import Store
+
+log = logging.getLogger("kubeai_tpu.loadbalancer")
+
+DEFAULT_PORT = 8000
+
+
+def pod_endpoint(pod: Pod, allow_override: bool) -> Endpoint | None:
+    """Address + adapter set for a ready server pod
+    (ref: load_balancer.go:108-137)."""
+    ip = pod.status.pod_ip
+    port = DEFAULT_PORT
+    if allow_override:
+        ip = pod.meta.annotations.get(mt.ANNOTATION_MODEL_POD_IP, ip)
+    port_ann = pod.meta.annotations.get(mt.ANNOTATION_MODEL_POD_PORT)
+    if port_ann:
+        port = int(port_ann)
+    if not ip:
+        return None
+    adapters = {
+        k[len(mt.LABEL_ADAPTER_PREFIX) :]
+        for k in pod.meta.labels
+        if k.startswith(mt.LABEL_ADAPTER_PREFIX)
+    }
+    return Endpoint(address=f"{ip}:{port}", adapters=adapters)
+
+
+class LoadBalancer:
+    def __init__(self, store: Store, allow_pod_address_override: bool = False):
+        self.store = store
+        self.allow_override = allow_pod_address_override
+        self._groups: dict[str, EndpointGroup] = {}
+        self._groups_lock = threading.Lock()
+        self._self_ips: list[str] = []
+        self._running = False
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._running = True
+        self._thread = threading.Thread(target=self._loop, name="loadbalancer", daemon=True)
+        self._thread.start()
+
+    def stop(self):
+        self._running = False
+        if self._thread:
+            self._thread.join(timeout=5)
+
+    def _loop(self):
+        q = self.store.watch(KIND_POD)
+        # Initial sync happens via synthetic ADDED events.
+        while self._running:
+            try:
+                ev = q.get(timeout=0.1)
+            except Exception:
+                continue
+            try:
+                model = ev.obj.meta.labels.get(mt.LABEL_MODEL)
+                if model:
+                    self._reconcile_model(model, ev.obj.meta.namespace)
+            except Exception:
+                log.exception("endpoint reconcile failed")
+
+    def _reconcile_model(self, model_name: str, namespace: str = "default"):
+        pods = self.store.list(KIND_POD, namespace, {mt.LABEL_MODEL: model_name})
+        observed: dict[str, Endpoint] = {}
+        for pod in pods:
+            if not pod_is_ready(pod):
+                continue
+            ep = pod_endpoint(pod, self.allow_override)
+            if ep is not None:
+                observed[pod.meta.name] = ep
+        self.group(model_name).reconcile_endpoints(observed)
+
+    def group(self, model_name: str) -> EndpointGroup:
+        with self._groups_lock:
+            g = self._groups.get(model_name)
+            if g is None:
+                g = EndpointGroup()
+                self._groups[model_name] = g
+            return g
+
+    # -- proxy interface (ref: load_balancer.go:176-202) -------------------
+
+    def await_best_address(self, req, timeout: float | None = None, cancelled=None, exclude=None):
+        """Returns (addr, done_fn). Blocks until an endpoint exists.
+        *exclude*: addresses that already failed this request (retries
+        prefer fresh endpoints when any exist)."""
+        lb = req.load_balancing
+        return self.group(req.model_name).get_best_addr(
+            strategy=lb.strategy,
+            prefix=req.prefix,
+            adapter=req.adapter,
+            mean_load_factor=lb.prefix_hash.mean_load_percentage / 100.0,
+            timeout=timeout,
+            cancelled=cancelled,
+            exclude=exclude,
+        )
+
+    def get_all_addresses(self, model_name: str) -> list[str]:
+        return self.group(model_name).get_all_addrs()
+
+    def get_self_ips(self) -> list[str]:
+        """Ready KubeAI operator pod IPs for autoscaler peer scraping
+        (ref: load_balancer.go:68-83). Local mode: empty (self only)."""
+        return list(self._self_ips)

@@ -81,10 +81,13 @@ def chwbl_choose(
     endpoint_load: Callable[[str], int],
     total_load: int,
     n_endpoints: int,
+    allowed: Callable[[str], bool] | None = None,
 ) -> str | None:
     """Pick an endpoint name for *key*, honoring adapter capability and the
-    bounded-load condition; falls back to the first adapter-capable endpoint
-    (ref: balance_chwbl.go:14-84)."""
+    bounded-load condition; falls back to the first servable endpoint
+    (ref: balance_chwbl.go:14-84). *allowed* additionally filters endpoints
+    (retry exclusion); callers fall back to allowed=None when it empties
+    the candidate set."""
     fallback: str | None = None
     seen: set[str] = set()
     for name in ring.walk(key):
@@ -94,14 +97,14 @@ def chwbl_choose(
         if name in seen:
             continue
         seen.add(name)
-        if adapter and not has_adapter(name, adapter):
-            if len(seen) == n_endpoints:
-                break
-            continue
-        if fallback is None:
-            fallback = name
-        if load_ok(endpoint_load(name), total_load, n_endpoints, load_factor):
-            return name
+        servable = (allowed is None or allowed(name)) and (
+            not adapter or has_adapter(name, adapter)
+        )
+        if servable:
+            if fallback is None:
+                fallback = name
+            if load_ok(endpoint_load(name), total_load, n_endpoints, load_factor):
+                return name
         if len(seen) == n_endpoints:
             break
     return fallback

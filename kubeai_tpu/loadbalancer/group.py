@@ -10,6 +10,7 @@ broadcast is expressed here as a Condition + generation counter.
 
 from __future__ import annotations
 
+import random
 import threading
 import time
 from dataclasses import dataclass, field
@@ -46,6 +47,7 @@ class EndpointGroup:
         mean_load_factor: float = 1.25,
         timeout: float | None = None,
         cancelled: threading.Event | None = None,
+        exclude: set[str] | None = None,
     ):
         """Block until an endpoint is available and return
         ``(address, done_fn)``; ``done_fn`` must be called when the request
@@ -75,7 +77,12 @@ class EndpointGroup:
                     if self._generation != gen:
                         await_change = False
 
-                name = self._choose(strategy, prefix, adapter, mean_load_factor)
+                # Endpoints in *exclude* (already failed this request) are
+                # avoided when an alternative exists — retries should land
+                # somewhere new.
+                name = self._choose(strategy, prefix, adapter, mean_load_factor, exclude)
+                if name is None and exclude:
+                    name = self._choose(strategy, prefix, adapter, mean_load_factor, None)
                 if name is None:
                     # No endpoint can serve this request (e.g. adapter not
                     # yet loaded anywhere) — wait for the endpoint set to
@@ -96,7 +103,19 @@ class EndpointGroup:
 
                 return ep.address, done
 
-    def _choose(self, strategy: str, prefix: str, adapter: str, mean_load_factor: float):
+    def _choose(
+        self,
+        strategy: str,
+        prefix: str,
+        adapter: str,
+        mean_load_factor: float,
+        exclude: set[str] | None = None,
+    ):
+        # Single source of truth for retry exclusion; None when unused.
+        allowed = (
+            (lambda name: self._endpoints[name].address not in exclude) if exclude else None
+        )
+
         if strategy == PREFIX_HASH:
             return chwbl_choose(
                 self._ring,
@@ -107,15 +126,25 @@ class EndpointGroup:
                 endpoint_load=lambda n: self._endpoints[n].in_flight,
                 total_load=self._total_in_flight,
                 n_endpoints=len(self._endpoints),
+                allowed=allowed,
             )
         if strategy == LEAST_LOAD:
-            best = None
+            # Ties broken randomly: retries after an upstream failure must
+            # be able to land on a different endpoint (the reference gets
+            # this implicitly from Go's randomized map iteration).
+            candidates: list[str] = []
+            best_load = None
             for name, ep in self._endpoints.items():
                 if adapter and adapter not in ep.adapters:
                     continue
-                if best is None or ep.in_flight < self._endpoints[best].in_flight:
-                    best = name
-            return best
+                if allowed is not None and not allowed(name):
+                    continue
+                if best_load is None or ep.in_flight < best_load:
+                    best_load = ep.in_flight
+                    candidates = [name]
+                elif ep.in_flight == best_load:
+                    candidates.append(name)
+            return random.choice(candidates) if candidates else None
         raise ValueError(f"unknown load balancing strategy: {strategy!r}")
 
     # -- membership --------------------------------------------------------

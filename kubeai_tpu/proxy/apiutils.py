@@ -1,0 +1,107 @@
+"""Request parsing: body -> (model, adapter, prefix, rewritten body).
+
+Parity: internal/apiutils/request.go:64-232 and model.go:23-37 —
+"model_adapter" ids split on the first underscore, adapter name written
+back into the body's model field (engines serve adapters as model ids),
+prefix extracted for PrefixHash routing, label-selector lookup semantics
+with 404/400 distinctions.
+"""
+
+from __future__ import annotations
+
+import json
+from dataclasses import dataclass, field
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.openai_types import _Body, body_for_path
+
+
+class APIError(Exception):
+    def __init__(self, code: int, message: str):
+        super().__init__(message)
+        self.code = code
+        self.message = message
+
+
+@dataclass
+class Request:
+    id: str = ""
+    model_name: str = ""
+    adapter: str = ""
+    prefix: str = ""
+    selectors: dict[str, str] = field(default_factory=dict)
+    body: _Body | None = None
+    model_obj: object = None
+
+    @property
+    def load_balancing(self) -> mt.LoadBalancing:
+        if self.model_obj is not None:
+            return self.model_obj.spec.load_balancing
+        return mt.LoadBalancing()
+
+    def body_bytes(self) -> bytes:
+        return self.body.to_bytes() if self.body else b""
+
+
+def split_model_adapter(s: str) -> tuple[str, str]:
+    """"model_adapter" -> (model, adapter); parity: model.go:23-37."""
+    model, sep, adapter = s.partition("_")
+    return model, adapter if sep else ""
+
+
+def parse_label_selector(header: str | None) -> dict[str, str]:
+    """X-Label-Selector: "k=v,k2=v2"."""
+    out: dict[str, str] = {}
+    if not header:
+        return out
+    for part in header.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise APIError(400, f"bad label selector segment {part!r}")
+        k, v = part.split("=", 1)
+        out[k.strip()] = v.strip().strip('"')
+    return out
+
+
+def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, str]) -> Request:
+    """Decode + validate + rewrite; parity: ParseRequest
+    (ref: apiutils/request.go:64-107)."""
+    import uuid
+
+    try:
+        data = json.loads(raw_body or b"{}")
+    except json.JSONDecodeError as e:
+        raise APIError(400, f"invalid JSON body: {e}")
+    try:
+        body = body_for_path(path, data)
+    except LookupError as e:
+        raise APIError(404, str(e))
+    except ValueError as e:
+        raise APIError(400, str(e))
+
+    requested = body.get_model()
+    if not requested:
+        raise APIError(400, "missing 'model' field")
+    model_name, adapter = split_model_adapter(requested)
+
+    selectors = parse_label_selector(headers.get("X-Label-Selector"))
+    model = model_client.lookup_model(model_name, adapter, selectors)
+
+    req = Request(
+        id=uuid.uuid4().hex,
+        model_name=model_name,
+        adapter=adapter,
+        prefix="",
+        selectors=selectors,
+        body=body,
+        model_obj=model,
+    )
+    if model.spec.load_balancing.strategy == mt.PREFIX_HASH_STRATEGY:
+        req.prefix = body.prefix(model.spec.load_balancing.prefix_hash.prefix_char_length)
+
+    # The engine serves adapters under their bare adapter name
+    # (ref: apiutils rewrite + engine /v1/models adapter ids).
+    body.set_model(adapter if adapter else model_name)
+    return req

@@ -1,0 +1,128 @@
+"""Retrying reverse proxy with request-triggered scale-from-zero.
+
+Parity: internal/modelproxy/handler.go:36-172 — parse once, bump the
+active-requests gauge (THE autoscaling signal), 0->1 scale, await an
+endpoint, proxy with body replay and retries on {500,502,503,504} or
+connection errors, re-entering endpoint selection each attempt.
+"""
+
+from __future__ import annotations
+
+import http.client
+import logging
+import threading
+
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
+from kubeai_tpu.proxy.apiutils import APIError, Request, parse_request
+
+log = logging.getLogger("kubeai_tpu.proxy")
+
+RETRYABLE_CODES = {500, 502, 503, 504}
+
+
+class ProxyResult:
+    def __init__(self, status: int, headers: list[tuple[str, str]], body_iter):
+        self.status = status
+        self.headers = headers
+        self.body_iter = body_iter
+
+
+class ModelProxy:
+    def __init__(self, model_client, load_balancer, max_retries: int = 3, await_timeout: float = 600.0):
+        self.model_client = model_client
+        self.lb = load_balancer
+        self.max_retries = max_retries
+        self.await_timeout = await_timeout
+        self.active = default_registry.gauge(
+            ACTIVE_REQUESTS, "requests currently being served per model"
+        )
+
+    def handle(self, raw_body: bytes, path: str, headers: dict[str, str], cancelled: threading.Event | None = None):
+        """Returns a ProxyResult; raises APIError for client errors."""
+        req = parse_request(self.model_client, raw_body, path, headers)
+
+        labels = {"request_model": req.model_name, "request_type": "http"}
+        self.active.add(1, labels=labels)
+        release = lambda: self.active.add(-1, labels=labels)
+
+        try:
+            self.model_client.scale_at_least_one_replica(req.model_obj)
+            return self._proxy_with_retries(req, path, headers, release, cancelled)
+        except BaseException:
+            release()
+            raise
+
+    def _proxy_with_retries(self, req: Request, path: str, headers: dict[str, str], release, cancelled):
+        body = req.body_bytes()
+        last_err: Exception | str | None = None
+        attempts = self.max_retries + 1
+        failed_addrs: set[str] = set()
+        for attempt in range(attempts):
+            try:
+                addr, done = self.lb.await_best_address(
+                    req, timeout=self.await_timeout, cancelled=cancelled,
+                    exclude=failed_addrs or None,
+                )
+            except TimeoutError as e:
+                # handle()'s except clause performs the gauge release.
+                raise APIError(503, f"no ready endpoints for {req.model_name}: {e}")
+            try:
+                resp, conn = self._connect(addr, path, headers, body)
+            except (ConnectionError, OSError, http.client.HTTPException) as e:
+                done()
+                failed_addrs.add(addr)
+                last_err = e
+                log.info("connection to %s failed (%s); attempt %d", addr, e, attempt + 1)
+                continue
+            if resp.status in RETRYABLE_CODES and attempt < attempts - 1:
+                log.info(
+                    "retrying %s after upstream %d (attempt %d)",
+                    req.model_name, resp.status, attempt + 1,
+                )
+                last_err = f"upstream status {resp.status}"
+                failed_addrs.add(addr)
+                try:
+                    resp.read()
+                finally:
+                    conn.close()
+                    done()
+                continue
+            return ProxyResult(
+                resp.status, resp.getheaders(), self._body_iter(resp, conn, done, release)
+            )
+        raise APIError(502, f"upstream unavailable after {attempts} attempts: {last_err}")
+
+    def _connect(self, addr: str, path: str, headers: dict[str, str], body: bytes):
+        host, _, port = addr.partition(":")
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=600)
+        # Strip hop-by-hop headers; body was rewritten (adapter names).
+        fwd = {
+            k: v
+            for k, v in headers.items()
+            if k.lower() not in ("host", "content-length", "connection", "transfer-encoding")
+        }
+        fwd["Content-Length"] = str(len(body))
+        conn.request("POST", self._upstream_path(path), body=body, headers=fwd)
+        return conn.getresponse(), conn
+
+    @staticmethod
+    def _body_iter(resp, conn, done, release):
+        """Stream the upstream body; exactly-once cleanup on exhaustion or
+        generator close (client disconnect)."""
+        try:
+            while True:
+                chunk = resp.read(65536)
+                if not chunk:
+                    break
+                yield chunk
+        finally:
+            conn.close()
+            done()
+            release()
+
+    @staticmethod
+    def _upstream_path(path: str) -> str:
+        """/openai/v1/... -> /v1/... (the engine serves /v1)."""
+        idx = path.find("/v1/")
+        return path[idx:] if idx >= 0 else path

@@ -1,0 +1,93 @@
+"""Model lookup + scaling operations.
+
+Parity: internal/modelclient (client.go:22-73, scale.go:14-100) — 404/400
+lookup semantics with adapter validation, request-triggered 0->1
+scale-from-zero, autoscaler-driven Scale with min/max clamp and the
+consecutive-scale-down gate.
+"""
+
+from __future__ import annotations
+
+import threading
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.proxy.apiutils import APIError
+from kubeai_tpu.runtime.store import NotFound, Store
+
+
+class ModelClient:
+    def __init__(self, store: Store, namespace: str = "default", required_consecutive_scale_downs=None):
+        self.store = store
+        self.namespace = namespace
+        self._lock = threading.Lock()
+        # model -> consecutive scale-down decision count
+        # (ref: scale.go consecutiveScaleDowns map)
+        self._consecutive_scale_downs: dict[str, int] = {}
+        self._required_consecutive = required_consecutive_scale_downs or (lambda m: 3)
+
+    def lookup_model(self, model_name: str, adapter: str, selectors: dict[str, str]) -> mt.Model:
+        try:
+            model = self.store.get(mt.KIND_MODEL, model_name, self.namespace)
+        except NotFound:
+            raise APIError(404, f"model {model_name!r} not found")
+        for k, v in selectors.items():
+            if model.meta.labels.get(k) != v:
+                raise APIError(404, f"model {model_name!r} does not match selector {k}={v}")
+        if adapter and not any(a.name == adapter for a in model.spec.adapters):
+            raise APIError(404, f"model {model_name!r} has no adapter {adapter!r}")
+        return model
+
+    def list_all_models(self) -> list[mt.Model]:
+        return self.store.list(mt.KIND_MODEL, self.namespace)
+
+    def scale_at_least_one_replica(self, model: mt.Model) -> None:
+        """Request-triggered 0->1 (ref: scale.go:14-39): only when
+        autoscaling is enabled and current replicas == 0."""
+        if model.spec.autoscaling_disabled:
+            return
+        try:
+            def mutate(m):
+                if (m.spec.replicas or 0) == 0:
+                    m.spec.replicas = 1
+
+            self.store.mutate(mt.KIND_MODEL, model.meta.name, mutate, self.namespace)
+        except NotFound:
+            pass
+
+    def scale(self, model_name: str, desired: int) -> None:
+        """Autoscaler-driven scale (ref: scale.go:43-100): scale-up applies
+        immediately; scale-down only after N consecutive decisions; always
+        clamped to [minReplicas, maxReplicas]."""
+        try:
+            model = self.store.get(mt.KIND_MODEL, model_name, self.namespace)
+        except NotFound:
+            return
+        s = model.spec
+        clamped = max(desired, s.min_replicas)
+        if s.max_replicas is not None:
+            clamped = min(clamped, s.max_replicas)
+        current = s.replicas or 0
+
+        if clamped < current:
+            # Check-then-increment (ref: scale.go:56-66): the scale-down
+            # fires on the (required+1)th consecutive decision and keeps
+            # firing until a non-scale-down decision resets the counter.
+            with self._lock:
+                n = self._consecutive_scale_downs.get(model_name, 0)
+                required = self._required_consecutive(model)
+                if n < required:
+                    self._consecutive_scale_downs[model_name] = n + 1
+                    return
+        else:
+            with self._lock:
+                self._consecutive_scale_downs[model_name] = 0
+            if clamped == current:
+                return
+
+        def mutate(m):
+            m.spec.replicas = clamped
+
+        try:
+            self.store.mutate(mt.KIND_MODEL, model_name, mutate, self.namespace)
+        except NotFound:
+            pass

@@ -1,0 +1,149 @@
+"""Operator-facing OpenAI API server (+ metrics/health endpoints).
+
+Parity: internal/openaiserver (handler.go:20-69, models.go:13-109) mounted
+at /openai on :8000, and the manager's metrics server on :8080
+(ref: internal/manager/run.go:267-282). Inference routes stream the
+proxied upstream body through unchanged (SSE included).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.proxy.apiutils import APIError, parse_label_selector
+
+log = logging.getLogger("kubeai_tpu.openaiserver")
+
+INFERENCE_PATHS = (
+    "/openai/v1/chat/completions",
+    "/openai/v1/completions",
+    "/openai/v1/embeddings",
+    "/openai/v1/rerank",
+    "/openai/v1/audio/transcriptions",
+)
+
+
+class OpenAIServer:
+    def __init__(self, model_proxy, model_client, host: str = "0.0.0.0", port: int = 8000):
+        self.proxy = model_proxy
+        self.model_client = model_client
+        self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
+        self.port = self.httpd.server_port
+        self._thread: threading.Thread | None = None
+
+    def start(self):
+        self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
+        self._thread.start()
+        log.info("openai server on :%d", self.port)
+
+    def stop(self):
+        self.httpd.shutdown()
+
+    def list_models(self, selectors: dict[str, str]) -> list[dict]:
+        """Models + adapter-expanded ids (ref: models.go:13-109)."""
+        out = []
+        for m in self.model_client.list_all_models():
+            if selectors and not all(m.meta.labels.get(k) == v for k, v in selectors.items()):
+                continue
+            features = [
+                k[len(mt.LABEL_FEATURE_PREFIX) :]
+                for k in m.meta.labels
+                if k.startswith(mt.LABEL_FEATURE_PREFIX)
+            ]
+            out.append(
+                {
+                    "id": m.meta.name,
+                    "object": "model",
+                    "owned_by": m.spec.owner or "kubeai-tpu",
+                    "features": sorted(features),
+                }
+            )
+            for a in m.spec.adapters:
+                out.append(
+                    {
+                        "id": f"{m.meta.name}_{a.name}",
+                        "object": "model",
+                        "owned_by": m.spec.owner or "kubeai-tpu",
+                        "parent": m.meta.name,
+                    }
+                )
+        return out
+
+
+def _make_handler(srv: OpenAIServer):
+    class Handler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *args):
+            log.debug(fmt, *args)
+
+        def _json(self, code: int, obj):
+            body = json.dumps(obj).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        def _api_error(self, e: APIError):
+            self._json(e.code, {"error": {"message": e.message, "type": "invalid_request_error" if e.code < 500 else "internal_error"}})
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path in ("/healthz", "/readyz", "/health"):
+                self._json(200, {"status": "ok"})
+            elif path == "/metrics":
+                body = default_registry.render().encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "text/plain; version=0.0.4")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+            elif path == "/openai/v1/models":
+                try:
+                    sel = parse_label_selector(self.headers.get("X-Label-Selector"))
+                    self._json(200, {"object": "list", "data": srv.list_models(sel)})
+                except APIError as e:
+                    self._api_error(e)
+            else:
+                self._json(404, {"error": {"message": f"no route {path}"}})
+
+        def do_POST(self):
+            path = self.path.split("?")[0]
+            if path not in INFERENCE_PATHS:
+                return self._json(404, {"error": {"message": f"no route {path}"}})
+            n = int(self.headers.get("Content-Length", 0))
+            raw = self.rfile.read(n)
+            cancelled = threading.Event()
+            try:
+                result = srv.proxy.handle(
+                    raw, path, {k: v for k, v in self.headers.items()}, cancelled
+                )
+            except APIError as e:
+                return self._api_error(e)
+            except Exception as e:  # pragma: no cover
+                log.exception("proxy failure")
+                return self._json(500, {"error": {"message": str(e)}})
+
+            self.send_response(result.status)
+            passthrough = {"content-type", "cache-control"}
+            for k, v in result.headers:
+                if k.lower() in passthrough:
+                    self.send_header(k, v)
+            self.send_header("Transfer-Encoding", "chunked")
+            self.end_headers()
+            try:
+                for chunk in result.body_iter:
+                    self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+            except (BrokenPipeError, ConnectionResetError):
+                cancelled.set()
+                result.body_iter.close()
+
+    return Handler

@@ -1,0 +1,3 @@
+from kubeai_tpu.runtime.store import ObjectMeta, Store, WatchEvent
+
+__all__ = ["Store", "ObjectMeta", "WatchEvent"]

@@ -1,0 +1,77 @@
+"""Full operator stack: Model object -> reconciler pods -> LB -> proxy ->
+real engine process; exercises scale-from-zero hold + streaming."""
+import sys, json, threading, time, urllib.request
+sys.path.insert(0, "/root/repo")
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+from kubeai_tpu.proxy.handler import ModelProxy
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.proxy.server import OpenAIServer
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.leader import Election
+
+store = Store()
+system = System().default_and_validate(); system.allow_pod_address_override = True
+rec = ModelReconciler(store, system); rec.start()
+lb = LoadBalancer(store, allow_pod_address_override=True); lb.start()
+mc = ModelClient(store)
+proxy = ModelProxy(mc, lb, await_timeout=30)
+api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0); api.start()
+el = Election(store, "op-1", duration=1.0); el.start()
+asc = Autoscaler(store, mc, lb, el, interval_seconds=0.5, average_window_count=4); asc.start()
+
+store.create(mt.KIND_MODEL, Model(meta=ObjectMeta(name="m1"),
+    spec=ModelSpec(url="hf://org/m", resource_profile="cpu:1", target_requests=2)))
+time.sleep(0.3)
+print("pods before first request:", len(store.list(KIND_POD, selector={"model": "m1"})))
+
+res = {}
+def client():
+    req = urllib.request.Request(f"http://127.0.0.1:{api.port}/openai/v1/chat/completions",
+        data=json.dumps({"model":"m1","messages":[{"role":"user","content":"hi"}],"max_tokens":4,"temperature":0}).encode(),
+        headers={"Content-Type":"application/json"})
+    with urllib.request.urlopen(req, timeout=30) as r:
+        res["body"] = json.loads(r.read())
+t = threading.Thread(target=client); t.start()
+time.sleep(0.5)
+pods = store.list(KIND_POD, selector={"model": "m1"})
+print("scale-from-zero created pods:", len(pods), "| request blocked:", "body" not in res)
+def mutate(p):
+    p.status.ready = True; p.status.pod_ip = "127.0.0.1"
+    p.meta.annotations["model-pod-ip"] = "127.0.0.1"
+    p.meta.annotations["model-pod-port"] = "8125"
+store.mutate(KIND_POD, pods[0].meta.name, mutate)
+t.join(20)
+print("response role:", res["body"]["choices"][0]["message"]["role"], "| usage:", res["body"]["usage"]["total_tokens"])
+
+# streaming through the full proxy chain
+req = urllib.request.Request(f"http://127.0.0.1:{api.port}/openai/v1/chat/completions",
+    data=json.dumps({"model":"m1","messages":[{"role":"user","content":"s"}],"max_tokens":3,"temperature":0,"stream":True}).encode(),
+    headers={"Content-Type":"application/json"})
+lines = []
+with urllib.request.urlopen(req, timeout=30) as r:
+    for line in r:
+        line = line.decode().strip()
+        if line.startswith("data: "): lines.append(line[6:])
+print("streamed chunks:", len(lines), "| terminator:", lines[-1])
+
+# autoscaler visibility: metrics endpoint exposes the gauge
+with urllib.request.urlopen(f"http://127.0.0.1:{api.port}/metrics", timeout=5) as r:
+    metrics = r.read().decode()
+print("gauge present:", "kubeai_inference_requests_active" in metrics)
+time.sleep(2.5)  # let autoscaler ticks run with zero load (min_replicas=0... but scale-down gate)
+m = store.get(mt.KIND_MODEL, "m1")
+print("replicas after idle ticks:", m.spec.replicas)
+# probe: label-selector mismatch
+req = urllib.request.Request(f"http://127.0.0.1:{api.port}/openai/v1/completions",
+    data=json.dumps({"model":"m1","prompt":"x"}).encode(),
+    headers={"Content-Type":"application/json","X-Label-Selector":"team=ghost"})
+try:
+    urllib.request.urlopen(req, timeout=10)
+except urllib.error.HTTPError as e:
+    print("selector mismatch ->", e.code, json.loads(e.read())["error"]["message"][:60])

@@ -1,0 +1,110 @@
+import json
+
+import pytest
+
+from kubeai_tpu.api.model_types import Adapter, Model, ModelSpec, PREFIX_HASH_STRATEGY
+from kubeai_tpu.proxy.apiutils import (
+    APIError,
+    parse_label_selector,
+    parse_request,
+    split_model_adapter,
+)
+from kubeai_tpu.runtime.store import ObjectMeta
+
+
+class FakeModelClient:
+    def __init__(self, models):
+        self.models = {m.meta.name: m for m in models}
+
+    def lookup_model(self, name, adapter, selectors):
+        m = self.models.get(name)
+        if m is None:
+            raise APIError(404, f"model {name} not found")
+        for k, v in selectors.items():
+            if m.meta.labels.get(k) != v:
+                raise APIError(404, "selector mismatch")
+        if adapter and not any(a.name == adapter for a in m.spec.adapters):
+            raise APIError(404, f"no adapter {adapter}")
+        return m
+
+
+def mk_model(name="m1", **kw):
+    kw.setdefault("url", "hf://a/b")
+    return Model(meta=ObjectMeta(name=name), spec=ModelSpec(**kw))
+
+
+def test_split_model_adapter():
+    assert split_model_adapter("llama_fin1") == ("llama", "fin1")
+    assert split_model_adapter("llama") == ("llama", "")
+    assert split_model_adapter("llama_a_b") == ("llama", "a_b")
+
+
+def test_label_selector_parse():
+    assert parse_label_selector('a=b, c="d"') == {"a": "b", "c": "d"}
+    assert parse_label_selector(None) == {}
+    with pytest.raises(APIError):
+        parse_label_selector("nonsense")
+
+
+def test_parse_chat_and_unknown_fields_roundtrip():
+    mc = FakeModelClient([mk_model()])
+    body = {
+        "model": "m1",
+        "messages": [{"role": "user", "content": "hello"}],
+        "engine_specific_knob": {"deep": [1, 2, 3]},  # must survive rewrite
+        "temperature": 0.5,
+    }
+    req = parse_request(mc, json.dumps(body).encode(), "/openai/v1/chat/completions", {})
+    out = json.loads(req.body_bytes())
+    assert out["engine_specific_knob"] == {"deep": [1, 2, 3]}
+    assert out["temperature"] == 0.5
+    assert out["model"] == "m1"
+
+
+def test_adapter_rewrites_model_field():
+    m = mk_model(adapters=[Adapter(name="ad1", url="hf://a/b")])
+    mc = FakeModelClient([m])
+    body = {"model": "m1_ad1", "messages": [{"role": "user", "content": "x"}]}
+    req = parse_request(mc, json.dumps(body).encode(), "/openai/v1/chat/completions", {})
+    assert req.model_name == "m1" and req.adapter == "ad1"
+    assert json.loads(req.body_bytes())["model"] == "ad1"
+
+
+def test_prefix_extracted_for_prefix_hash():
+    m = mk_model()
+    m.spec.load_balancing.strategy = PREFIX_HASH_STRATEGY
+    m.spec.load_balancing.prefix_hash.prefix_char_length = 4
+    mc = FakeModelClient([m])
+    body = {"model": "m1", "messages": [{"role": "user", "content": "abcdefgh"}]}
+    req = parse_request(mc, json.dumps(body).encode(), "/openai/v1/chat/completions", {})
+    assert req.prefix == "abcd"
+
+    # Completions use the prompt; content-parts use the first text part.
+    body = {"model": "m1", "prompt": "zyxwvu"}
+    req = parse_request(mc, json.dumps(body).encode(), "/openai/v1/completions", {})
+    assert req.prefix == "zyxw"
+    body = {
+        "model": "m1",
+        "messages": [
+            {"role": "system", "content": "sys"},
+            {"role": "user", "content": [{"type": "text", "text": "partial"}]},
+        ],
+    }
+    req = parse_request(mc, json.dumps(body).encode(), "/openai/v1/chat/completions", {})
+    assert req.prefix == "part"
+
+
+def test_errors():
+    mc = FakeModelClient([mk_model()])
+    with pytest.raises(APIError) as e:
+        parse_request(mc, b"not json", "/openai/v1/completions", {})
+    assert e.value.code == 400
+    with pytest.raises(APIError) as e:
+        parse_request(mc, b"{}", "/openai/v1/completions", {})
+    assert e.value.code == 400  # missing model
+    with pytest.raises(APIError) as e:
+        parse_request(mc, b'{"model":"nope"}', "/openai/v1/completions", {})
+    assert e.value.code == 404
+    with pytest.raises(APIError) as e:
+        parse_request(mc, b'{"model":"m1"}', "/openai/v1/bogus", {})
+    assert e.value.code == 404

@@ -1,0 +1,197 @@
+"""Autoscaler decisions with fake peer metric servers — the reference's
+HA-without-a-cluster seam (ref: test/integration/autoscaling_ha_test.go,
+FixedSelfMetricAddrs)."""
+
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.autoscaler.autoscaler import KIND_STATE, Autoscaler, parse_scraped_text
+from kubeai_tpu.autoscaler.leader import Election
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+
+class FakeMetricsPeer:
+    def __init__(self, text: str):
+        self.text = text
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(self):
+                body = outer.text.encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.addr = f"127.0.0.1:{self.httpd.server_port}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+class AlwaysLeader:
+    is_leader = threading.Event()
+
+
+AlwaysLeader.is_leader.set()
+
+
+def mk_model(name="m1", **kw):
+    kw.setdefault("url", "hf://a/b")
+    kw.setdefault("target_requests", 2)
+    kw.setdefault("min_replicas", 0)
+    kw.setdefault("max_replicas", 10)
+    return Model(meta=ObjectMeta(name=name), spec=ModelSpec(**kw))
+
+
+class FakeLB:
+    def get_self_ips(self):
+        return []
+
+
+def mk_autoscaler(store, peers=None, window=3, required=1):
+    mc = ModelClient(store, required_consecutive_scale_downs=lambda m: required)
+    return (
+        Autoscaler(
+            store,
+            mc,
+            FakeLB(),
+            AlwaysLeader,
+            interval_seconds=0.05,
+            average_window_count=window,
+            fixed_self_metric_addrs=peers or [],
+        ),
+        mc,
+    )
+
+
+class TestScalingMath:
+    def test_scales_up_from_peer_metrics(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model())
+        text = 'kubeai_inference_requests_active{request_model="m1",request_type="http"} 6\n'
+        p1, p2 = FakeMetricsPeer(text), FakeMetricsPeer(text)
+        try:
+            asc, _ = mk_autoscaler(store, [p1.addr, p2.addr], window=1)
+            asc.tick()
+            m = store.get(mt.KIND_MODEL, "m1")
+            # 6+6 active / target 2 = 6 replicas
+            assert m.spec.replicas == 6
+        finally:
+            p1.stop()
+            p2.stop()
+
+    def test_moving_average_smooths(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model())
+        peer = FakeMetricsPeer(
+            'kubeai_inference_requests_active{request_model="m1"} 6\n'
+        )
+        try:
+            asc, _ = mk_autoscaler(store, [peer.addr], window=3)
+            asc.tick()  # avg = 2 -> 1 replica
+            m = store.get(mt.KIND_MODEL, "m1")
+            assert m.spec.replicas == 1
+            asc.tick()
+            asc.tick()  # avg = 6 -> 3
+            m = store.get(mt.KIND_MODEL, "m1")
+            assert m.spec.replicas == 3
+        finally:
+            peer.stop()
+
+    def test_scale_to_zero_after_consecutive_downs(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model(replicas=2))
+        peer = FakeMetricsPeer("")  # no active requests anywhere
+        try:
+            asc, _ = mk_autoscaler(store, [peer.addr], window=1, required=2)
+            asc.tick()  # scale-down gate 1
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 2
+            asc.tick()  # gate 2
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 2
+            asc.tick()  # fires
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 0
+        finally:
+            peer.stop()
+
+    def test_autoscaling_disabled_untouched(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model(autoscaling_disabled=True, replicas=4))
+        peer = FakeMetricsPeer("")
+        try:
+            asc, _ = mk_autoscaler(store, [peer.addr], window=1)
+            asc.tick()
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 4
+        finally:
+            peer.stop()
+
+    def test_state_persists_and_preloads(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model())
+        peer = FakeMetricsPeer('kubeai_inference_requests_active{request_model="m1"} 4\n')
+        try:
+            asc, _ = mk_autoscaler(store, [peer.addr], window=2)
+            asc.tick()
+            state = store.get(KIND_STATE, "kubeai-autoscaler-state")
+            assert state.averages["m1"] == 2.0  # [4,0]/2
+
+            # A fresh autoscaler (restart) preloads the averages.
+            asc2, _ = mk_autoscaler(store, [peer.addr], window=2)
+            assert asc2._averages["m1"].calculate() == 2.0
+        finally:
+            peer.stop()
+
+    def test_engine_queue_signal_added(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model())
+        peer = FakeMetricsPeer('kubeai_inference_requests_active{request_model="m1"} 2\n')
+        try:
+            asc, _ = mk_autoscaler(store, [peer.addr], window=1)
+            asc.engine_queue_scrape = lambda name: 6.0
+            asc.tick()
+            # (2 + 6) / 2 = 4
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 4
+        finally:
+            peer.stop()
+
+
+class TestParse:
+    def test_parse_scraped_text_sums_types(self):
+        text = (
+            'kubeai_inference_requests_active{request_model="m",request_type="http"} 2\n'
+            'kubeai_inference_requests_active{request_model="m",request_type="messenger"} 3\n'
+        )
+        assert parse_scraped_text(text) == {"m": 5.0}
+
+
+class TestLeaderElection:
+    def test_single_leader_and_failover(self):
+        store = Store()
+        e1 = Election(store, "a", duration=0.4)
+        e2 = Election(store, "b", duration=0.4)
+        e1.start()
+        time.sleep(0.3)
+        e2.start()
+        try:
+            time.sleep(0.3)
+            assert e1.is_leader.is_set()
+            assert not e2.is_leader.is_set()
+            e1.stop()  # releases the lease
+            deadline = time.time() + 3
+            while time.time() < deadline and not e2.is_leader.is_set():
+                time.sleep(0.05)
+            assert e2.is_leader.is_set()
+        finally:
+            e1.stop()
+            e2.stop()

@@ -1,0 +1,196 @@
+"""Reconciler integration against the in-memory store (the envtest
+analogue: real controller, no kubelet — pod readiness forged by tests,
+cf. reference test/integration/utils_test.go markAllModelPodsReady)."""
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+
+@pytest.fixture
+def env():
+    store = Store()
+    system = System().default_and_validate()
+    rec = ModelReconciler(store, system)
+    return store, system, rec
+
+
+def mk_model(name="m1", **kw):
+    kw.setdefault("url", "hf://org/model")
+    kw.setdefault("engine", mt.ENGINE_TPU)
+    kw.setdefault("resource_profile", "tpu-v5e-1x1:1")
+    kw.setdefault("replicas", 1)
+    return Model(meta=ObjectMeta(name=name), spec=ModelSpec(**kw))
+
+
+def reconcile_until_settled(rec, name, n=5):
+    for _ in range(n):
+        rec.reconcile(name)
+
+
+class TestReconcile:
+    def test_creates_pods_with_tpu_resources(self, env):
+        store, system, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=2))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 2
+        server = pods[0].spec.containers[0]
+        assert server.resources_limits.get("google.com/tpu") == "1"
+        assert pods[0].spec.node_selector["cloud.google.com/gke-tpu-accelerator"] == "tpu-v5-lite-podslice"
+        assert "--served-model-name" in server.args
+
+    def test_feature_labels_applied_to_model(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model())
+        reconcile_until_settled(rec, "m1")
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert m.meta.labels.get(mt.LABEL_FEATURE_PREFIX + "TextGeneration") == "true"
+
+    def test_scale_up_down(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=1))
+        reconcile_until_settled(rec, "m1")
+        assert len(store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})) == 1
+
+        store.mutate(mt.KIND_MODEL, "m1", lambda m: setattr(m.spec, "replicas", 3))
+        reconcile_until_settled(rec, "m1")
+        assert len(store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})) == 3
+
+        store.mutate(mt.KIND_MODEL, "m1", lambda m: setattr(m.spec, "replicas", 0))
+        reconcile_until_settled(rec, "m1")
+        assert store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"}) == []
+
+    def test_replica_bounds_clamp(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=9, max_replicas=2))
+        reconcile_until_settled(rec, "m1")
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert m.spec.replicas == 2
+
+    def test_status_counts(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=2))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        # Forge readiness for one pod (the envtest seam).
+        store.mutate(KIND_POD, pods[0].meta.name, lambda p: setattr(p.status, "ready", True))
+        reconcile_until_settled(rec, "m1")
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert m.status.replicas_all == 2
+        assert m.status.replicas_ready == 1
+
+    def test_rollout_on_spec_change(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=2))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        for p in pods:
+            store.mutate(KIND_POD, p.meta.name, lambda p: setattr(p.status, "ready", True))
+        old_hashes = {p.meta.labels[mt.LABEL_POD_HASH] for p in pods}
+
+        store.mutate(mt.KIND_MODEL, "m1", lambda m: m.spec.args.append("--max-seq-len=4096"))
+        # Surge pod first.
+        rec.reconcile("m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 3
+        # Mark everything ready repeatedly; rollout converges to 2 new-hash.
+        for _ in range(8):
+            for p in store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"}):
+                try:
+                    store.mutate(KIND_POD, p.meta.name, lambda p: setattr(p.status, "ready", True))
+                except Exception:
+                    pass
+            rec.reconcile("m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 2
+        new_hashes = {p.meta.labels[mt.LABEL_POD_HASH] for p in pods}
+        assert new_hashes.isdisjoint(old_hashes)
+
+    def test_model_delete_cascades_pods(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=2))
+        reconcile_until_settled(rec, "m1")
+        store.delete(mt.KIND_MODEL, "m1")
+        assert store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"}) == []
+
+    def test_files_configmap(self, env):
+        from kubeai_tpu.api.model_types import File
+
+        store, _, rec = env
+        store.create(
+            mt.KIND_MODEL,
+            mk_model(files=[File(path="/cfg/prompt.txt", content="hello")]),
+        )
+        reconcile_until_settled(rec, "m1")
+        cm = store.get("ConfigMap", "model-m1-files")
+        assert cm.data == {"_cfg_prompt.txt": "hello"}
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        mounts = pods[0].spec.containers[0].volume_mounts
+        assert any(m.mount_path == "/cfg/prompt.txt" and m.sub_path == "_cfg_prompt.txt" for m in mounts)
+
+
+class TestMultiHostSlice:
+    def test_gang_creation_with_ranks(self, env):
+        store, system, rec = env
+        store.create(
+            mt.KIND_MODEL,
+            mk_model(resource_profile="tpu-v5e-4x4:1", replicas=2),
+        )
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 8  # 2 replicas x 4 hosts
+        by_slice = {}
+        for p in pods:
+            by_slice.setdefault(p.meta.labels["slice-id"], []).append(p)
+        assert len(by_slice) == 2
+        for gang in by_slice.values():
+            ranks = sorted(int(p.meta.labels["slice-rank"]) for p in gang)
+            assert ranks == [0, 1, 2, 3]
+            env0 = gang[0].spec.containers[0].env
+            assert env0["TPU_HOSTS_PER_REPLICA"] == "4"
+            assert len(env0["TPU_WORKER_HOSTNAMES"].split(",")) == 4
+
+    def test_gang_scale_down_removes_whole_gang(self, env):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(resource_profile="tpu-v5e-4x4:1", replicas=2))
+        reconcile_until_settled(rec, "m1")
+        store.mutate(mt.KIND_MODEL, "m1", lambda m: setattr(m.spec, "replicas", 1))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 4
+        assert len({p.meta.labels["slice-id"] for p in pods}) == 1
+
+
+class TestEngineMatrix:
+    @pytest.mark.parametrize(
+        "engine,url",
+        [
+            (mt.ENGINE_VLLM, "hf://org/model"),
+            (mt.ENGINE_OLLAMA, "ollama://qwen2:0.5b"),
+            (mt.ENGINE_FASTER_WHISPER, "hf://org/whisper"),
+            (mt.ENGINE_INFINITY, "hf://org/embed"),
+        ],
+    )
+    def test_pod_generated_per_engine(self, env, engine, url):
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(engine=engine, url=url, resource_profile="cpu:1"))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 1
+        assert pods[0].spec.containers[0].image
+
+    def test_json_patches_applied(self, env):
+        store, system, rec = env
+        system.model_server_pods.json_patches = [
+            {"op": "add", "path": "/spec/node_selector/custom", "value": "yes"}
+        ]
+        store.create(mt.KIND_MODEL, mk_model())
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert pods[0].spec.node_selector["custom"] == "yes"

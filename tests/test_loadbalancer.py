@@ -142,6 +142,38 @@ class TestAwaitEndpoints:
         assert errs
 
 
+class TestRetryExclusion:
+    def test_least_load_avoids_excluded(self):
+        g = make_group(["a", "b"])
+        for _ in range(10):
+            addr, done = g.get_best_addr(LEAST_LOAD, timeout=1, exclude={"a"})
+            assert addr == "b"
+            done()
+
+    def test_all_excluded_falls_back(self):
+        g = make_group(["a"])
+        addr, done = g.get_best_addr(LEAST_LOAD, timeout=1, exclude={"a"})
+        assert addr == "a"
+        done()
+
+    def test_prefix_hash_avoids_excluded(self):
+        g = make_group(["a", "b", "c"])
+        home, done = g.get_best_addr(PREFIX_HASH, prefix="conv", timeout=1)
+        done()
+        addr, done = g.get_best_addr(PREFIX_HASH, prefix="conv", timeout=1, exclude={home})
+        assert addr != home
+        done()
+
+    def test_least_load_random_tie_break(self):
+        g = make_group(["a", "b", "c"])
+        picks = set()
+        for _ in range(60):
+            addr, done = g.get_best_addr(LEAST_LOAD, timeout=1)
+            picks.add(addr)
+            done()
+        assert len(picks) == 3  # ties must not be deterministic
+
+
 class TestReconcile:
     def test_inflight_preserved_across_reconcile(self):
         g = make_group(["a"])

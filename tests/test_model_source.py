@@ -1,0 +1,50 @@
+import pytest
+
+from kubeai_tpu.controller.model_source import parse_model_source
+
+
+def test_hf():
+    s = parse_model_source("hf://meta-llama/Llama-3.1-8B")
+    assert s.scheme == "hf" and s.huggingface_repo == "meta-llama/Llama-3.1-8B"
+
+
+def test_hf_bad_shape():
+    with pytest.raises(ValueError):
+        parse_model_source("hf://onlyorg")
+
+
+def test_pvc_with_path():
+    s = parse_model_source("pvc://my-claim/models/llama")
+    assert s.pvc_name == "my-claim" and s.pvc_subpath == "models/llama"
+
+
+def test_pvc_bare():
+    s = parse_model_source("pvc://my-claim")
+    assert s.pvc_name == "my-claim" and s.pvc_subpath == ""
+
+
+def test_ollama_with_params():
+    s = parse_model_source("ollama://qwen2:0.5b?pull=always&insecure=true")
+    assert s.ollama_model == "qwen2:0.5b"
+    assert s.insecure is True and s.pull == "always"
+
+
+def test_s3():
+    s = parse_model_source("s3://bucket/path/to/model?model=sub")
+    assert s.bucket_url == "s3://bucket/path/to/model"
+    assert s.named_model == "sub"
+
+
+def test_gs_and_oss():
+    assert parse_model_source("gs://b/k").scheme == "gs"
+    assert parse_model_source("oss://b/k").scheme == "oss"
+
+
+def test_file():
+    s = parse_model_source("file:///tmp/ckpt")
+    assert s.local_path == "/tmp/ckpt"
+
+
+def test_unknown_scheme():
+    with pytest.raises(ValueError):
+        parse_model_source("ftp://nope")

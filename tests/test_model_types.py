@@ -1,0 +1,99 @@
+"""Model validation matrix (mirrors the reference's CEL validation tests,
+ref: test/integration/model_validation_test.go)."""
+
+import pytest
+
+from kubeai_tpu.api.model_types import (
+    Adapter,
+    File,
+    Model,
+    ModelSpec,
+    ValidationError,
+    validate_model,
+)
+
+
+def ok(**kw):
+    spec = ModelSpec(url="hf://org/model", **kw)
+    m = Model(spec=spec)
+    m.meta.name = "m"
+    validate_model(m)
+    return m
+
+
+def bad(match, **kw):
+    spec = ModelSpec(**{"url": "hf://org/model", **kw})
+    m = Model(spec=spec)
+    with pytest.raises(ValidationError, match=match):
+        validate_model(m)
+
+
+class TestURL:
+    def test_valid_schemes(self):
+        for url in [
+            "hf://a/b",
+            "pvc://claim/path",
+            "pvc://c",
+            "ollama://llama3",
+            "ollama://m:tag",
+            "s3://b/k",
+            "s3://bucket/deep/path",
+            "gs://b/k",
+            "oss://b/k",
+        ]:
+            validate_model(Model(spec=ModelSpec(url=url)))
+
+    def test_bad_scheme(self):
+        bad("schemes", url="ftp://nope")
+        bad("schemes", url="no-scheme")
+
+
+class TestAdapters:
+    def test_valid(self):
+        ok(adapters=[Adapter(name="fin-tune1", url="hf://a/b")])
+
+    def test_bad_name(self):
+        bad("adapter name", adapters=[Adapter(name="Bad_Name", url="hf://a/b")])
+        bad("adapter", adapters=[Adapter(name="", url="hf://a/b")])
+
+    def test_duplicate(self):
+        bad("duplicate", adapters=[Adapter(name="a1", url="hf://a/b"), Adapter(name="a1", url="hf://a/b")])
+
+    def test_bad_url(self):
+        bad("adapter url", adapters=[Adapter(name="a1", url="nope")])
+
+
+class TestFiles:
+    def test_max_ten(self):
+        bad("at most 10", files=[File(path=f"/f{i}", content="x") for i in range(11)])
+
+    def test_duplicate_path(self):
+        bad("duplicate", files=[File(path="/a", content="1"), File(path="/a", content="2")])
+
+    def test_content_cap(self):
+        bad("100k", files=[File(path="/a", content="x" * 100_001)])
+
+
+class TestReplicas:
+    def test_min_gt_max(self):
+        bad("minReplicas", min_replicas=5, max_replicas=2)
+
+    def test_profile_shape(self):
+        bad("resourceProfile", resource_profile="no-colon")
+        ok(resource_profile="tpu-v5e-1x1:1")
+
+
+class TestImmutability:
+    def test_url_immutable(self):
+        m1 = ok()
+        m2 = ok()
+        m2.spec.url = "hf://other/model"
+        with pytest.raises(ValidationError, match="immutable"):
+            validate_model(m2, prev=m1)
+
+    def test_engine_immutable(self):
+        m1 = ok()
+        m2 = ok()
+        m2.spec.engine = "OLlama"
+        with pytest.raises(ValidationError, match="immutable"):
+            validate_model(m2, prev=m1)

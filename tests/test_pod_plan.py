@@ -1,0 +1,110 @@
+"""Pod planner math (mirrors the reference's pod_plan_test coverage)."""
+
+import time
+
+from kubeai_tpu.api.core_types import Pod, PodStatus
+from kubeai_tpu.api.model_types import LABEL_POD_HASH, Model, ModelSpec
+from kubeai_tpu.controller.pod_plan import calculate_pod_plan, pod_spec_hash
+from kubeai_tpu.runtime.store import ObjectMeta
+
+
+def mk_model(replicas):
+    m = Model(spec=ModelSpec(url="hf://a/b", replicas=replicas))
+    m.meta.name = "m"
+    return m
+
+
+def mk_pod(name, hash_=None, ready=True, scheduled=True, age=100.0):
+    p = Pod(meta=ObjectMeta(name=name), status=PodStatus(ready=ready, scheduled=scheduled))
+    p.meta.creation_time = time.time() - age
+    if hash_:
+        p.meta.labels[LABEL_POD_HASH] = hash_
+    return p
+
+
+def desired():
+    return Pod()
+
+
+class TestScale:
+    def test_scale_up_from_zero(self):
+        plan = calculate_pod_plan([], mk_model(3), desired())
+        assert len(plan.to_create) == 3 and not plan.to_delete
+
+    def test_scale_down_to_zero(self):
+        h = pod_spec_hash(desired())
+        pods = [mk_pod(f"p{i}", h) for i in range(2)]
+        plan = calculate_pod_plan(pods, mk_model(0), desired())
+        assert len(plan.to_delete) == 2 and not plan.to_create
+
+    def test_at_scale_no_actions(self):
+        h = pod_spec_hash(desired())
+        pods = [mk_pod(f"p{i}", h) for i in range(2)]
+        plan = calculate_pod_plan(pods, mk_model(2), desired())
+        assert not plan.contains_actions()
+        assert len(plan.to_remain) == 2
+
+    def test_scale_down_prefers_not_ready_then_youngest(self):
+        h = pod_spec_hash(desired())
+        pods = [
+            mk_pod("old-ready", h, ready=True, age=1000),
+            mk_pod("young-ready", h, ready=True, age=10),
+            mk_pod("not-ready", h, ready=False, age=500),
+        ]
+        plan = calculate_pod_plan(pods, mk_model(1), desired())
+        deleted = {p.meta.name for p in plan.to_delete}
+        assert deleted == {"not-ready", "young-ready"}
+
+
+class TestRollout:
+    def test_hash_change_adds_surge_and_recreates_when_all_ready(self):
+        pods = [mk_pod(f"p{i}", "stale", ready=True) for i in range(2)]
+        plan = calculate_pod_plan(pods, mk_model(2), desired(), surge=1)
+        # Desired becomes 3 (2 + surge): create surge pod; no ready
+        # recreation yet because ready_all(2) != desired(3).
+        assert len(plan.to_create) == 1
+        assert not plan.to_delete
+
+    def test_rollout_recreates_one_ready_pod_when_all_ready(self):
+        h = pod_spec_hash(desired())
+        pods = [
+            mk_pod("new-0", h, ready=True),
+            mk_pod("stale-0", "stale", ready=True),
+            mk_pod("stale-1", "stale", ready=True),
+        ]
+        plan = calculate_pod_plan(pods, mk_model(2), desired(), surge=1)
+        # desired = 2 + 1 surge = 3 == len(pods); ready_all == 3 == desired
+        # -> delete ONE ready stale pod, recreate one.
+        assert len(plan.to_delete) == 1
+        assert plan.to_delete[0].meta.name.startswith("stale")
+        assert len(plan.to_create) == 1
+
+    def test_not_ready_stale_recreated_immediately(self):
+        h = pod_spec_hash(desired())
+        pods = [
+            mk_pod("new-0", h, ready=True),
+            mk_pod("stale-bad", "stale", ready=False),
+            mk_pod("stale-ok", "stale", ready=True),
+        ]
+        plan = calculate_pod_plan(pods, mk_model(2), desired(), surge=1)
+        deleted = {p.meta.name for p in plan.to_delete}
+        assert "stale-bad" in deleted
+
+    def test_rollout_completion_removes_surge(self):
+        h = pod_spec_hash(desired())
+        pods = [mk_pod(f"new-{i}", h, ready=True) for i in range(3)]
+        plan = calculate_pod_plan(pods, mk_model(2), desired(), surge=1)
+        # No out-of-date pods: desired back to 2, one pod deleted.
+        assert len(plan.to_delete) == 1
+        assert not plan.to_create
+
+
+class TestHash:
+    def test_hash_stable(self):
+        assert pod_spec_hash(desired()) == pod_spec_hash(desired())
+
+    def test_hash_sensitive_to_spec(self):
+        a = desired()
+        b = desired()
+        b.spec.node_selector["x"] = "y"
+        assert pod_spec_hash(a) != pod_spec_hash(b)

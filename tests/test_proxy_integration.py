@@ -1,0 +1,204 @@
+"""Integration: store + reconciler + LB + proxy + OpenAI server against
+fake engine backends (httptest-style), using the pod-address-override
+annotation seam — the analogue of the reference's envtest proxy tests
+(ref: test/integration/proxy_test.go:19-95, utils_test.go:118-159)."""
+
+import json
+import threading
+import time
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+from kubeai_tpu.proxy.handler import ModelProxy
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.proxy.server import OpenAIServer
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+
+class FakeEngine:
+    """Minimal engine-compatible backend recording requests."""
+
+    def __init__(self, fail_first: int = 0):
+        self.requests = []
+        self.fail_remaining = fail_first
+        outer = self
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                body = json.loads(self.rfile.read(n))
+                outer.requests.append((self.path, body))
+                if outer.fail_remaining > 0:
+                    outer.fail_remaining -= 1
+                    payload = json.dumps({"error": "boom"}).encode()
+                    self.send_response(503)
+                else:
+                    payload = json.dumps(
+                        {"choices": [{"text": f"ok:{body.get('model')}"}]}
+                    ).encode()
+                    self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(payload)))
+                self.end_headers()
+                self.wfile.write(payload)
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+@pytest.fixture
+def stack():
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=10)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    engines = []
+    yield store, rec, lb, mc, api, engines
+    api.stop()
+    lb.stop()
+    rec.stop()
+    for e in engines:
+        e.stop()
+
+
+def mk_model(name="m1", **kw):
+    kw.setdefault("url", "hf://org/model")
+    kw.setdefault("resource_profile", "cpu:1")
+    kw.setdefault("min_replicas", 0)
+    return Model(meta=ObjectMeta(name=name), spec=ModelSpec(**kw))
+
+
+def forge_ready(store, pod_name, engine: FakeEngine):
+    """Point a pod at a fake engine and mark it ready (the envtest seam)."""
+
+    def mutate(p):
+        p.status.ready = True
+        p.status.pod_ip = "127.0.0.1"
+        p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+        p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(engine.port)
+
+    store.mutate(KIND_POD, pod_name, mutate)
+
+
+def await_pods(store, model, n, timeout=5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: model})
+        if len(pods) == n:
+            return pods
+        time.sleep(0.05)
+    raise AssertionError(f"expected {n} pods for {model}")
+
+
+def post_completion(api, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestScaleFromZero:
+    def test_request_triggers_scale_and_blocks_until_ready(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model())
+        time.sleep(0.2)
+        assert store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"}) == []
+
+        eng = FakeEngine()
+        engines.append(eng)
+        result = {}
+
+        def client():
+            result["resp"] = post_completion(api, {"model": "m1", "prompt": "hi"})
+
+        t = threading.Thread(target=client)
+        t.start()
+        # The request should have scaled 0->1.
+        pods = await_pods(store, "m1", 1)
+        assert "resp" not in result  # blocked on endpoint
+        forge_ready(store, pods[0].meta.name, eng)
+        t.join(timeout=20)
+        status, body = result["resp"]
+        assert status == 200
+        assert body["choices"][0]["text"] == "ok:m1"
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert m.spec.replicas == 1
+
+    def test_unknown_model_404(self, stack):
+        _, _, _, _, api, _ = stack
+        status, body = post_completion(api, {"model": "ghost", "prompt": "x"})
+        assert status == 404
+
+    def test_retry_on_503_switches_endpoint(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=2, min_replicas=2))
+        pods = await_pods(store, "m1", 2)
+        bad = FakeEngine(fail_first=100)
+        good = FakeEngine()
+        engines += [bad, good]
+        forge_ready(store, pods[0].meta.name, bad)
+        forge_ready(store, pods[1].meta.name, good)
+        # LeastLoad may pick either first; retries must land on the good one.
+        for _ in range(4):
+            status, body = post_completion(api, {"model": "m1", "prompt": "x"})
+            assert status == 200
+
+    def test_models_endpoint_lists_adapters(self, stack):
+        store, _, _, _, api, _ = stack
+        from kubeai_tpu.api.model_types import Adapter
+
+        store.create(
+            mt.KIND_MODEL,
+            mk_model(adapters=[Adapter(name="ad1", url="hf://x/y")]),
+        )
+        time.sleep(0.2)
+        with urllib.request.urlopen(f"http://127.0.0.1:{api.port}/openai/v1/models", timeout=5) as resp:
+            data = json.loads(resp.read())
+        ids = {m["id"] for m in data["data"]}
+        assert ids == {"m1", "m1_ad1"}
+
+    def test_active_requests_gauge_drains(self, stack):
+        store, _, _, _, api, engines = stack
+        from kubeai_tpu.metrics import default_registry
+        from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
+
+        store.create(mt.KIND_MODEL, mk_model(name="m2", replicas=1, min_replicas=1))
+        pods = await_pods(store, "m2", 1)
+        eng = FakeEngine()
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        for _ in range(3):
+            status, _ = post_completion(api, {"model": "m2", "prompt": "x"})
+            assert status == 200
+        g = default_registry.gauge(ACTIVE_REQUESTS)
+        assert g.value(labels={"request_model": "m2", "request_type": "http"}) == 0
