@@ -181,30 +181,41 @@ def _snake(k: str) -> str:
 def _build(cls, data):
     """Recursively build a dataclass from a (camelCase or snake_case) dict."""
     import dataclasses
+    import typing
 
     if not dataclasses.is_dataclass(cls) or not isinstance(data, dict):
         return data
     fields = {f.name: f for f in dataclasses.fields(cls)}
+    # PEP 563 (future annotations) stores field types as strings; resolve.
+    hints = typing.get_type_hints(cls)
     kwargs = {}
     for k, v in data.items():
         name = _snake(k)
         f = fields.get(name)
         if f is None:
             raise ValueError(f"unknown config field {k!r} for {cls.__name__}")
-        ftype = f.type if isinstance(f.type, type) else None
-        if ftype is not None and dataclasses.is_dataclass(ftype):
-            kwargs[name] = _build(ftype, v)
-        elif name == "resource_profiles":
-            kwargs[name] = {n: _build(ResourceProfile, p) for n, p in v.items()}
-        elif name == "cache_profiles":
-            kwargs[name] = {n: _build(CacheProfile, p) for n, p in v.items()}
-        elif name == "engine_images":
-            kwargs[name] = {n: _build(EngineImages, p) for n, p in v.items()}
-        elif name == "streams":
-            kwargs[name] = [_build(MessageStream, s) for s in v]
-        else:
-            kwargs[name] = v
+        kwargs[name] = _coerce(hints.get(name), v)
     return cls(**kwargs)
+
+
+def _coerce(ftype, v):
+    """Coerce a parsed value to its (resolved) field type: nested
+    dataclasses, dict[str, Dataclass], and list[Dataclass] are built
+    recursively; everything else passes through."""
+    import dataclasses
+    import typing
+
+    if ftype is None:
+        return v
+    if dataclasses.is_dataclass(ftype):
+        return _build(ftype, v)
+    origin = typing.get_origin(ftype)
+    args = typing.get_args(ftype)
+    if origin is dict and args and dataclasses.is_dataclass(args[1]) and isinstance(v, dict):
+        return {k: _build(args[1], item) for k, item in v.items()}
+    if origin is list and args and dataclasses.is_dataclass(args[0]) and isinstance(v, list):
+        return [_build(args[0], item) for item in v]
+    return v
 
 
 def load_system_config(path: str | None = None, data: dict | None = None) -> System:

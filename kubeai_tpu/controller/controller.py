@@ -103,6 +103,8 @@ class ModelReconciler:
 
         cfg = self.resolve_pod_config(model)
         desired = engines.pod_for_model(model, cfg)
+        if cfg.cache_mount_path:
+            self._patch_cache_mount(desired, model)
         patch_file_volumes(desired, model)
         if self.adapter_reconciler is not None and model.spec.adapters:
             self.adapter_reconciler.patch_loader_sidecar(desired, model)
@@ -270,9 +272,29 @@ class ModelReconciler:
                 except Conflict:
                     pass
 
+    def _patch_cache_mount(self, pod, model: Model) -> None:
+        """Server pods mount the shared cache PVC read-only at the model's
+        cache dir (ref: cache.go:436-458)."""
+        from kubeai_tpu.api.core_types import Volume, VolumeMount
+        from kubeai_tpu.controller.cache import pvc_name
+
+        pod.spec.volumes.append(
+            Volume(name="model-cache", pvc_name=pvc_name(model.spec.cache_profile))
+        )
+        pod.spec.containers[0].volume_mounts.append(
+            VolumeMount(
+                name="model-cache",
+                mount_path=self.cache_reconciler.model_cache_dir(model),
+                sub_path=f"{model.meta.name}-{model.meta.uid}",
+                read_only=True,
+            )
+        )
+
     def _finalize(self, model: Model) -> None:
         """Deletion: drop server pods, run cache finalizer
         (ref: model_controller.go:112-133)."""
+        from kubeai_tpu.controller.cache import CACHE_FINALIZER
+
         self.store.delete_all_of(KIND_POD, model.meta.namespace, {mt.LABEL_MODEL: model.meta.name})
         if self.cache_reconciler is not None and model.spec.cache_profile:
             if not self.cache_reconciler.finalize(model):
@@ -285,6 +307,3 @@ class ModelReconciler:
             self.store.mutate(mt.KIND_MODEL, model.meta.name, mutate, model.meta.namespace)
         except NotFound:
             pass
-
-
-CACHE_FINALIZER = "kubeai.org/cache-eviction"

@@ -28,7 +28,9 @@ def tpu_engine_pod_for_model(model, cfg: ModelPodConfig) -> Pod:
     elif src.scheme == "pvc":
         model_arg = "/model"
     elif src.scheme == "file":
-        model_arg = "/model"
+        # Host path works identically in LocalRuntime (no mounts) and in
+        # cluster mode (hostPath volume mounted at the same path).
+        model_arg = src.local_path
     elif src.scheme in ("s3", "gs", "oss"):
         # Weights staged to local SSD by the loader init container / cache.
         model_arg = cfg.cache_mount_path or "/model"

@@ -105,8 +105,11 @@ def source_pod_additions(src: ModelSource, secrets: SecretNames) -> SourcePodAdd
             )
         )
     elif src.scheme == "file":
+        # Mounted at the same path as on the host so the container arg
+        # (--model <path>) is valid in both cluster mode and LocalRuntime
+        # (which has no mounts at all).
         add.volumes.append(Volume(name="model-source", host_path=src.local_path))
-        add.mounts.append(VolumeMount(name="model-source", mount_path="/model"))
+        add.mounts.append(VolumeMount(name="model-source", mount_path=src.local_path))
     return add
 
 

@@ -356,6 +356,16 @@ def build_engine_from_args(args) -> tuple[Engine, str]:
 
 
 def main(argv=None):
+    # Honor JAX_PLATFORMS explicitly: plugin registration can override the
+    # env var, and config only works before the first backend query.
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+
     parser = argparse.ArgumentParser("kubeai-tpu-engine")
     parser.add_argument("--model", required=True, help="checkpoint dir or test:tiny")
     parser.add_argument("--served-model-name", default=None)

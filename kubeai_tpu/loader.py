@@ -1,0 +1,89 @@
+"""Model loader — stages weights from a source URL into a destination dir.
+
+The TPU-native counterpart of the reference's model-loader container
+(ref: components/model-loader/load.sh:20-67 + Dockerfile: a bash script
+over huggingface-cli/awscli/gcloud/ossutil). Used by cache loader Jobs
+and the adapter loader sidecar.
+
+    python -m kubeai_tpu.loader <src-url> <dest-dir>
+    python -m kubeai_tpu.loader --evict <dir>
+
+Schemes: file:// and pvc:// copy locally; hf:// uses huggingface_hub;
+s3:// gs:// oss:// shell out to their CLIs when present. Destination is
+written atomically (tmp dir + rename) so a crashed load never looks
+complete.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+from kubeai_tpu.controller.model_source import parse_model_source
+
+
+def _atomic_dest(dest: str):
+    os.makedirs(os.path.dirname(dest.rstrip("/")) or "/", exist_ok=True)
+    return tempfile.mkdtemp(prefix=os.path.basename(dest.rstrip("/")) + ".tmp.", dir=os.path.dirname(dest.rstrip("/")))
+
+
+def load(src_url: str, dest: str) -> None:
+    src = parse_model_source(src_url)
+    if os.path.isdir(dest) and os.listdir(dest):
+        print(f"destination {dest} already populated; nothing to do")
+        return
+    tmp = _atomic_dest(dest)
+    try:
+        if src.scheme in ("file", "pvc"):
+            source_dir = src.local_path if src.scheme == "file" else f"/model/{src.pvc_subpath}"
+            shutil.copytree(source_dir, tmp, dirs_exist_ok=True)
+        elif src.scheme == "hf":
+            from huggingface_hub import snapshot_download
+
+            snapshot_download(repo_id=src.huggingface_repo, local_dir=tmp)
+        elif src.scheme == "s3":
+            subprocess.run(["aws", "s3", "sync", src.bucket_url, tmp], check=True)
+        elif src.scheme == "gs":
+            subprocess.run(["gcloud", "storage", "cp", "-r", src.bucket_url + "/*", tmp], check=True)
+        elif src.scheme == "oss":
+            subprocess.run(["ossutil", "cp", "-r", src.bucket_url, tmp], check=True)
+        else:
+            raise ValueError(f"loader does not support scheme {src.scheme!r}")
+        if os.path.isdir(dest):
+            shutil.rmtree(dest)
+        os.rename(tmp, dest)
+        tmp = None
+        print(f"loaded {src_url} -> {dest}")
+    finally:
+        if tmp and os.path.isdir(tmp):
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+def evict(dest: str) -> None:
+    if os.path.isdir(dest):
+        shutil.rmtree(dest)
+        print(f"evicted {dest}")
+    else:
+        print(f"{dest} already absent")
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("kubeai-tpu-loader")
+    parser.add_argument("--evict", action="store_true")
+    parser.add_argument("src_or_dir")
+    parser.add_argument("dest", nargs="?")
+    args = parser.parse_args(argv)
+    if args.evict:
+        evict(args.src_or_dir)
+    else:
+        if not args.dest:
+            parser.error("dest required")
+        load(args.src_or_dir, args.dest)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,144 @@
+"""Process manager: constructs and wires every component.
+
+Parity: internal/manager/run.go:77-406 — builds the store/client, leader
+election, load balancer, model reconciler, autoscaler, proxy + OpenAI
+server, messengers, and (local mode, new) the LocalRuntime pod executor;
+starts each as a daemon thread and tears them down in reverse.
+
+CLI (the operator container entrypoint, ref: cmd/main.go):
+    python -m kubeai_tpu.manager --config sys.yaml [--local] [--port 8000]
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import time
+import uuid
+
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.leader import Election
+from kubeai_tpu.config.system import System, load_system_config
+from kubeai_tpu.controller.cache import CacheReconciler
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+from kubeai_tpu.messenger.messenger import Messenger
+from kubeai_tpu.proxy.handler import ModelProxy
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.proxy.server import OpenAIServer
+from kubeai_tpu.runtime.local import LocalRuntime
+from kubeai_tpu.runtime.store import Store
+
+log = logging.getLogger("kubeai_tpu.manager")
+
+
+class Manager:
+    def __init__(
+        self,
+        system: System | None = None,
+        store: Store | None = None,
+        local_runtime: bool = False,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        namespace: str = "default",
+    ):
+        self.system = (system or System()).default_and_validate()
+        self.store = store or Store()
+        self.namespace = namespace
+
+        identity = f"kubeai-{uuid.uuid4().hex[:8]}"
+        self.election = Election(
+            self.store, identity, duration=self.system.leader_election_lease_seconds,
+            namespace=namespace,
+        )
+        self.model_client = ModelClient(
+            self.store,
+            namespace,
+            required_consecutive_scale_downs=lambda m: self.system.autoscaling.consecutive_scale_downs_for(
+                m.spec.scale_down_delay_seconds
+            ),
+        )
+        self.lb = LoadBalancer(self.store, self.system.allow_pod_address_override)
+        self.cache_reconciler = CacheReconciler(self.store, self.system, namespace)
+        self.reconciler = ModelReconciler(
+            self.store, self.system, cache_reconciler=self.cache_reconciler
+        )
+        self.autoscaler = Autoscaler(
+            self.store,
+            self.model_client,
+            self.lb,
+            self.election,
+            interval_seconds=self.system.autoscaling.interval_seconds,
+            average_window_count=self.system.autoscaling.average_window_count,
+            fixed_self_metric_addrs=self.system.fixed_self_metric_addrs,
+            state_name=self.system.autoscaling.state_config_map_name,
+            namespace=namespace,
+        )
+        self.proxy = ModelProxy(self.model_client, self.lb)
+        self.api = OpenAIServer(self.proxy, self.model_client, host=host, port=port)
+        self.messengers = [
+            Messenger(
+                stream.requests_url,
+                stream.responses_url,
+                max_handlers=stream.max_handlers,
+                model_client=self.model_client,
+                lb=self.lb,
+                error_max_backoff=self.system.messaging_error_max_backoff_seconds,
+            )
+            for stream in self.system.streams
+        ]
+        self.local_runtime = LocalRuntime(self.store, namespace) if local_runtime else None
+
+    def start(self):
+        self.lb.start()
+        self.reconciler.start()
+        self.election.start()
+        self.autoscaler.start()
+        if self.local_runtime:
+            self.local_runtime.start()
+        for m in self.messengers:
+            m.start()
+        self.api.start()
+        log.info("manager up: api :%d", self.api.port)
+
+    def stop(self):
+        for m in self.messengers:
+            m.stop()
+        self.api.stop()
+        if self.local_runtime:
+            self.local_runtime.stop()
+        self.autoscaler.stop()
+        self.election.stop()
+        self.reconciler.stop()
+        self.lb.stop()
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("kubeai-tpu-manager")
+    parser.add_argument("--config", default=os.environ.get("CONFIG_PATH"))
+    parser.add_argument("--local", action="store_true", help="run pods as local processes")
+    parser.add_argument("--host", default="0.0.0.0")
+    parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument("--models", default=None, help="YAML file of Model manifests to apply at boot")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    system = load_system_config(args.config) if args.config else System().default_and_validate()
+    mgr = Manager(system, local_runtime=args.local, host=args.host, port=args.port)
+    mgr.start()
+
+    if args.models:
+        from kubeai_tpu.catalog import apply_manifest_file
+
+        apply_manifest_file(mgr.store, args.models)
+
+    try:
+        while True:
+            time.sleep(3600)
+    except KeyboardInterrupt:
+        mgr.stop()
+
+
+if __name__ == "__main__":
+    main()

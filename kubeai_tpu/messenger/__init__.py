@@ -1,0 +1,4 @@
+from kubeai_tpu.messenger.messenger import Messenger
+from kubeai_tpu.messenger.drivers import open_subscription, open_topic
+
+__all__ = ["Messenger", "open_topic", "open_subscription"]

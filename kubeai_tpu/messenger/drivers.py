@@ -1,0 +1,181 @@
+"""Pub/sub drivers behind one Topic/Subscription interface.
+
+The reference rides gocloud.dev with AWS SNS/SQS, Azure SB, GCP Pub/Sub,
+Kafka, NATS, RabbitMQ drivers (ref: internal/manager/run.go:47-53,
+internal/messenger/messenger.go). Here the interface is the same shape
+with two built-in drivers:
+
+    mem://<name>    in-process queues (tests/dev; parity with the
+                    reference integration tests' mem:// driver)
+    file://<dir>    spool-directory queues (cross-process on one host)
+
+Cloud drivers (gcppubsub://, kafka://, ...) register via
+`register_driver` — deployments bring their client library; the scheme
+registry keeps them out of the core's import path.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import queue
+import threading
+import time
+import uuid
+from urllib.parse import urlparse
+
+
+class Message:
+    def __init__(self, body: bytes, ack=None, nack=None):
+        self.body = body
+        self._ack = ack or (lambda: None)
+        self._nack = nack or (lambda: None)
+
+    def ack(self):
+        self._ack()
+
+    def nack(self):
+        self._nack()
+
+
+class Topic:
+    def send(self, body: bytes) -> None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+class Subscription:
+    def receive(self, timeout: float | None = None) -> Message | None:
+        raise NotImplementedError
+
+    def close(self) -> None:
+        pass
+
+
+# -- mem:// -----------------------------------------------------------------
+
+_mem_lock = threading.Lock()
+_mem_queues: dict[str, "queue.Queue[bytes]"] = {}
+
+
+def _mem_queue(name: str) -> "queue.Queue[bytes]":
+    with _mem_lock:
+        q = _mem_queues.get(name)
+        if q is None:
+            q = queue.Queue()
+            _mem_queues[name] = q
+        return q
+
+
+class MemTopic(Topic):
+    def __init__(self, name: str):
+        self._q = _mem_queue(name)
+
+    def send(self, body: bytes) -> None:
+        self._q.put(body)
+
+
+class MemSubscription(Subscription):
+    def __init__(self, name: str):
+        self._q = _mem_queue(name)
+
+    def receive(self, timeout: float | None = None) -> Message | None:
+        try:
+            body = self._q.get(timeout=timeout)
+        except queue.Empty:
+            return None
+        # Nack re-queues (at-least-once semantics).
+        return Message(body, nack=lambda: self._q.put(body))
+
+
+# -- file:// ----------------------------------------------------------------
+
+
+class FileTopic(Topic):
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def send(self, body: bytes) -> None:
+        name = f"{time.time_ns():020d}-{uuid.uuid4().hex[:8]}"
+        tmp = os.path.join(self.dir, f".tmp-{name}")
+        with open(tmp, "wb") as f:
+            f.write(body)
+        os.rename(tmp, os.path.join(self.dir, name + ".msg"))
+
+
+class FileSubscription(Subscription):
+    def __init__(self, directory: str):
+        self.dir = directory
+        os.makedirs(directory, exist_ok=True)
+
+    def receive(self, timeout: float | None = None) -> Message | None:
+        deadline = None if timeout is None else time.monotonic() + timeout
+        while True:
+            for name in sorted(os.listdir(self.dir)):
+                if not name.endswith(".msg"):
+                    continue
+                path = os.path.join(self.dir, name)
+                claimed = path + ".claimed"
+                try:
+                    os.rename(path, claimed)  # atomic claim
+                except OSError:
+                    continue
+                with open(claimed, "rb") as f:
+                    body = f.read()
+
+                def ack(p=claimed):
+                    try:
+                        os.unlink(p)
+                    except OSError:
+                        pass
+
+                def nack(p=claimed, orig=path):
+                    try:
+                        os.rename(p, orig)
+                    except OSError:
+                        pass
+
+                return Message(body, ack=ack, nack=nack)
+            if deadline is not None and time.monotonic() >= deadline:
+                return None
+            time.sleep(0.05)
+
+
+# -- registry ---------------------------------------------------------------
+
+_DRIVERS: dict[str, tuple] = {}
+
+
+def register_driver(scheme: str, topic_factory, subscription_factory):
+    _DRIVERS[scheme] = (topic_factory, subscription_factory)
+
+
+register_driver("mem", lambda ref: MemTopic(ref), lambda ref: MemSubscription(ref))
+register_driver("file", lambda ref: FileTopic(ref), lambda ref: FileSubscription(ref))
+
+
+def _split(url: str) -> tuple[str, str]:
+    parsed = urlparse(url)
+    if not parsed.scheme:
+        raise ValueError(f"pubsub url missing scheme: {url!r}")
+    ref = (parsed.netloc + parsed.path).rstrip("/")
+    if parsed.scheme == "file":
+        ref = parsed.path
+    return parsed.scheme, ref
+
+
+def open_topic(url: str) -> Topic:
+    scheme, ref = _split(url)
+    if scheme not in _DRIVERS:
+        raise ValueError(f"no pubsub driver for scheme {scheme!r}")
+    return _DRIVERS[scheme][0](ref)
+
+
+def open_subscription(url: str) -> Subscription:
+    scheme, ref = _split(url)
+    if scheme not in _DRIVERS:
+        raise ValueError(f"no pubsub driver for scheme {scheme!r}")
+    return _DRIVERS[scheme][1](ref)

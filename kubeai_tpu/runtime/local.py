@@ -1,0 +1,236 @@
+"""LocalRuntime — executes Pod objects as local subprocesses.
+
+The kubelet substitute for cluster-less operation (dev boxes, single
+TPU-VM deployments, e2e tests): watches Pods in the store, launches the
+server container's command as a subprocess (rewriting the port to a free
+one), marks the pod Ready when its /health endpoint answers, and kills
+the process on pod deletion. The reference has no analogue — it always
+needs a cluster; this makes the whole operator stack self-hosting on one
+machine.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import signal
+import socket
+import subprocess
+import threading
+import time
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_JOB, KIND_POD, Pod
+from kubeai_tpu.runtime.store import NotFound, Store
+
+log = logging.getLogger("kubeai_tpu.localruntime")
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+class LocalProcess:
+    def __init__(self, pod_name: str, proc: subprocess.Popen, port: int):
+        self.pod_name = pod_name
+        self.proc = proc
+        self.port = port
+        self.ready = False
+
+
+class LocalRuntime:
+    def __init__(self, store: Store, namespace: str = "default", repo_root: str | None = None, extra_env: dict[str, str] | None = None):
+        self.store = store
+        self.namespace = namespace
+        self.repo_root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        self.extra_env = extra_env or {}
+        self._procs: dict[str, LocalProcess] = {}
+        self._lock = threading.Lock()
+        self._running = False
+        self._threads: list[threading.Thread] = []
+
+    def start(self):
+        self._running = True
+        t = threading.Thread(target=self._watch_loop, name="local-runtime", daemon=True)
+        t.start()
+        self._threads.append(t)
+        t2 = threading.Thread(target=self._health_loop, name="local-runtime-health", daemon=True)
+        t2.start()
+        self._threads.append(t2)
+
+    def stop(self):
+        self._running = False
+        with self._lock:
+            procs = list(self._procs.values())
+            self._procs.clear()
+        for lp in procs:
+            self._kill(lp)
+        for t in self._threads:
+            t.join(timeout=5)
+
+    # -- pod lifecycle -----------------------------------------------------
+
+    def _watch_loop(self):
+        q = self.store.watch()  # Pods AND Jobs
+        while self._running:
+            try:
+                ev = q.get(timeout=0.1)
+            except Exception:
+                continue
+            try:
+                if ev.kind == KIND_POD:
+                    if ev.type == "ADDED":
+                        self._launch(ev.obj)
+                    elif ev.type == "DELETED":
+                        with self._lock:
+                            lp = self._procs.pop(ev.obj.meta.name, None)
+                        if lp:
+                            self._kill(lp)
+                elif ev.kind == KIND_JOB and ev.type == "ADDED":
+                    self._run_job(ev.obj)
+            except Exception:
+                log.exception("pod event handling failed")
+
+    def _run_job(self, job):
+        """Execute a Job's container to completion in a worker thread and
+        record success/failure in its status (the kubelet's job controller
+        analogue; cache loader/eviction Jobs run through this)."""
+        if not job.spec.containers:
+            return
+        server = job.spec.containers[0]
+        cmd = list(server.command) + list(server.args)
+        env = dict(os.environ)
+        env.update({k: v for k, v in server.env.items() if not k.startswith("__envFromSecret_")})
+        env.update(self.extra_env)
+        env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
+
+        def run():
+            try:
+                rc = subprocess.run(
+                    cmd, env=env, stdout=subprocess.DEVNULL, stderr=subprocess.STDOUT
+                ).returncode
+            except OSError as e:
+                log.error("job %s failed to start: %s", job.meta.name, e)
+                rc = 127
+
+            def mutate(j):
+                if rc == 0:
+                    j.status.succeeded += 1
+                else:
+                    j.status.failed += 1
+
+            try:
+                self.store.mutate(KIND_JOB, job.meta.name, mutate, job.meta.namespace)
+            except NotFound:
+                pass
+
+        t = threading.Thread(target=run, name=f"job-{job.meta.name}", daemon=True)
+        t.start()
+        self._threads.append(t)
+
+    def _launch(self, pod: Pod):
+        with self._lock:
+            if pod.meta.name in self._procs:
+                return
+        if not pod.spec.containers:
+            return
+        server = pod.spec.containers[0]
+        cmd = list(server.command) + list(server.args)
+        if not cmd:
+            return
+        port = free_port()
+        cmd = self._rewrite_port(cmd, port)
+        env = dict(os.environ)
+        env.update({k: v for k, v in server.env.items() if not k.startswith("__envFromSecret_")})
+        env.update(self.extra_env)
+        env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        log.info("launching pod %s: %s (port %d)", pod.meta.name, " ".join(cmd[:4]), port)
+        try:
+            proc = subprocess.Popen(
+                cmd,
+                env=env,
+                stdout=subprocess.DEVNULL,
+                stderr=subprocess.STDOUT,
+                start_new_session=True,
+            )
+        except OSError as e:
+            log.error("failed to launch pod %s: %s", pod.meta.name, e)
+            self._set_status(pod.meta.name, phase="Failed")
+            return
+        with self._lock:
+            self._procs[pod.meta.name] = LocalProcess(pod.meta.name, proc, port)
+        self._set_status(pod.meta.name, phase="Running", scheduled=True, pod_ip="127.0.0.1", port=port)
+
+    @staticmethod
+    def _rewrite_port(cmd: list[str], port: int) -> list[str]:
+        out = []
+        i = 0
+        replaced = False
+        while i < len(cmd):
+            if cmd[i] == "--port" and i + 1 < len(cmd):
+                out += ["--port", str(port)]
+                i += 2
+                replaced = True
+                continue
+            out.append(cmd[i])
+            i += 1
+        if not replaced:
+            out += ["--port", str(port)]
+        return out
+
+    def _kill(self, lp: LocalProcess):
+        try:
+            os.killpg(os.getpgid(lp.proc.pid), signal.SIGKILL)
+        except (ProcessLookupError, PermissionError):
+            pass
+        lp.proc.wait(timeout=5)
+
+    # -- readiness ---------------------------------------------------------
+
+    def _health_loop(self):
+        import urllib.request
+
+        while self._running:
+            time.sleep(0.25)
+            with self._lock:
+                procs = list(self._procs.values())
+            for lp in procs:
+                if lp.proc.poll() is not None:
+                    log.warning("pod process %s exited (%s)", lp.pod_name, lp.proc.returncode)
+                    with self._lock:
+                        self._procs.pop(lp.pod_name, None)
+                    self._set_status(lp.pod_name, phase="Failed", ready=False)
+                    continue
+                if lp.ready:
+                    continue
+                try:
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{lp.port}/health", timeout=1
+                    ) as resp:
+                        if resp.status == 200:
+                            lp.ready = True
+                            self._set_status(
+                                lp.pod_name, ready=True, pod_ip="127.0.0.1", port=lp.port
+                            )
+                except Exception:
+                    pass
+
+    def _set_status(self, pod_name: str, phase: str | None = None, ready: bool | None = None, scheduled: bool | None = None, pod_ip: str | None = None, port: int | None = None):
+        def mutate(p):
+            if phase is not None:
+                p.status.phase = phase
+            if ready is not None:
+                p.status.ready = ready
+            if scheduled is not None:
+                p.status.scheduled = scheduled
+            if pod_ip is not None:
+                p.status.pod_ip = pod_ip
+            if port is not None:
+                p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(port)
+
+        try:
+            self.store.mutate(KIND_POD, pod_name, mutate, self.namespace)
+        except NotFound:
+            pass

@@ -1,0 +1,111 @@
+"""Cache subsystem: PVC + loader Job + annotation protocol + finalizer,
+with Job completion forged by the test (the reference's envtest seam,
+ref: test/integration/cache_shared_filesystem_test.go)."""
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_JOB, KIND_POD, KIND_PVC
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.config.system import CacheProfile, System
+from kubeai_tpu.controller.cache import CACHE_FINALIZER, CacheReconciler
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.runtime.store import NotFound, ObjectMeta, Store
+
+
+@pytest.fixture
+def env():
+    store = Store()
+    system = System().default_and_validate()
+    system.cache_profiles["efs"] = CacheProfile(shared_filesystem_storage_class="efs")
+    cache = CacheReconciler(store, system)
+    rec = ModelReconciler(store, system, cache_reconciler=cache)
+    return store, system, cache, rec
+
+
+def mk_model(**kw):
+    kw.setdefault("url", "hf://org/model")
+    kw.setdefault("resource_profile", "cpu:1")
+    kw.setdefault("cache_profile", "efs")
+    kw.setdefault("replicas", 1)
+    return Model(meta=ObjectMeta(name="m1"), spec=ModelSpec(**kw))
+
+
+def complete_job(store, name):
+    store.mutate(KIND_JOB, name, lambda j: setattr(j.status, "succeeded", 1))
+
+
+class TestCacheLoad:
+    def test_pods_gated_until_cache_loaded(self, env):
+        store, _, cache, rec = env
+        store.create(mt.KIND_MODEL, mk_model())
+        rec.reconcile("m1")
+        rec.reconcile("m1")
+        # No server pods yet; loader job created; PVC exists.
+        assert store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"}) == []
+        job = store.get(KIND_JOB, "load-cache-m1")
+        assert "kubeai_tpu.loader" in job.spec.containers[0].command
+        assert store.get(KIND_PVC, "model-cache-efs")
+
+        complete_job(store, "load-cache-m1")
+        rec.reconcile("m1")
+        rec.reconcile("m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert len(pods) == 1
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert m.status.cache_loaded
+        # Loader job cleaned up; annotation on PVC.
+        with pytest.raises(NotFound):
+            store.get(KIND_JOB, "load-cache-m1")
+        pvc = store.get(KIND_PVC, "model-cache-efs")
+        assert any(k.startswith("cache-loaded.kubeai.org/") for k in pvc.meta.annotations)
+
+    def test_server_pod_mounts_cache(self, env):
+        store, _, cache, rec = env
+        store.create(mt.KIND_MODEL, mk_model())
+        rec.reconcile("m1")
+        rec.reconcile("m1")
+        complete_job(store, "load-cache-m1")
+        rec.reconcile("m1")
+        pod = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})[0]
+        m = store.get(mt.KIND_MODEL, "m1")
+        mounts = pod.spec.containers[0].volume_mounts
+        cache_dir = cache.model_cache_dir(m)
+        assert any(v.mount_path == cache_dir for v in mounts)
+        assert any(v.pvc_name == "model-cache-efs" for v in pod.spec.volumes)
+
+    def test_finalizer_added(self, env):
+        store, _, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model())
+        rec.reconcile("m1")
+        rec.reconcile("m1")
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert CACHE_FINALIZER in m.meta.finalizers
+
+
+class TestCacheEviction:
+    def _loaded_model(self, env):
+        store, _, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model())
+        rec.reconcile("m1")
+        rec.reconcile("m1")
+        complete_job(store, "load-cache-m1")
+        rec.reconcile("m1")
+        return store, rec
+
+    def test_delete_runs_eviction_then_releases(self, env):
+        store, rec = self._loaded_model(env)
+        store.delete(mt.KIND_MODEL, "m1")
+        # Finalizer holds the object; eviction job spawned.
+        m = store.get(mt.KIND_MODEL, "m1")
+        assert m.meta.deletion_timestamp is not None
+        rec.reconcile("m1")
+        job = store.get(KIND_JOB, "evict-cache-m1")
+        assert "--evict" in job.spec.containers[0].command
+
+        complete_job(store, "evict-cache-m1")
+        rec.reconcile("m1")
+        with pytest.raises(NotFound):
+            store.get(mt.KIND_MODEL, "m1")
+        pvc = store.get(KIND_PVC, "model-cache-efs")
+        assert not any(k.startswith("cache-loaded.kubeai.org/") for k in pvc.meta.annotations)

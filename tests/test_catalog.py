@@ -1,0 +1,62 @@
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.catalog import (
+    CATALOG,
+    apply_catalog,
+    model_from_catalog,
+    model_from_manifest,
+)
+from kubeai_tpu.runtime.store import Store
+
+
+def test_all_catalog_entries_validate():
+    for name in CATALOG:
+        m = model_from_catalog(name)
+        assert m.spec.url
+
+
+def test_apply_catalog_idempotent():
+    store = Store()
+    first = apply_catalog(store, ["gemma-2b-it-tpu"])
+    again = apply_catalog(store, ["gemma-2b-it-tpu"])
+    assert len(first) == 1 and again == []
+
+
+def test_manifest_with_nested_fields():
+    m = model_from_manifest(
+        {
+            "apiVersion": "kubeai.org/v1",
+            "kind": "Model",
+            "metadata": {"name": "mani", "namespace": "prod"},
+            "spec": {
+                "url": "hf://a/b",
+                "engine": "TPUEngine",
+                "resourceProfile": "tpu-v5e-1x1:1",
+                "minReplicas": 1,
+                "loadBalancing": {
+                    "strategy": "PrefixHash",
+                    "prefixHash": {"meanLoadFactor": 150, "prefixCharLength": 50},
+                },
+                "adapters": [{"name": "ad1", "url": "hf://c/d"}],
+                "files": [{"path": "/etc/x", "content": "y"}],
+            },
+        }
+    )
+    assert m.meta.namespace == "prod"
+    assert m.spec.load_balancing.strategy == mt.PREFIX_HASH_STRATEGY
+    assert m.spec.load_balancing.prefix_hash.mean_load_percentage == 150
+    assert m.spec.adapters[0].name == "ad1"
+    assert m.spec.files[0].path == "/etc/x"
+
+
+def test_manifest_unknown_field_rejected():
+    with pytest.raises(ValueError, match="unknown config field"):
+        model_from_manifest(
+            {"metadata": {"name": "x"}, "spec": {"url": "hf://a/b", "bogus": 1}}
+        )
+
+
+def test_manifest_bad_url_rejected():
+    with pytest.raises(Exception):
+        model_from_manifest({"metadata": {"name": "x"}, "spec": {"url": "ftp://n"}})

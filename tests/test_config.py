@@ -1,0 +1,62 @@
+"""System config loading/defaulting (regression: nested camelCase YAML
+sections must build into dataclasses under PEP 563 string annotations)."""
+
+import pytest
+
+from kubeai_tpu.config.system import System, load_system_config
+
+
+def test_defaults():
+    s = System().default_and_validate()
+    assert "tpu-v5e-1x1" in s.resource_profiles
+    assert s.resource_profiles["tpu-v5e-4x4"].hosts_per_replica == 4
+    assert s.engine_images["TPUEngine"].default
+    assert s.autoscaling.average_window_count == 60
+
+
+def test_nested_camelcase_dict():
+    s = load_system_config(
+        data={
+            "autoscaling": {"intervalSeconds": 2.0, "timeWindowSeconds": 20.0},
+            "modelRollouts": {"surge": 2},
+            "resourceProfiles": {
+                "my-tpu": {
+                    "requests": {"google.com/tpu": "4"},
+                    "nodeSelector": {"x": "y"},
+                    "hostsPerReplica": 2,
+                }
+            },
+            "allowPodAddressOverride": True,
+        }
+    )
+    assert s.autoscaling.interval_seconds == 2.0
+    assert s.autoscaling.average_window_count == 10
+    assert s.model_rollouts.surge == 2
+    assert s.resource_profiles["my-tpu"].hosts_per_replica == 2
+    assert s.allow_pod_address_override is True
+
+
+def test_yaml_file(tmp_path):
+    p = tmp_path / "sys.yaml"
+    p.write_text("autoscaling:\n  intervalSeconds: 1.5\nstreams:\n- requestsUrl: mem://r\n  responsesUrl: mem://s\n")
+    s = load_system_config(str(p))
+    assert s.autoscaling.interval_seconds == 1.5
+    assert s.streams[0].requests_url == "mem://r"
+
+
+def test_unknown_field_rejected():
+    with pytest.raises(ValueError, match="unknown config field"):
+        load_system_config(data={"bogusKnob": 1})
+
+
+def test_validation():
+    with pytest.raises(ValueError):
+        load_system_config(data={"autoscaling": {"intervalSeconds": 0}})
+    with pytest.raises(ValueError):
+        load_system_config(data={"modelRollouts": {"surge": -1}})
+
+
+def test_consecutive_scale_downs():
+    s = System().default_and_validate()
+    assert s.autoscaling.consecutive_scale_downs_for(30) == 3
+    assert s.autoscaling.consecutive_scale_downs_for(5) == 1
