@@ -17,7 +17,12 @@ from kubeai_tpu.controller.engines.common import (
 
 def faster_whisper_pod_for_model(model, cfg: ModelPodConfig) -> Pod:
     src = cfg.source
-    model_ref = src.huggingface_repo if src.scheme == "hf" else "/model"
+    if src.scheme == "hf":
+        model_ref = src.huggingface_repo
+    elif src.scheme == "file":
+        model_ref = src.local_path  # mounted at the same path
+    else:
+        model_ref = "/model"
     if cfg.cache_mount_path:
         model_ref = cfg.cache_mount_path
     env = {

@@ -23,6 +23,8 @@ def vllm_pod_for_model(model, cfg: ModelPodConfig) -> Pod:
         model_arg = src.huggingface_repo
     elif src.scheme == "pvc":
         model_arg = "/model"
+    elif src.scheme == "file":
+        model_arg = src.local_path  # mounted at the same path
     elif src.scheme == "s3":
         # vLLM loads s3 urls directly via the runai streamer
         # (ref: engine_vllm.go:20-41).

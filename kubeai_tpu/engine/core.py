@@ -56,6 +56,10 @@ class EngineConfig:
     # the cost of up to K-1 wasted steps per finished sequence and
     # admission latency quantized to one chunk.
     decode_chunk: int = 8
+    # Batched multi-LoRA capacity (bank allocated on first adapter load;
+    # the first load triggers one recompile of the step functions).
+    max_adapters: int = 8
+    max_lora_rank: int = 64
 
 
 @dataclass
@@ -69,6 +73,7 @@ class FinishInfo:
 class Request:
     prompt_ids: list[int]
     params: SamplingParams
+    adapter: str | None = None
     out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
     # events on `out`: ("token", id, text_delta) | ("done", FinishInfo) |
     # ("error", message)
@@ -148,6 +153,9 @@ class Engine:
         self._temp = jnp.ones((B,), jnp.float32)
         self._top_p = jnp.ones((B,), jnp.float32)
         self._top_k = jnp.zeros((B,), jnp.int32)
+        self._lora_rows = jnp.zeros((B,), jnp.int32)
+        if not hasattr(self, "_adapters"):
+            self._adapters = None  # AdapterRuntime; survives _recover()
 
     def _build_step_fns(self, apply_fns=None):
         mc = self.model_config
@@ -161,8 +169,10 @@ class Engine:
                 return logits.at[..., n_valid:].set(-jnp.inf)
             return logits
 
-        def prefill_fn(params, tokens, length, slot, key, temp, top_p, top_k, cache):
-            logits, cache = llama.prefill_into(params, mc, tokens, cache, slot, length)
+        def prefill_fn(params, tokens, length, slot, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
+            logits, cache = llama.prefill_into(
+                params, mc, tokens, cache, slot, length, lora=lora, lora_row=lora_row
+            )
             tok = sample(
                 mask_pad(logits[:, -1]),
                 key[None],
@@ -174,12 +184,14 @@ class Engine:
 
         K = self.cfg.decode_chunk
 
-        def decode_fn(params, cache, lengths, last_tokens, keys, active, temp, top_p, top_k):
+        def decode_fn(params, cache, lengths, last_tokens, keys, active, temp, top_p, top_k, lora=None, lora_rows=None):
             """K fused decode+sample steps; returns token ids [K, B]."""
 
             def body(carry, _):
                 cache, lengths, last, keys = carry
-                logits, cache = llama.decode_step(params, mc, last[:, None], cache, lengths)
+                logits, cache = llama.decode_step(
+                    params, mc, last[:, None], cache, lengths, lora=lora, lora_rows=lora_rows
+                )
                 step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k)
                 toks = jnp.where(active, toks, last)
@@ -210,7 +222,7 @@ class Engine:
         if self._thread:
             self._thread.join(timeout=10)
 
-    def submit(self, prompt_ids: list[int], params: SamplingParams) -> Request:
+    def submit(self, prompt_ids: list[int], params: SamplingParams, adapter: str | None = None) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
         retries another replica on 503)."""
         max_prompt = min(max(self.cfg.prefill_buckets), self.cfg.max_seq_len - 1)
@@ -218,7 +230,9 @@ class Engine:
             raise ValueError(
                 f"prompt too long: {len(prompt_ids)} tokens > {max_prompt}"
             )
-        req = Request(prompt_ids=prompt_ids, params=params)
+        if adapter and (self._adapters is None or self._adapters.row_for(adapter) == 0):
+            raise ValueError(f"adapter {adapter!r} is not loaded")
+        req = Request(prompt_ids=prompt_ids, params=params, adapter=adapter)
         self._queue.put_nowait(req)
         self.m_queue.set(self._queue.qsize())
         self._wake.set()
@@ -240,6 +254,29 @@ class Engine:
                 return ids, "".join(chunks), ev[1]
             else:
                 raise RuntimeError(ev[1])
+
+    # -- LoRA adapters -----------------------------------------------------
+
+    def load_adapter(self, name: str, path: str) -> None:
+        """Install a PEFT adapter into the bank (first load allocates it
+        and costs one step-function recompile)."""
+        from kubeai_tpu.engine.lora import AdapterRuntime
+
+        if self._adapters is None:
+            self._adapters = AdapterRuntime(
+                self.model_config,
+                max_adapters=self.cfg.max_adapters,
+                max_rank=self.cfg.max_lora_rank,
+            )
+        self._adapters.load(name, path)
+
+    def unload_adapter(self, name: str) -> bool:
+        if self._adapters is None:
+            return False
+        return self._adapters.unload(name)
+
+    def loaded_adapters(self) -> list[str]:
+        return self._adapters.names() if self._adapters else []
 
     def queue_depth(self) -> int:
         return self._queue.qsize()
@@ -333,6 +370,11 @@ class Engine:
         seed = sp.seed if sp.seed is not None else (time.monotonic_ns() & 0xFFFFFFFF)
         key = jax.random.key(seed)
 
+        lora_args = {}
+        lora_row = 0
+        if self._adapters is not None:
+            lora_row = self._adapters.row_for(req.adapter)
+            lora_args = {"lora": self._adapters.bank, "lora_row": jnp.int32(lora_row)}
         tok, self._cache = self._prefill_jit(
             self.params,
             jnp.asarray(padded),
@@ -343,6 +385,7 @@ class Engine:
             jnp.float32(sp.top_p),
             jnp.int32(sp.top_k),
             self._cache,
+            **lora_args,
         )
 
         budget = min(
@@ -371,11 +414,15 @@ class Engine:
         self._temp = self._temp.at[slot_idx].set(sp.temperature)
         self._top_p = self._top_p.at[slot_idx].set(sp.top_p)
         self._top_k = self._top_k.at[slot_idx].set(sp.top_k)
+        self._lora_rows = self._lora_rows.at[slot_idx].set(lora_row)
         return tok
 
     def _dispatch_chunk(self):
         """Dispatch one decode chunk (async) and snapshot which request
         occupied each slot at dispatch time."""
+        lora_args = {}
+        if self._adapters is not None:
+            lora_args = {"lora": self._adapters.bank, "lora_rows": self._lora_rows}
         toks_seq, self._cache, self._lengths, self._last_tokens, self._keys = self._decode_jit(
             self.params,
             self._cache,
@@ -386,6 +433,7 @@ class Engine:
             self._temp,
             self._top_p,
             self._top_k,
+            **lora_args,
         )
         snapshot = [(i, s) for i, s in enumerate(self._slots) if s is not None]
         return toks_seq, snapshot
@@ -452,9 +500,17 @@ class Engine:
             if flush:
                 # Deliver held-back chars; detok.text() additionally decodes
                 # any trailing incomplete UTF-8 to replacement chars
-                # (committed_text is always a prefix of it).
+                # (committed_text is always a prefix of it). Those flushed
+                # chars were never stop-checked — check them now.
                 text = slot.detok.text()
-                tail = text[slot.delivered_chars :]
+                end = len(text)
+                search_from = max(0, slot.delivered_chars - slot.holdback)
+                for s in slot.req.params.stop:
+                    pos = text.find(s, search_from)
+                    if pos != -1:
+                        end = min(end, pos)
+                        reason = "stop"
+                tail = text[slot.delivered_chars : end]
                 if tail:
                     slot.req.out.put(("token", -1, tail))
             slot.req.out.put(

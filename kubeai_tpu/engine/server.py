@@ -24,6 +24,7 @@ import argparse
 import json
 import logging
 import queue
+import re
 import threading
 import time
 import uuid
@@ -57,8 +58,11 @@ class EngineServer:
         self.httpd.shutdown()
         self.engine.stop()
 
-    # Adapter registry; weight application lands with the LoRA runtime.
+    _ADAPTER_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,128}$")
+
     def load_adapter(self, name: str, path: str) -> tuple[bool, str]:
+        if not self._ADAPTER_NAME_RE.match(name or ""):
+            return False, f"invalid adapter name {name!r}"
         with self._adapters_lock:
             if name in self.adapters and self.adapters[name] != path:
                 return False, f"adapter {name} already loaded from {self.adapters[name]}"
@@ -66,12 +70,31 @@ class EngineServer:
         loader = getattr(self.engine, "load_adapter", None)
         if loader is not None:
             try:
-                loader(name, path)
+                loader(name, self._resolve_adapter_path(name, path))
             except Exception as e:
                 with self._adapters_lock:
                     self.adapters.pop(name, None)
                 return False, str(e)
         return True, "ok"
+
+    @staticmethod
+    def _resolve_adapter_path(name: str, path: str) -> str:
+        """Remote adapter sources are staged to local disk first (the
+        reference does this with an exec'd loader sidecar,
+        ref: internal/modelcontroller/adapters.go:143-160). The staging dir
+        is keyed by the URL hash so a re-load with a new URL never reuses a
+        stale download (loader.load skips populated destinations); the
+        name was validated against a strict charset by load_adapter."""
+        if path.startswith("file://"):
+            return path[len("file://") :]
+        if "://" in path:
+            from kubeai_tpu.loader import load
+            from kubeai_tpu.utils.xxh import xxh64
+
+            dest = f"/tmp/kubeai-adapters/{name}-{xxh64(path) & 0xFFFFFFFF:08x}"
+            load(path, dest)
+            return dest
+        return path
 
     def unload_adapter(self, name: str) -> tuple[bool, str]:
         with self._adapters_lock:
@@ -222,8 +245,13 @@ def _make_handler(srv: EngineServer):
             )
             if prompt_ids is None:
                 prompt_ids = tok.encode(prompt_text)
+            # A request whose model field names a loaded adapter runs with
+            # that adapter (the operator proxy rewrites model_adapter ids
+            # to the bare adapter name before forwarding).
+            requested = str(body.get("model", ""))
+            adapter = requested if requested in srv.adapters else None
             try:
-                req = srv.engine.submit(prompt_ids, params)
+                req = srv.engine.submit(prompt_ids, params, adapter=adapter)
             except ValueError as e:
                 return self._error(400, str(e))
             except queue.Full:

@@ -20,6 +20,7 @@ import uuid
 from kubeai_tpu.autoscaler.autoscaler import Autoscaler
 from kubeai_tpu.autoscaler.leader import Election
 from kubeai_tpu.config.system import System, load_system_config
+from kubeai_tpu.controller.adapters import AdapterReconciler
 from kubeai_tpu.controller.cache import CacheReconciler
 from kubeai_tpu.controller.controller import ModelReconciler
 from kubeai_tpu.loadbalancer.balancer import LoadBalancer
@@ -61,8 +62,14 @@ class Manager:
         )
         self.lb = LoadBalancer(self.store, self.system.allow_pod_address_override)
         self.cache_reconciler = CacheReconciler(self.store, self.system, namespace)
+        self.adapter_reconciler = AdapterReconciler(
+            self.store, allow_override=self.system.allow_pod_address_override or local_runtime
+        )
         self.reconciler = ModelReconciler(
-            self.store, self.system, cache_reconciler=self.cache_reconciler
+            self.store,
+            self.system,
+            cache_reconciler=self.cache_reconciler,
+            adapter_reconciler=self.adapter_reconciler,
         )
         self.autoscaler = Autoscaler(
             self.store,

@@ -161,10 +161,13 @@ def _split(url: str) -> tuple[str, str]:
     parsed = urlparse(url)
     if not parsed.scheme:
         raise ValueError(f"pubsub url missing scheme: {url!r}")
-    ref = (parsed.netloc + parsed.path).rstrip("/")
     if parsed.scheme == "file":
-        ref = parsed.path
-    return parsed.scheme, ref
+        # file://spool/q -> relative "spool/q"; file:///var/q -> "/var/q".
+        ref = (parsed.netloc + parsed.path) if parsed.netloc else parsed.path
+        if not ref:
+            raise ValueError(f"file:// pubsub url needs a directory: {url!r}")
+        return "file", ref
+    return parsed.scheme, (parsed.netloc + parsed.path).rstrip("/")
 
 
 def open_topic(url: str) -> Topic:

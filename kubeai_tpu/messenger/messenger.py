@@ -143,7 +143,12 @@ class Messenger:
         host, _, port = addr.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80), timeout=self.await_timeout)
         try:
-            upstream = path if path.startswith("/v1/") else path[path.find("/v1/") :]
+            # parse_request already rejected paths without a /v1/ suffix;
+            # guard anyway so a typo'd path can't become a garbage URL.
+            idx = path.find("/v1/")
+            if idx < 0:
+                raise ValueError(f"unsupported inference path {path!r}")
+            upstream = path[idx:]
             conn.request(
                 "POST", upstream, body=body, headers={"Content-Type": "application/json"}
             )

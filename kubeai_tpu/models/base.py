@@ -27,6 +27,22 @@ class ModelConfig:
     # MoE (Mixtral-style); num_experts == 0 means dense.
     num_experts: int = 0
     num_experts_per_tok: int = 2
+    # Static per-expert capacity = ceil(k*T/E * factor); tokens routed past
+    # it are dropped (GShard semantics). Raise for exactness at the cost of
+    # padding compute.
+    moe_capacity_factor: float = 2.0
+    # Architecture variants (Gemma family).
+    hidden_act: str = "silu"  # "silu" | "gelu_tanh"
+    embed_scale: bool = False  # multiply embeddings by sqrt(hidden)
+    rms_one_offset: bool = False  # RMSNorm weight is (1 + w)
+    post_norms: bool = False  # Gemma2 post-attention/post-ffn norms
+    attn_softcap: float = 0.0  # 0 = disabled
+    logit_softcap: float = 0.0
+    query_scale: float | None = None  # attention scale override
+    # Sliding-window attention: window size (0 = disabled) and which
+    # layers it applies to ("all", or "even" for Gemma2's interleave).
+    sliding_window: int = 0
+    sliding_layers: str = "all"
     dtype: str = "bfloat16"
 
     @property
@@ -68,7 +84,28 @@ class ModelConfig:
                     f"unsupported rope_scaling type {rope_type!r}; "
                     "supported: llama3, linear"
                 )
+        model_type = get("model_type", "llama")
+        gemma_kw = {}
+        if model_type in ("gemma", "gemma2"):
+            gemma_kw = dict(
+                hidden_act="gelu_tanh",
+                embed_scale=True,
+                rms_one_offset=True,
+            )
+            if model_type == "gemma2":
+                gemma_kw.update(
+                    post_norms=True,
+                    attn_softcap=get("attn_logit_softcapping", 50.0) or 0.0,
+                    logit_softcap=get("final_logit_softcapping", 30.0) or 0.0,
+                    query_scale=(get("query_pre_attn_scalar") or 0) ** -0.5
+                    if get("query_pre_attn_scalar")
+                    else None,
+                    # HF Gemma2 applies the window on even layer indices.
+                    sliding_window=get("sliding_window") or 0,
+                    sliding_layers="even",
+                )
         return cls(
+            **gemma_kw,
             vocab_size=config.vocab_size,
             hidden_size=config.hidden_size,
             intermediate_size=get("intermediate_size") or get("ffn_dim"),

@@ -56,20 +56,31 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
         scale = scale or (1.0 / np.sqrt(shape[-2] if len(shape) > 1 else shape[-1]))
         return (jax.random.normal(k, shape, jnp.float32) * scale).astype(dtype)
 
+    layers: Params = {
+        "ln1": jnp.ones((L, D), dtype),
+        "ln2": jnp.ones((L, D), dtype),
+        "wq": w(next(keys), L, D, H * h),
+        "wk": w(next(keys), L, D, Kv * h),
+        "wv": w(next(keys), L, D, Kv * h),
+        "wo": w(next(keys), L, H * h, D),
+    }
+    if config.post_norms:
+        layers["ln1b"] = jnp.ones((L, D), dtype)
+        layers["ln2b"] = jnp.ones((L, D), dtype)
+    if config.num_experts > 0:
+        E = config.num_experts
+        layers["wr"] = w(next(keys), L, D, E)  # router
+        layers["wg"] = w(next(keys), L, E, D, F)
+        layers["wu"] = w(next(keys), L, E, D, F)
+        layers["wd"] = w(next(keys), L, E, F, D)
+    else:
+        layers["wg"] = w(next(keys), L, D, F)
+        layers["wu"] = w(next(keys), L, D, F)
+        layers["wd"] = w(next(keys), L, F, D)
     params: Params = {
         "embed": w(next(keys), V, D, scale=0.02),
         "final_norm": jnp.ones((D,), dtype),
-        "layers": {
-            "ln1": jnp.ones((L, D), dtype),
-            "ln2": jnp.ones((L, D), dtype),
-            "wq": w(next(keys), L, D, H * h),
-            "wk": w(next(keys), L, D, Kv * h),
-            "wv": w(next(keys), L, D, Kv * h),
-            "wo": w(next(keys), L, H * h, D),
-            "wg": w(next(keys), L, D, F),
-            "wu": w(next(keys), L, D, F),
-            "wd": w(next(keys), L, F, D),
-        },
+        "layers": layers,
     }
     if not config.tie_word_embeddings:
         params["lm_head"] = w(next(keys), D, V, scale=0.02)
@@ -90,20 +101,50 @@ def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype
         arr = np.stack([w.T if transpose else w for w in ws])
         return jnp.asarray(arr, dtype)
 
+    layers: Params = {
+        "ln1": stack("model.layers.{}.input_layernorm.weight", transpose=False),
+        "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
+        "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
+        "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
+        "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
+    }
+    if config.post_norms:
+        # Gemma2 layout: post-attn + pre/post-feedforward norms.
+        layers["ln1b"] = stack("model.layers.{}.post_attention_layernorm.weight", transpose=False)
+        layers["ln2"] = stack("model.layers.{}.pre_feedforward_layernorm.weight", transpose=False)
+        layers["ln2b"] = stack("model.layers.{}.post_feedforward_layernorm.weight", transpose=False)
+    else:
+        layers["ln2"] = stack("model.layers.{}.post_attention_layernorm.weight", transpose=False)
+    if config.num_experts > 0:
+        # Mixtral naming: block_sparse_moe.gate + experts.{e}.w1/w3/w2
+        # (gate/up/down); stacked to [L, E, in, out].
+        E = config.num_experts
+
+        def stack_experts(which):
+            out = []
+            for li in range(L):
+                per = [
+                    get(f"model.layers.{li}.block_sparse_moe.experts.{e}.{which}.weight").T
+                    for e in range(E)
+                ]
+                out.append(np.stack(per))
+            return jnp.asarray(np.stack(out), dtype)
+
+        layers["ln2"] = stack(
+            "model.layers.{}.post_attention_layernorm.weight", transpose=False
+        )
+        layers["wr"] = stack("model.layers.{}.block_sparse_moe.gate.weight")
+        layers["wg"] = stack_experts("w1")
+        layers["wu"] = stack_experts("w3")
+        layers["wd"] = stack_experts("w2")
+    else:
+        layers["wg"] = stack("model.layers.{}.mlp.gate_proj.weight")
+        layers["wu"] = stack("model.layers.{}.mlp.up_proj.weight")
+        layers["wd"] = stack("model.layers.{}.mlp.down_proj.weight")
     params: Params = {
         "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
         "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
-        "layers": {
-            "ln1": stack("model.layers.{}.input_layernorm.weight", transpose=False),
-            "ln2": stack("model.layers.{}.post_attention_layernorm.weight", transpose=False),
-            "wq": stack("model.layers.{}.self_attn.q_proj.weight"),
-            "wk": stack("model.layers.{}.self_attn.k_proj.weight"),
-            "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
-            "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
-            "wg": stack("model.layers.{}.mlp.gate_proj.weight"),
-            "wu": stack("model.layers.{}.mlp.up_proj.weight"),
-            "wd": stack("model.layers.{}.mlp.down_proj.weight"),
-        },
+        "layers": layers,
     }
     if not config.tie_word_embeddings:
         params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
@@ -125,6 +166,77 @@ def init_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> Par
 # Forward
 
 
+LORA_TARGETS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
+
+
+def init_lora_bank(config: ModelConfig, n_adapters: int, rank: int, dtype=None) -> Params:
+    """Zeroed stacked adapter bank for batched multi-LoRA (punica-style):
+    per target, A [L, N, in, r] and B [L, N, r, out]; adapter row 0 is the
+    identity (all-zero) adapter for requests without one. Static shapes —
+    installing an adapter is a device scatter, never a recompile."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    D, F, L = config.hidden_size, config.intermediate_size, config.num_layers
+    H, Kv, h = config.num_heads, config.num_kv_heads, config.head_dim_
+    dims = {
+        "wq": (D, H * h), "wk": (D, Kv * h), "wv": (D, Kv * h), "wo": (H * h, D),
+        "wg": (D, F), "wu": (D, F), "wd": (F, D),
+    }
+    bank: Params = {"scale": jnp.zeros((n_adapters,), jnp.float32)}
+    for t, (din, dout) in dims.items():
+        bank[t + "_A"] = jnp.zeros((L, n_adapters, din, rank), dtype)
+        bank[t + "_B"] = jnp.zeros((L, n_adapters, rank, dout), dtype)
+    return bank
+
+
+def moe_mlp(x, wr, wg, wu, wd, num_experts_per_tok: int, capacity_factor: float = 2.0):
+    """Mixtral-style sparse MoE FFN with GShard static-capacity dispatch.
+
+    x [B, S, D]; wr [D, E]; wg/wu [E, D, F]; wd [E, F, D].
+    Top-k routing with softmax-over-top-k weights (Mixtral semantics);
+    tokens beyond an expert's capacity C = ceil(k*T/E * factor) are
+    dropped (their contribution is zero). All shapes static: dispatch and
+    combine are one-hot einsums that land on the MXU, and the expert dim
+    shards over the `ep` mesh axis (XLA inserts the all-to-alls).
+    """
+    B, S, D = x.shape
+    E = wr.shape[-1]
+    k = num_experts_per_tok
+    T = B * S
+    C = max(int(np.ceil(k * T / E * capacity_factor)), 1)
+
+    xt = x.reshape(T, D)
+    router_logits = (xt @ wr).astype(jnp.float32)  # [T, E]
+    top_vals, top_idx = jax.lax.top_k(router_logits, k)  # [T, k]
+    weights = jax.nn.softmax(top_vals, axis=-1)  # renorm over chosen experts
+
+    onehot = jax.nn.one_hot(top_idx.reshape(T * k), E, dtype=jnp.float32)  # [T*k, E]
+    # Position of each (token, choice) within its expert's capacity.
+    pos = (jnp.cumsum(onehot, axis=0) - onehot)  # [T*k, E]
+    pos = (pos * onehot).sum(-1)  # [T*k]
+    keep = (pos < C).astype(jnp.float32)
+    dispatch = onehot * keep[:, None]  # [T*k, E]
+    pos_oh = jax.nn.one_hot(pos, C, dtype=jnp.float32)  # [T*k, C]
+    disp = jnp.einsum("ne,nc->ecn", dispatch, pos_oh)  # [E, C, T*k]
+
+    x_rep = jnp.repeat(xt, k, axis=0)  # token for each (t, choice)
+    xe = jnp.einsum("ecn,nd->ecd", disp, x_rep.astype(jnp.float32)).astype(x.dtype)
+    h = jax.nn.silu(jnp.einsum("ecd,edf->ecf", xe, wg)) * jnp.einsum("ecd,edf->ecf", xe, wu)
+    ye = jnp.einsum("ecf,efd->ecd", h, wd)  # [E, C, D]
+
+    w_flat = weights.reshape(T * k) * keep
+    y = jnp.einsum("ecn,ecd->nd", disp, ye.astype(jnp.float32)) * w_flat[:, None]
+    return y.reshape(T, k, D).sum(axis=1).reshape(B, S, D).astype(x.dtype)
+
+
+def _lora_delta(x, A_l, B_l, rows, scale):
+    """Per-row LoRA delta: x [B, S, din], A_l [N, din, r], B_l [N, r, dout],
+    rows [B] adapter indices, scale [N] -> [B, S, dout]."""
+    A_sel = A_l[rows]  # [B, din, r]
+    B_sel = B_l[rows]  # [B, r, dout]
+    low = jnp.einsum("bsd,bdr->bsr", x, A_sel)
+    return jnp.einsum("bsr,bro->bso", low, B_sel) * scale[rows][:, None, None]
+
+
 def apply(
     params: Params,
     config: ModelConfig,
@@ -133,6 +245,8 @@ def apply(
     cache: Params | None = None,
     logits_idx: jnp.ndarray | None = None,  # [B] gather one query index before lm_head
     cache_rows: jnp.ndarray | None = None,  # [B] cache row per batch row
+    lora: Params | None = None,  # adapter bank from init_lora_bank
+    lora_rows: jnp.ndarray | None = None,  # [B] adapter index per batch row
 ):
     """Run the decoder. Returns (logits, new_cache).
 
@@ -150,6 +264,15 @@ def apply(
     inv_freq = jnp.asarray(rope_frequencies(h, config.rope_theta, config.rope_scaling))
 
     x = params["embed"].astype(jnp.dtype(config.dtype))[tokens]
+    if config.embed_scale:
+        # Gemma multiplies embeddings by sqrt(hidden), rounded through the
+        # compute dtype (HF casts the normalizer).
+        x = x * jnp.asarray(config.hidden_size**0.5, x.dtype)
+
+    act = jax.nn.silu if config.hidden_act == "silu" else (
+        lambda v: jax.nn.gelu(v, approximate=True)
+    )
+    norm_offset = 1.0 if config.rms_one_offset else 0.0
 
     if cache is not None:
         skv = cache["k"].shape[2]
@@ -158,14 +281,38 @@ def apply(
         key_positions = positions[:, None, :]  # [B, 1, S]
     mask = key_positions <= positions[:, :, None]  # [B, S, Skv]
 
+    # Sliding-window attention (Gemma2 interleave): per-layer flag selects
+    # between the global causal mask and the windowed one.
+    L = config.num_layers
+    if config.sliding_window > 0:
+        window_ok = key_positions > positions[:, :, None] - config.sliding_window
+        if config.sliding_layers == "even":
+            sliding_flags = (jnp.arange(L) % 2) == 0
+        else:
+            sliding_flags = jnp.ones((L,), bool)
+    else:
+        window_ok = None
+        sliding_flags = jnp.zeros((L,), bool)
+
     batch_idx = jnp.arange(B)[:, None]
     rows = batch_idx if cache_rows is None else cache_rows[:, None]
 
-    def layer(x, w, k_cache_l, v_cache_l):
-        attn_in = rms_norm(x, w["ln1"], config.rms_norm_eps)
-        q = (attn_in @ w["wq"]).reshape(B, S, H, h)
-        k = (attn_in @ w["wk"]).reshape(B, S, Kv, h)
-        v = (attn_in @ w["wv"]).reshape(B, S, Kv, h)
+    def layer(x, w, k_cache_l, v_cache_l, lora_l=None, sliding=None):
+        def proj(inp, name):
+            out = inp @ w[name]
+            if lora_l is not None:
+                out = out + _lora_delta(
+                    inp, lora_l[name + "_A"], lora_l[name + "_B"], lora_rows, lora["scale"]
+                )
+            return out
+
+        def norm(inp, name):
+            return rms_norm(inp, w[name] + norm_offset, config.rms_norm_eps)
+
+        attn_in = norm(x, "ln1")
+        q = proj(attn_in, "wq").reshape(B, S, H, h)
+        k = proj(attn_in, "wk").reshape(B, S, Kv, h)
+        v = proj(attn_in, "wv").reshape(B, S, Kv, h)
         q, k = apply_rope(q, k, positions, inv_freq)
 
         if k_cache_l is not None:
@@ -179,42 +326,70 @@ def apply(
             k_full, v_full = k, v
             k_att, v_att = k, v
 
-        attn_out = attention(q, k_att, v_att, mask)
-        x = x + attn_out.reshape(B, S, H * h) @ w["wo"]
+        layer_mask = mask
+        if window_ok is not None and sliding is not None:
+            layer_mask = jnp.logical_and(mask, jnp.logical_or(~sliding, window_ok))
+        attn_out = attention(
+            q, k_att, v_att, layer_mask,
+            scale=config.query_scale, softcap=config.attn_softcap,
+        )
+        o = proj(attn_out.reshape(B, S, H * h), "wo")
+        if config.post_norms:
+            o = norm(o, "ln1b")
+        x = x + o
 
-        mlp_in = rms_norm(x, w["ln2"], config.rms_norm_eps)
-        gated = jax.nn.silu(mlp_in @ w["wg"]) * (mlp_in @ w["wu"])
-        x = x + gated @ w["wd"]
+        mlp_in = norm(x, "ln2")
+        if config.num_experts > 0:
+            m = moe_mlp(
+                mlp_in, w["wr"], w["wg"], w["wu"], w["wd"],
+                config.num_experts_per_tok, config.moe_capacity_factor,
+            )
+        else:
+            m = proj(act(proj(mlp_in, "wg")) * proj(mlp_in, "wu"), "wd")
+        if config.post_norms:
+            m = norm(m, "ln2b")
+        x = x + m
         return x, (k_full, v_full)
+
+    # Per-layer lora slices ride the scan xs (leading dim L).
+    lora_xs = None
+    if lora is not None:
+        lora_xs = {k: v for k, v in lora.items() if k != "scale"}
 
     if cache is not None:
 
         def step(x, xs):
-            w, kc, vc = xs
-            return layer(x, w, kc, vc)
+            w, kc, vc, lora_l, sliding = xs
+            return layer(x, w, kc, vc, lora_l, sliding)
 
-        x, (new_k, new_v) = jax.lax.scan(step, x, (params["layers"], cache["k"], cache["v"]))
+        x, (new_k, new_v) = jax.lax.scan(
+            step, x, (params["layers"], cache["k"], cache["v"], lora_xs, sliding_flags)
+        )
         new_cache = {"k": new_k, "v": new_v}
     else:
 
-        def step_nocache(x, w):
-            x, _ = layer(x, w, None, None)
+        def step_nocache(x, xs):
+            w, lora_l, sliding = xs
+            x, _ = layer(x, w, None, None, lora_l, sliding)
             return x, None
 
-        x, _ = jax.lax.scan(step_nocache, x, params["layers"])
+        x, _ = jax.lax.scan(step_nocache, x, (params["layers"], lora_xs, sliding_flags))
         new_cache = None
 
-    x = rms_norm(x, params["final_norm"], config.rms_norm_eps)
+    x = rms_norm(x, params["final_norm"] + norm_offset, config.rms_norm_eps)
     if logits_idx is not None:
         x = x[batch_idx, logits_idx[:, None]]  # [B, 1, D]
     if config.tie_word_embeddings:
         logits = x @ params["embed"].astype(x.dtype).T
     else:
         logits = x @ params["lm_head"]
-    return logits.astype(jnp.float32), new_cache
+    logits = logits.astype(jnp.float32)
+    if config.logit_softcap > 0.0:
+        logits = config.logit_softcap * jnp.tanh(logits / config.logit_softcap)
+    return logits, new_cache
 
 
-def prefill(params, config, tokens, cache, lengths=None):
+def prefill(params, config, tokens, cache, lengths=None, lora=None, lora_rows=None):
     """Prefill [B, S] left-aligned (right-padded) tokens into the cache.
     Returns (last_token_logits [B, 1, V], cache); *lengths* [B] are the true
     sequence lengths (default S)."""
@@ -222,10 +397,13 @@ def prefill(params, config, tokens, cache, lengths=None):
     if lengths is None:
         lengths = jnp.full((B,), S, jnp.int32)
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-    return apply(params, config, tokens, pos, cache, logits_idx=lengths - 1)
+    return apply(
+        params, config, tokens, pos, cache, logits_idx=lengths - 1,
+        lora=lora, lora_rows=lora_rows,
+    )
 
 
-def prefill_into(params, config, tokens, cache, slot, length):
+def prefill_into(params, config, tokens, cache, slot, length, lora=None, lora_row=None):
     """Prefill one sequence [1, S] directly into cache row *slot* (traced
     int32 scalar). Returns (last_token_logits [1, 1, V], cache)."""
     _, S = tokens.shape
@@ -238,10 +416,15 @@ def prefill_into(params, config, tokens, cache, slot, length):
         cache,
         logits_idx=length[None] - 1 if length.ndim == 0 else length - 1,
         cache_rows=jnp.reshape(slot, (1,)).astype(jnp.int32),
+        lora=lora,
+        lora_rows=None if lora_row is None else jnp.reshape(lora_row, (1,)).astype(jnp.int32),
     )
 
 
-def decode_step(params, config, tokens, cache, lengths):
+def decode_step(params, config, tokens, cache, lengths, lora=None, lora_rows=None):
     """One decode step for [B, 1] tokens at positions *lengths* [B].
     Returns (logits [B, 1, V], cache)."""
-    return apply(params, config, tokens, lengths[:, None].astype(jnp.int32), cache)
+    return apply(
+        params, config, tokens, lengths[:, None].astype(jnp.int32), cache,
+        lora=lora, lora_rows=lora_rows,
+    )

@@ -26,11 +26,13 @@ def attention(
     v: jnp.ndarray,
     mask: jnp.ndarray | None = None,
     scale: float | None = None,
+    softcap: float = 0.0,
 ) -> jnp.ndarray:
     """Scaled dot-product attention with GQA.
 
     *mask* is boolean, broadcastable to [B, Sq, Sk]; True = attend.
-    Softmax is computed in float32.
+    Softmax is computed in float32. *softcap* > 0 applies Gemma2-style
+    tanh capping to the attention logits.
     """
     B, Sq, H, h = q.shape
     Kv = k.shape[2]
@@ -43,6 +45,8 @@ def attention(
         "bqkgh,bskh->bkgqs", qg.astype(jnp.float32), k.astype(jnp.float32)
     )
     logits *= scale
+    if softcap > 0.0:
+        logits = softcap * jnp.tanh(logits / softcap)
     if mask is not None:
         # [B, Sq, Sk] -> [B, 1, 1, Sq, Sk]
         logits = jnp.where(mask[:, None, None, :, :], logits, _NEG_INF)

@@ -19,23 +19,32 @@ def llama_param_specs(config=None, fsdp: bool = False):
     """PartitionSpec tree matching models.llama param trees.
 
     tp sharding: attention heads + ffn intermediate dim; vocab-sharded
-    embedding and lm_head.
+    embedding and lm_head. MoE expert weights shard experts over `ep` and
+    the ffn dim over `tp`.
     """
     d = "dp" if fsdp else None
+    moe = config is not None and config.num_experts > 0
+    layers = {
+        "ln1": P(None, None),
+        "ln2": P(None, None),
+        "wq": P(None, d, "tp"),
+        "wk": P(None, d, "tp"),
+        "wv": P(None, d, "tp"),
+        "wo": P(None, "tp", d),
+    }
+    if moe:
+        layers["wr"] = P(None, d, None)
+        layers["wg"] = P(None, "ep", d, "tp")
+        layers["wu"] = P(None, "ep", d, "tp")
+        layers["wd"] = P(None, "ep", "tp", d)
+    else:
+        layers["wg"] = P(None, d, "tp")
+        layers["wu"] = P(None, d, "tp")
+        layers["wd"] = P(None, "tp", d)
     specs = {
         "embed": P("tp", d),  # vocab-sharded
         "final_norm": P(None),
-        "layers": {
-            "ln1": P(None, None),
-            "ln2": P(None, None),
-            "wq": P(None, d, "tp"),
-            "wk": P(None, d, "tp"),
-            "wv": P(None, d, "tp"),
-            "wo": P(None, "tp", d),
-            "wg": P(None, d, "tp"),
-            "wu": P(None, d, "tp"),
-            "wd": P(None, "tp", d),
-        },
+        "layers": layers,
     }
     if config is None or not config.tie_word_embeddings:
         specs["lm_head"] = P(d, "tp")  # [D, V]: vocab-sharded output

@@ -180,11 +180,16 @@ class TestHTTP:
         assert "kubeai_engine_generated_tokens_total" in text
         assert "kubeai_engine_active_slots" in text
 
-    def test_adapter_endpoints(self, server):
+    def test_adapter_endpoints(self, server, tmp_path):
+        from tests.test_lora import write_peft_checkpoint
+
+        write_peft_checkpoint(str(tmp_path / "ad"), server.engine.model_config)
         status, body = post(
-            server, "/v1/load_lora_adapter", {"lora_name": "ad1", "lora_path": "/tmp/x"}
+            server,
+            "/v1/load_lora_adapter",
+            {"lora_name": "ad1", "lora_path": str(tmp_path / "ad")},
         )
-        assert status == 200
+        assert status == 200, body
         status, body = get(server, "/v1/models")
         ids = [m["id"] for m in json.loads(body)["data"]]
         assert "ad1" in ids
