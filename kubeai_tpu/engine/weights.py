@@ -74,6 +74,8 @@ def load_engine_from_path(
 ) -> Engine:
     """Build an Engine from an HF-format checkpoint directory."""
     config = ModelConfig.from_json_file(path).replace(dtype=dtype)
+    if jax.default_backend() == "tpu":
+        config = config.replace(use_flash_prefill=True)
     sd = load_state_dict(path)
     if "lm_head.weight" not in sd and not config.tie_word_embeddings:
         config = config.replace(tie_word_embeddings=True)

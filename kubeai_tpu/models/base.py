@@ -43,6 +43,9 @@ class ModelConfig:
     # layers it applies to ("all", or "even" for Gemma2's interleave).
     sliding_window: int = 0
     sliding_layers: str = "all"
+    # Use the Pallas flash-attention kernel for prefill (set by the engine
+    # on TPU; only valid without softcap/sliding-window).
+    use_flash_prefill: bool = False
     dtype: str = "bfloat16"
 
     @property

@@ -247,6 +247,7 @@ def apply(
     cache_rows: jnp.ndarray | None = None,  # [B] cache row per batch row
     lora: Params | None = None,  # adapter bank from init_lora_bank
     lora_rows: jnp.ndarray | None = None,  # [B] adapter index per batch row
+    left_aligned: bool = False,  # caller guarantees positions == arange(S)
 ):
     """Run the decoder. Returns (logits, new_cache).
 
@@ -273,6 +274,19 @@ def apply(
         lambda v: jax.nn.gelu(v, approximate=True)
     )
     norm_offset = 1.0 if config.rms_one_offset else 0.0
+    # Flash prefill: only when the caller vouches the positions are
+    # arange(S) (left_aligned — prefill/prefill_into set it; inferring it
+    # from shapes would silently mis-mask offset-position calls), on plain
+    # causal models with kernel-friendly shapes.
+    use_flash = (
+        config.use_flash_prefill
+        and left_aligned
+        and cache is not None
+        and S >= 256
+        and S % 256 == 0
+        and config.attn_softcap == 0.0
+        and config.sliding_window == 0
+    )
 
     if cache is not None:
         skv = cache["k"].shape[2]
@@ -326,13 +340,23 @@ def apply(
             k_full, v_full = k, v
             k_att, v_att = k, v
 
-        layer_mask = mask
-        if window_ok is not None and sliding is not None:
-            layer_mask = jnp.logical_and(mask, jnp.logical_or(~sliding, window_ok))
-        attn_out = attention(
-            q, k_att, v_att, layer_mask,
-            scale=config.query_scale, softcap=config.attn_softcap,
-        )
+        if use_flash:
+            # Prefill positions are arange(S): plain causal over the first
+            # S cache columns == the position-derived mask.
+            from kubeai_tpu.ops.flash_attention import flash_attention_tpu
+
+            attn_out = flash_attention_tpu(
+                q, k_att[:, :S], v_att[:, :S], causal=True, sm_scale=config.query_scale,
+                interpret=jax.default_backend() != "tpu",
+            )
+        else:
+            layer_mask = mask
+            if window_ok is not None and sliding is not None:
+                layer_mask = jnp.logical_and(mask, jnp.logical_or(~sliding, window_ok))
+            attn_out = attention(
+                q, k_att, v_att, layer_mask,
+                scale=config.query_scale, softcap=config.attn_softcap,
+            )
         o = proj(attn_out.reshape(B, S, H * h), "wo")
         if config.post_norms:
             o = norm(o, "ln1b")
@@ -399,7 +423,7 @@ def prefill(params, config, tokens, cache, lengths=None, lora=None, lora_rows=No
     pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     return apply(
         params, config, tokens, pos, cache, logits_idx=lengths - 1,
-        lora=lora, lora_rows=lora_rows,
+        lora=lora, lora_rows=lora_rows, left_aligned=True,
     )
 
 
@@ -418,6 +442,7 @@ def prefill_into(params, config, tokens, cache, slot, length, lora=None, lora_ro
         cache_rows=jnp.reshape(slot, (1,)).astype(jnp.int32),
         lora=lora,
         lora_rows=None if lora_row is None else jnp.reshape(lora_row, (1,)).astype(jnp.int32),
+        left_aligned=True,
     )
 
 

@@ -1,0 +1,123 @@
+"""Pallas flash-attention kernel for TPU prefill.
+
+The prefill hot op: O(S^2) attention computed in VMEM tiles so the
+[S, S] score matrix never touches HBM. Grid = (batch, q-head, q-block);
+each program streams KV blocks with online-softmax accumulators kept in
+f32 scratch. GQA maps query heads onto their KV head in the BlockSpec
+index maps — KV is never materialized at H width.
+
+Dispatch: `flash_attention` uses the kernel on TPU and the XLA reference
+implementation elsewhere; `interpret=True` runs the kernel in Pallas
+interpret mode (CPU tests).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+from kubeai_tpu.ops.attention import attention, causal_mask
+
+_NEG_INF = -1e30
+
+
+def _flash_kernel(q_ref, k_ref, v_ref, o_ref, *, sm_scale, block_k, causal, block_q, seq_k):
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32) * sm_scale  # [block_q, h]
+    h = q.shape[-1]
+
+    m = jnp.full((block_q,), _NEG_INF, jnp.float32)
+    l = jnp.zeros((block_q,), jnp.float32)
+    acc = jnp.zeros((block_q, h), jnp.float32)
+
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+
+    n_k = seq_k // block_k
+
+    def body(j, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.dslice(j * block_k, block_k)].astype(jnp.float32)
+        s = q @ k_blk.T  # [block_q, block_k]
+        if causal:
+            k_pos = j * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+        m_new = jnp.maximum(m, s.max(axis=-1))
+        p = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m - m_new)
+        l_new = l * alpha + p.sum(axis=-1)
+        acc_new = acc * alpha[:, None] + p @ v_blk
+        return m_new, l_new, acc_new
+
+    if causal:
+        # Skip KV blocks entirely above the causal diagonal.
+        n_used = jnp.minimum(((qi + 1) * block_q + block_k - 1) // block_k, n_k)
+    else:
+        n_used = n_k
+    m, l, acc = jax.lax.fori_loop(0, n_used, body, (m, l, acc))
+    o_ref[0, 0] = (acc / jnp.maximum(l, 1e-30)[:, None]).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("causal", "sm_scale", "block_q", "block_k", "interpret")
+)
+def flash_attention_tpu(
+    q: jnp.ndarray,  # [B, S, H, h]
+    k: jnp.ndarray,  # [B, S, Kv, h]
+    v: jnp.ndarray,
+    causal: bool = True,
+    sm_scale: float | None = None,
+    block_q: int = 256,
+    block_k: int = 256,
+    interpret: bool = False,
+) -> jnp.ndarray:
+    B, S, H, h = q.shape
+    Kv = k.shape[2]
+    G = H // Kv
+    if sm_scale is None:
+        sm_scale = h**-0.5
+    block_q = min(block_q, S)
+    block_k = min(block_k, S)
+    assert S % block_q == 0 and S % block_k == 0, "seq must divide block sizes"
+
+    qt = q.transpose(0, 2, 1, 3)  # [B, H, S, h]
+    kt = k.transpose(0, 2, 1, 3)  # [B, Kv, S, h]
+    vt = v.transpose(0, 2, 1, 3)
+
+    grid = (B, H, S // block_q)
+    out = pl.pallas_call(
+        functools.partial(
+            _flash_kernel,
+            sm_scale=sm_scale,
+            block_k=block_k,
+            causal=causal,
+            block_q=block_q,
+            seq_k=S,
+        ),
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, h), lambda b, hh, qi: (b, hh, qi, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, qi: (b, hh // G, 0, 0)),
+            pl.BlockSpec((1, 1, S, h), lambda b, hh, qi: (b, hh // G, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, h), lambda b, hh, qi: (b, hh, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct(qt.shape, q.dtype),
+        interpret=interpret,
+    )(qt, kt, vt)
+    return out.transpose(0, 2, 1, 3)
+
+
+def flash_attention(q, k, v, causal: bool = True, sm_scale: float | None = None):
+    """Dispatch: Pallas kernel on TPU, XLA reference elsewhere."""
+    platform = q.devices().pop().platform if hasattr(q, "devices") else "cpu"
+    S = q.shape[1]
+    if platform == "tpu" and S >= 256 and S % 256 == 0:
+        return flash_attention_tpu(q, k, v, causal=causal, sm_scale=sm_scale)
+    B = q.shape[0]
+    mask = jnp.broadcast_to(causal_mask(S, S), (B, S, S)) if causal else None
+    return attention(q, k, v, mask, scale=sm_scale)

@@ -32,10 +32,27 @@ def _merge_round(h: int, v: int) -> int:
     return (h * _P1 + _P4) & _M
 
 
+# Resolved once on first use: either the raw ctypes function (direct C
+# call, no lock on the steady-state path) or the Python fallback.
+_impl = None
+
+
 def xxh64(data: bytes | str, seed: int = 0) -> int:
-    """Compute xxHash64 of *data* with *seed*; returns an unsigned 64-bit int."""
+    """Compute xxHash64 of *data* with *seed*; returns an unsigned 64-bit
+    int. Uses the native C++ implementation when built (utils.native);
+    this Python version is the fallback and the test reference."""
     if isinstance(data, str):
         data = data.encode("utf-8")
+    global _impl
+    if _impl is None:
+        from kubeai_tpu.utils.native import load
+
+        lib = load()  # one-time (compiles the extension if needed)
+        _impl = (lambda d, s: lib.xxh64(d, len(d), s)) if lib is not None else _xxh64_py
+    return _impl(data, seed)
+
+
+def _xxh64_py(data: bytes, seed: int = 0) -> int:
     n = len(data)
     i = 0
 
