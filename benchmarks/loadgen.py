@@ -1,0 +1,155 @@
+"""Multi-turn chat load generator with TTFT/ITL/TPOT aggregation.
+
+Parity with the reference's benchmark tooling (ref:
+benchmarks/multi-turn-chat-go/benchmark/runner.go — stateful conversation
+threads; docs/benchmarks/prefix-aware-load-balancing.md methodology):
+N concurrent threads each hold a conversation (so PrefixHash routing has
+prefixes to exploit), send streaming chat completions with the growing
+history, and record time-to-first-token, inter-token latency, and
+time-per-output-token. Works against any OpenAI-compatible endpoint —
+this framework's operator or engine, or an upstream server.
+
+    python benchmarks/loadgen.py --url http://localhost:8000/openai \
+        --model m1 --threads 16 --turns 4 --max-tokens 64
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import statistics
+import threading
+import time
+import urllib.request
+
+
+class ThreadStats:
+    def __init__(self):
+        self.ttfts: list[float] = []
+        self.itls: list[float] = []
+        self.turn_latencies: list[float] = []
+        # Per-turn (decode_time, token_count) for TPOT.
+        self.turn_decode: list[tuple[float, int]] = []
+        self.output_tokens = 0
+        self.failures = 0
+
+
+def run_thread(base_url: str, model: str, turns: int, max_tokens: int, prompt_seed: str, stats: ThreadStats):
+    messages = []
+    for turn in range(turns):
+        messages.append({"role": "user", "content": f"{prompt_seed} turn {turn}: tell me more."})
+        body = {
+            "model": model,
+            "messages": messages,
+            "max_tokens": max_tokens,
+            "temperature": 0.7,
+            "stream": True,
+        }
+        req = urllib.request.Request(
+            f"{base_url}/v1/chat/completions",
+            data=json.dumps(body).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        t_start = time.monotonic()
+        t_first = None
+        t_last = None
+        chunks: list[str] = []
+        n_tokens = 0
+        try:
+            with urllib.request.urlopen(req, timeout=600) as resp:
+                for line in resp:
+                    line = line.decode().strip()
+                    if not line.startswith("data: "):
+                        continue
+                    payload = line[6:]
+                    if payload == "[DONE]":
+                        break
+                    delta = (
+                        json.loads(payload)["choices"][0].get("delta", {}).get("content")
+                    )
+                    if not delta:
+                        continue
+                    now = time.monotonic()
+                    if t_last is None:
+                        t_first = now
+                        stats.ttfts.append(now - t_start)
+                    else:
+                        stats.itls.append(now - t_last)
+                    t_last = now
+                    n_tokens += 1
+                    chunks.append(delta)
+        except Exception:
+            stats.failures += 1
+            messages.pop()
+            continue
+        t_end = time.monotonic()
+        stats.turn_latencies.append(t_end - t_start)
+        if t_first is not None and n_tokens > 1:
+            stats.turn_decode.append((t_end - t_first, n_tokens - 1))
+        stats.output_tokens += n_tokens
+        messages.append({"role": "assistant", "content": "".join(chunks)})
+
+
+def pct(values, p):
+    if not values:
+        return None
+    s = sorted(values)
+    return s[min(len(s) - 1, int(len(s) * p / 100))]
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://localhost:8000/openai")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--threads", type=int, default=8)
+    parser.add_argument("--turns", type=int, default=4)
+    parser.add_argument("--max-tokens", type=int, default=64)
+    args = parser.parse_args()
+
+    stats = [ThreadStats() for _ in range(args.threads)]
+    threads = [
+        threading.Thread(
+            target=run_thread,
+            args=(args.url, args.model, args.turns, args.max_tokens, f"conversation-{i}", stats[i]),
+        )
+        for i in range(args.threads)
+    ]
+    t0 = time.monotonic()
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    elapsed = time.monotonic() - t0
+
+    ttfts = [x for s in stats for x in s.ttfts]
+    itls = [x for s in stats for x in s.itls]
+    lats = [x for s in stats for x in s.turn_latencies]
+    total_tokens = sum(s.output_tokens for s in stats)
+    failures = sum(s.failures for s in stats)
+    n_requests = len(lats)
+
+    summary = {
+        "requests": n_requests,
+        "failures": failures,
+        "elapsed_s": round(elapsed, 2),
+        "req_per_s": round(n_requests / elapsed, 2) if elapsed else 0,
+        "output_tok_per_s": round(total_tokens / elapsed, 2) if elapsed else 0,
+        "ttft_ms": {
+            "mean": round(statistics.mean(ttfts) * 1000, 1) if ttfts else None,
+            "p50": round(pct(ttfts, 50) * 1000, 1) if ttfts else None,
+            "p99": round(pct(ttfts, 99) * 1000, 1) if ttfts else None,
+        },
+        "itl_ms": {
+            "mean": round(statistics.mean(itls) * 1000, 1) if itls else None,
+            "p50": round(pct(itls, 50) * 1000, 1) if itls else None,
+        },
+        # Per-turn decode time (TTFT excluded) over that turn's tokens.
+        "tpot_ms": round(
+            statistics.mean(dt / n for s in stats for dt, n in s.turn_decode) * 1000, 1
+        ) if any(s.turn_decode for s in stats) else None,
+    }
+    print(json.dumps(summary, indent=1))
+
+
+if __name__ == "__main__":
+    main()

@@ -255,6 +255,49 @@ class Engine:
             else:
                 raise RuntimeError(ev[1])
 
+    # -- embeddings --------------------------------------------------------
+
+    def embed(self, prompts: list[list[int]]) -> np.ndarray:
+        """Mean-pooled, L2-normalized final hidden states (the
+        TextEmbedding feature; the reference delegates this to Infinity
+        containers). Runs outside the decode loop — a one-shot cache-free
+        forward whose dispatch interleaves with decode chunks."""
+        if not hasattr(self, "_embed_jit"):
+            mc = self.model_config
+
+            def embed_fn(params, tokens, lengths):
+                B, S = tokens.shape
+                pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+                hidden, _ = llama.apply(params, mc, tokens, pos, return_hidden=True)
+                valid = (pos < lengths[:, None]).astype(jnp.float32)[..., None]
+                pooled = (hidden * valid).sum(1) / jnp.maximum(valid.sum(1), 1.0)
+                return pooled / jnp.maximum(
+                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+                )
+
+            self._embed_jit = jax.jit(embed_fn)
+
+        out = []
+        max_prompt = max(self.cfg.prefill_buckets)
+        B = self.cfg.max_slots
+        for start in range(0, len(prompts), B):
+            group = prompts[start : start + B]
+            longest = max(len(p) for p in group)
+            if longest > max_prompt:
+                raise ValueError(f"embedding input too long: {longest} > {max_prompt}")
+            bucket = self._bucket(longest)
+            # Batch dim padded to max_slots so the compile count is bounded
+            # by len(prefill_buckets), not by observed batch sizes; padding
+            # rows (length 0) pool to zeros and are sliced off.
+            tokens = np.zeros((B, bucket), np.int32)
+            lengths = np.zeros((B,), np.int32)
+            for i, p in enumerate(group):
+                tokens[i, : len(p)] = p
+                lengths[i] = len(p)
+            vecs = self._embed_jit(self.params, jnp.asarray(tokens), jnp.asarray(lengths))
+            out.append(np.asarray(jax.device_get(vecs))[: len(group)])
+        return np.concatenate(out, axis=0)
+
     # -- LoRA adapters -----------------------------------------------------
 
     def load_adapter(self, name: str, path: str) -> None:

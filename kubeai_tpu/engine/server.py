@@ -166,6 +166,8 @@ def _make_handler(srv: EngineServer):
                     self._completions(body, chat=False)
                 elif path == "/v1/chat/completions":
                     self._completions(body, chat=True)
+                elif path == "/v1/embeddings":
+                    self._embeddings(body)
                 elif path == "/v1/load_lora_adapter":
                     ok, msg = srv.load_adapter(body.get("lora_name", ""), body.get("lora_path", ""))
                     self._json(200 if ok else 400, {"status": msg})
@@ -184,6 +186,51 @@ def _make_handler(srv: EngineServer):
                     pass
 
         # ---- inference ----
+
+        def _embeddings(self, body: dict):
+            inputs = body.get("input")
+            if inputs is None:
+                return self._error(400, "input is required")
+            if isinstance(inputs, str):
+                inputs = [inputs]
+            if not isinstance(inputs, list) or not inputs:
+                return self._error(400, "input must be a string or list of strings")
+            tok = srv.engine.tokenizer
+            if all(isinstance(x, int) for x in inputs):
+                prompts = [list(inputs)]  # one pre-tokenized input
+            elif all(isinstance(x, str) for x in inputs):
+                prompts = [tok.encode(t) for t in inputs]
+            elif all(
+                isinstance(x, list) and all(isinstance(i, int) for i in x) for x in inputs
+            ):
+                prompts = [list(x) for x in inputs]  # batch of token arrays
+            else:
+                return self._error(
+                    400, "input must be a string, list of strings, or token array(s)"
+                )
+            if any(not p for p in prompts):
+                return self._error(400, "input entries must be non-empty")
+            try:
+                vecs = srv.engine.embed(prompts)
+            except ValueError as e:
+                return self._error(400, str(e))
+            import base64
+
+            fmt = body.get("encoding_format", "float")
+            data = []
+            for i, v in enumerate(vecs):
+                if fmt == "base64":
+                    emb = base64.b64encode(v.astype("<f4").tobytes()).decode()
+                else:
+                    emb = [float(x) for x in v]
+                data.append({"object": "embedding", "index": i, "embedding": emb})
+            n_tokens = sum(len(p) for p in prompts)
+            self._json(200, {
+                "object": "list",
+                "data": data,
+                "model": srv.model_name,
+                "usage": {"prompt_tokens": n_tokens, "total_tokens": n_tokens},
+            })
 
         def _parse_prompt(self, prompt):
             """OpenAI `prompt` accepts a string, a token-id list, a

@@ -248,6 +248,7 @@ def apply(
     lora: Params | None = None,  # adapter bank from init_lora_bank
     lora_rows: jnp.ndarray | None = None,  # [B] adapter index per batch row
     left_aligned: bool = False,  # caller guarantees positions == arange(S)
+    return_hidden: bool = False,  # final-norm hidden states instead of logits
 ):
     """Run the decoder. Returns (logits, new_cache).
 
@@ -401,6 +402,8 @@ def apply(
         new_cache = None
 
     x = rms_norm(x, params["final_norm"] + norm_offset, config.rms_norm_eps)
+    if return_hidden:
+        return x.astype(jnp.float32), new_cache
     if logits_idx is not None:
         x = x[batch_idx, logits_idx[:, None]]  # [B, 1, D]
     if config.tie_word_embeddings:

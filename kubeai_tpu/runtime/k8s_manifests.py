@@ -1,0 +1,260 @@
+"""Serialization between our workload dataclasses and Kubernetes manifests.
+
+The cluster deployment path: the controller's Pod/PVC/Job/ConfigMap
+objects render to real core/v1 + batch/v1 manifests (and Models to the
+CRD form mirroring the reference's kubeai.org/v1, ref:
+manifests/crds/kubeai.org_models.yaml). Used by the cluster store
+adapter and by `python -m kubeai_tpu.runtime.k8s_manifests` to emit
+deployable YAML from a local store for inspection/GitOps.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+from kubeai_tpu.api.core_types import PVC, ConfigMap, Container, Job, Pod, Probe
+from kubeai_tpu.api.model_types import Model
+
+GROUP = "kubeai.org"
+VERSION = "v1"
+
+
+def _meta(obj) -> dict[str, Any]:
+    m: dict[str, Any] = {"name": obj.meta.name}
+    if obj.meta.namespace != "default":
+        m["namespace"] = obj.meta.namespace
+    if obj.meta.labels:
+        m["labels"] = dict(obj.meta.labels)
+    if obj.meta.annotations:
+        m["annotations"] = dict(obj.meta.annotations)
+    if obj.meta.finalizers:
+        m["finalizers"] = list(obj.meta.finalizers)
+    return m
+
+
+def _probe(p: Probe | None) -> dict | None:
+    if p is None:
+        return None
+    out: dict[str, Any] = {
+        "periodSeconds": p.period_seconds,
+        "failureThreshold": p.failure_threshold,
+        "timeoutSeconds": p.timeout_seconds,
+    }
+    if p.initial_delay_seconds:
+        out["initialDelaySeconds"] = p.initial_delay_seconds
+    if p.path.startswith("exec:"):
+        out["exec"] = {"command": ["/bin/sh", "-c", p.path[len("exec:") :]]}
+    else:
+        out["httpGet"] = {"path": p.path, "port": p.port}
+    return out
+
+
+def _container(c: Container) -> dict[str, Any]:
+    out: dict[str, Any] = {"name": c.name or "server", "image": c.image}
+    if c.command:
+        out["command"] = list(c.command)
+    if c.args:
+        out["args"] = list(c.args)
+    env = []
+    env_from = []
+    for k, v in c.env.items():
+        if k.startswith("__envFromSecret_"):
+            env_from.append({"secretRef": {"name": v, "optional": True}})
+        else:
+            env.append({"name": k, "value": v})
+    if env:
+        out["env"] = env
+    if env_from:
+        out["envFrom"] = env_from
+    if c.ports:
+        out["ports"] = [{"containerPort": p} for p in c.ports]
+    resources = {}
+    if c.resources_requests:
+        resources["requests"] = dict(c.resources_requests)
+    if c.resources_limits:
+        resources["limits"] = dict(c.resources_limits)
+    if resources:
+        out["resources"] = resources
+    if c.volume_mounts:
+        out["volumeMounts"] = [
+            {
+                "name": m.name,
+                "mountPath": m.mount_path,
+                **({"subPath": m.sub_path} if m.sub_path else {}),
+                **({"readOnly": True} if m.read_only else {}),
+            }
+            for m in c.volume_mounts
+        ]
+    for attr, key in [
+        ("startup_probe", "startupProbe"),
+        ("readiness_probe", "readinessProbe"),
+        ("liveness_probe", "livenessProbe"),
+    ]:
+        p = _probe(getattr(c, attr))
+        if p:
+            out[key] = p
+    return out
+
+
+def _pod_spec(spec) -> dict[str, Any]:
+    out: dict[str, Any] = {
+        "containers": [_container(c) for c in spec.containers],
+    }
+    if spec.init_containers:
+        out["initContainers"] = [_container(c) for c in spec.init_containers]
+    volumes = []
+    for v in spec.volumes:
+        vol: dict[str, Any] = {"name": v.name}
+        if v.empty_dir:
+            vol["emptyDir"] = {}
+        elif v.pvc_name:
+            vol["persistentVolumeClaim"] = {"claimName": v.pvc_name}
+        elif v.config_map_name:
+            vol["configMap"] = {"name": v.config_map_name}
+        elif v.host_path:
+            vol["hostPath"] = {"path": v.host_path}
+        volumes.append(vol)
+    if volumes:
+        out["volumes"] = volumes
+    if spec.node_selector:
+        out["nodeSelector"] = dict(spec.node_selector)
+    if spec.tolerations:
+        out["tolerations"] = list(spec.tolerations)
+    if spec.affinity:
+        out["affinity"] = dict(spec.affinity)
+    for attr, key in [
+        ("scheduler_name", "schedulerName"),
+        ("runtime_class_name", "runtimeClassName"),
+        ("priority_class_name", "priorityClassName"),
+        ("service_account_name", "serviceAccountName"),
+        ("subdomain", "subdomain"),
+        ("hostname", "hostname"),
+    ]:
+        val = getattr(spec, attr)
+        if val:
+            out[key] = val
+    if spec.restart_policy != "Always":
+        out["restartPolicy"] = spec.restart_policy
+    return out
+
+
+def pod_manifest(pod: Pod) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "Pod",
+        "metadata": _meta(pod),
+        "spec": _pod_spec(pod.spec),
+    }
+
+
+def job_manifest(job: Job) -> dict[str, Any]:
+    spec = _pod_spec(job.spec)
+    spec.setdefault("restartPolicy", "OnFailure")
+    return {
+        "apiVersion": "batch/v1",
+        "kind": "Job",
+        "metadata": _meta(job),
+        "spec": {
+            "backoffLimit": job.backoff_limit,
+            "template": {"spec": spec},
+        },
+    }
+
+
+def pvc_manifest(pvc: PVC) -> dict[str, Any]:
+    spec: dict[str, Any] = {
+        "accessModes": list(pvc.spec.access_modes),
+        "resources": {"requests": {"storage": pvc.spec.storage}},
+    }
+    if pvc.spec.storage_class_name:
+        spec["storageClassName"] = pvc.spec.storage_class_name
+    return {
+        "apiVersion": "v1",
+        "kind": "PersistentVolumeClaim",
+        "metadata": _meta(pvc),
+        "spec": spec,
+    }
+
+
+def configmap_manifest(cm: ConfigMap) -> dict[str, Any]:
+    return {
+        "apiVersion": "v1",
+        "kind": "ConfigMap",
+        "metadata": _meta(cm),
+        "data": dict(cm.data),
+    }
+
+
+def model_manifest(model: Model) -> dict[str, Any]:
+    """Model -> kubeai.org/v1 CRD form (camelCase field names matching
+    catalog.model_from_manifest's input, i.e. round-trippable)."""
+    s = model.spec
+    spec: dict[str, Any] = {"url": s.url, "engine": s.engine, "features": list(s.features)}
+    if s.resource_profile:
+        spec["resourceProfile"] = s.resource_profile
+    if s.cache_profile:
+        spec["cacheProfile"] = s.cache_profile
+    if s.args:
+        spec["args"] = list(s.args)
+    if s.env:
+        spec["env"] = dict(s.env)
+    if s.replicas is not None:
+        spec["replicas"] = s.replicas
+    if s.min_replicas:
+        spec["minReplicas"] = s.min_replicas
+    if s.max_replicas is not None:
+        spec["maxReplicas"] = s.max_replicas
+    if s.autoscaling_disabled:
+        spec["autoscalingDisabled"] = True
+    if s.target_requests != 100:
+        spec["targetRequests"] = s.target_requests
+    if s.scale_down_delay_seconds != 30:
+        spec["scaleDownDelaySeconds"] = s.scale_down_delay_seconds
+    from kubeai_tpu.api.model_types import LoadBalancing
+
+    if s.load_balancing != LoadBalancing():
+        ph = s.load_balancing.prefix_hash
+        spec["loadBalancing"] = {
+            "strategy": s.load_balancing.strategy,
+            "prefixHash": {
+                "meanLoadPercentage": ph.mean_load_percentage,
+                "replication": ph.replication,
+                "prefixCharLength": ph.prefix_char_length,
+            },
+        }
+    if s.adapters:
+        spec["adapters"] = [{"name": a.name, "url": a.url} for a in s.adapters]
+    if s.files:
+        spec["files"] = [{"path": f.path, "content": f.content} for f in s.files]
+    if s.priority_class_name:
+        spec["priorityClassName"] = s.priority_class_name
+    if s.owner:
+        spec["owner"] = s.owner
+    return {
+        "apiVersion": f"{GROUP}/{VERSION}",
+        "kind": "Model",
+        "metadata": _meta(model),
+        "spec": spec,
+    }
+
+
+MANIFEST_FNS = {
+    "Pod": pod_manifest,
+    "Job": job_manifest,
+    "PersistentVolumeClaim": pvc_manifest,
+    "ConfigMap": configmap_manifest,
+    "Model": model_manifest,
+}
+
+
+def render_store(store, kinds=None) -> str:
+    """All objects of the given kinds in a store -> multi-doc YAML."""
+    import yaml
+
+    docs = []
+    for kind, fn in MANIFEST_FNS.items():
+        if kinds and kind not in kinds:
+            continue
+        for obj in store.list(kind, namespace=None):
+            docs.append(fn(obj))
+    return yaml.safe_dump_all(docs, sort_keys=False)

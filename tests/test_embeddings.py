@@ -1,0 +1,72 @@
+"""Native-engine embeddings: /v1/embeddings feature parity."""
+
+import base64
+import json
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kubeai_tpu.engine.core import build_test_engine
+from kubeai_tpu.engine.server import EngineServer
+
+
+@pytest.fixture(scope="module")
+def server():
+    eng = build_test_engine()
+    srv = EngineServer(eng, "embedder", host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def post(srv, body):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/embeddings",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=120) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_single_and_batch(server):
+    status, body = post(server, {"model": "embedder", "input": "hello world"})
+    assert status == 200
+    assert len(body["data"]) == 1
+    v = np.asarray(body["data"][0]["embedding"])
+    assert v.shape == (128,)  # hidden size of the test model
+    np.testing.assert_allclose(np.linalg.norm(v), 1.0, rtol=1e-5)
+
+    status, body = post(server, {"model": "embedder", "input": ["a", "b", "c", "d", "e"]})
+    assert status == 200
+    assert [d["index"] for d in body["data"]] == [0, 1, 2, 3, 4]
+
+
+def test_deterministic_and_input_sensitive(server):
+    _, b1 = post(server, {"model": "embedder", "input": "same text"})
+    _, b2 = post(server, {"model": "embedder", "input": "same text"})
+    _, b3 = post(server, {"model": "embedder", "input": "different text"})
+    v1 = np.asarray(b1["data"][0]["embedding"])
+    v2 = np.asarray(b2["data"][0]["embedding"])
+    v3 = np.asarray(b3["data"][0]["embedding"])
+    np.testing.assert_allclose(v1, v2)
+    assert np.abs(v1 - v3).max() > 1e-4
+
+
+def test_base64_format(server):
+    _, fb = post(server, {"model": "embedder", "input": "x"})
+    _, bb = post(
+        server, {"model": "embedder", "input": "x", "encoding_format": "base64"}
+    )
+    decoded = np.frombuffer(base64.b64decode(bb["data"][0]["embedding"]), "<f4")
+    np.testing.assert_allclose(decoded, fb["data"][0]["embedding"], rtol=1e-6)
+
+
+def test_validation(server):
+    assert post(server, {"model": "m"})[0] == 400
+    assert post(server, {"model": "m", "input": []})[0] == 400
+    assert post(server, {"model": "m", "input": "x" * 100_000})[0] == 400

@@ -1,0 +1,79 @@
+"""Dataclass -> k8s manifest serialization, incl. Model round-trip."""
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.model_types import Adapter, Model, ModelSpec
+from kubeai_tpu.catalog import model_from_manifest
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.runtime.k8s_manifests import (
+    model_manifest,
+    pod_manifest,
+    render_store,
+)
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+
+def test_tpu_pod_manifest_shape():
+    store = Store()
+    system = System().default_and_validate()
+    rec = ModelReconciler(store, system)
+    store.create(
+        mt.KIND_MODEL,
+        Model(
+            meta=ObjectMeta(name="m1"),
+            spec=ModelSpec(
+                url="hf://org/model", resource_profile="tpu-v5e-2x2:1", replicas=1
+            ),
+        ),
+    )
+    for _ in range(3):
+        rec.reconcile("m1")
+    pod = store.list("Pod", selector={"model": "m1"})[0]
+    doc = pod_manifest(pod)
+    assert doc["apiVersion"] == "v1" and doc["kind"] == "Pod"
+    server = doc["spec"]["containers"][0]
+    assert server["resources"]["limits"]["google.com/tpu"] == "4"
+    assert doc["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
+    assert server["readinessProbe"]["httpGet"]["path"] == "/health"
+    assert any(e["name"] == "PYTHONUNBUFFERED" for e in server["env"])
+    # HF secret becomes envFrom.
+    assert any("secretRef" in e for e in server.get("envFrom", []))
+
+
+def test_model_manifest_roundtrip():
+    m = Model(
+        meta=ObjectMeta(name="rt", namespace="prod"),
+        spec=ModelSpec(
+            url="hf://a/b",
+            engine=mt.ENGINE_TPU,
+            resource_profile="tpu-v5e-1x1:1",
+            min_replicas=2,
+            max_replicas=5,
+            target_requests=64,
+            adapters=[Adapter(name="ad1", url="hf://c/d")],
+        ),
+    )
+    doc = model_manifest(m)
+    back = model_from_manifest(doc)
+    assert back.meta.name == "rt" and back.meta.namespace == "prod"
+    assert back.spec.url == m.spec.url
+    assert back.spec.min_replicas == 2 and back.spec.max_replicas == 5
+    assert back.spec.target_requests == 64
+    assert back.spec.adapters[0].name == "ad1"
+
+
+def test_render_store_yaml_parses():
+    import yaml
+
+    store = Store()
+    system = System().default_and_validate()
+    rec = ModelReconciler(store, system)
+    store.create(
+        mt.KIND_MODEL,
+        Model(meta=ObjectMeta(name="m1"), spec=ModelSpec(url="hf://a/b", replicas=1)),
+    )
+    for _ in range(3):
+        rec.reconcile("m1")
+    docs = list(yaml.safe_load_all(render_store(store)))
+    kinds = {d["kind"] for d in docs}
+    assert kinds == {"Model", "Pod"}
