@@ -149,7 +149,6 @@ def model_from_manifest(doc: dict) -> Model:
     fields are rejected rather than silently dropped."""
     from kubeai_tpu.config.system import _build
 
-    meta = doc.get("metadata", {})
     spec_doc = dict(doc.get("spec", {}))
     # Manifest alias (reference CRD field name) -> dataclass field.
     lb = spec_doc.get("loadBalancing")
@@ -159,15 +158,15 @@ def model_from_manifest(doc: dict) -> Model:
             ph["meanLoadPercentage"] = ph.pop("meanLoadFactor")
         spec_doc["loadBalancing"] = {**lb, "prefixHash": ph}
     spec = _build(ModelSpec, spec_doc)
-    m = Model(
-        meta=ObjectMeta(
-            name=meta.get("name", ""),
-            namespace=meta.get("namespace", "default"),
-            labels=meta.get("labels", {}) or {},
-            annotations=meta.get("annotations", {}) or {},
-        ),
-        spec=spec,
-    )
+    from kubeai_tpu.runtime.k8s_parse import parse_meta
+
+    m = Model(meta=parse_meta(doc), spec=spec)
+    status_doc = doc.get("status") or {}
+    if status_doc:
+        reps = status_doc.get("replicas") or {}
+        m.status.replicas_all = reps.get("all", 0)
+        m.status.replicas_ready = reps.get("ready", 0)
+        m.status.cache_loaded = (status_doc.get("cache") or {}).get("loaded", False)
     default_model(m)
     validate_model(m)
     return m

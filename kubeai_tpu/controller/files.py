@@ -36,6 +36,7 @@ def ensure_model_files_configmap(store: Store, model: Model) -> None:
             meta=ObjectMeta(
                 name=name,
                 namespace=model.meta.namespace,
+                labels={"model": model.meta.name},
                 owner_uids=[model.meta.uid],
             ),
             data=data,

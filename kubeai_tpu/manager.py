@@ -125,6 +125,13 @@ def main(argv=None):
     parser = argparse.ArgumentParser("kubeai-tpu-manager")
     parser.add_argument("--config", default=os.environ.get("CONFIG_PATH"))
     parser.add_argument("--local", action="store_true", help="run pods as local processes")
+    parser.add_argument(
+        "--kube",
+        action="store_true",
+        default=bool(os.environ.get("KUBERNETES_SERVICE_HOST")),
+        help="back the store with the kube-apiserver (auto-detected in-cluster)",
+    )
+    parser.add_argument("--kube-api-server", default=None, help="apiserver URL (dev: kubectl proxy)")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--models", default=None, help="YAML file of Model manifests to apply at boot")
@@ -132,7 +139,15 @@ def main(argv=None):
     logging.basicConfig(level=logging.INFO)
 
     system = load_system_config(args.config) if args.config else System().default_and_validate()
-    mgr = Manager(system, local_runtime=args.local, host=args.host, port=args.port)
+    store = None
+    want_kube = args.kube or bool(args.kube_api_server)
+    if want_kube and args.local:
+        log.warning("--local overrides --kube: pods run as local processes on the in-memory store")
+    if want_kube and not args.local:
+        from kubeai_tpu.runtime.k8s import KubeStore
+
+        store = KubeStore(api_server=args.kube_api_server)
+    mgr = Manager(system, store=store, local_runtime=args.local, host=args.host, port=args.port)
     mgr.start()
 
     if args.models:

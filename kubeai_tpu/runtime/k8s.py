@@ -1,0 +1,345 @@
+"""KubeStore — the Store interface backed by a real kube-apiserver.
+
+The control plane (controller, LB, autoscaler, cache, adapters) programs
+against the Store surface; this adapter maps it onto the Kubernetes REST
+API so the exact same components run in-cluster (the reference's
+controller-runtime role). Models are stored as the kubeai.org/v1 CRD
+(deploy/crds/), workloads as core/v1 + batch/v1 objects, the autoscaler
+state and leases as ConfigMap-backed records.
+
+Transport is stdlib urllib against the in-cluster endpoint (service
+account bearer token + CA bundle); watches use the apiserver's streaming
+`?watch=true` JSON-lines protocol fanned into the same WatchEvent queues
+the in-memory store provides. No kubernetes client dependency.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import ssl
+import threading
+import urllib.error
+import urllib.request
+from typing import Any, Callable
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_CONFIGMAP, KIND_JOB, KIND_POD, KIND_PVC
+from kubeai_tpu.catalog import model_from_manifest
+from kubeai_tpu.runtime import k8s_manifests as enc
+from kubeai_tpu.runtime import k8s_parse as dec
+from kubeai_tpu.runtime.store import (
+    AlreadyExists,
+    Conflict,
+    NotFound,
+    WatchEvent,
+    match_labels,
+)
+
+log = logging.getLogger("kubeai_tpu.kubestore")
+
+SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+# kind -> (api prefix, plural, encoder, decoder)
+_KINDS: dict[str, tuple[str, str, Callable, Callable]] = {
+    mt.KIND_MODEL: ("/apis/kubeai.org/v1", "models", enc.model_manifest, model_from_manifest),
+    KIND_POD: ("/api/v1", "pods", enc.pod_manifest, dec.parse_pod),
+    KIND_JOB: ("/apis/batch/v1", "jobs", enc.job_manifest, dec.parse_job),
+    KIND_PVC: ("/api/v1", "persistentvolumeclaims", enc.pvc_manifest, dec.parse_pvc),
+    KIND_CONFIGMAP: ("/api/v1", "configmaps", enc.configmap_manifest, dec.parse_configmap),
+}
+
+# Internal record kinds (Lease, AutoscalerState) persist as ConfigMaps —
+# the reference stores autoscaler state the same way (ref:
+# internal/modelautoscaler/state.go) and leases via coordination/v1.
+RECORD_LABEL = "records.kubeai.org/kind"
+
+
+def _record_types() -> dict[str, Callable[[dict], Any]]:
+    from kubeai_tpu.autoscaler.autoscaler import AutoscalerState
+    from kubeai_tpu.autoscaler.leader import Lease
+    from kubeai_tpu.runtime.store import ObjectMeta
+
+    def build(cls):
+        def decode(payload: dict) -> Any:
+            meta = ObjectMeta(**payload.pop("meta"))
+            return cls(meta=meta, **payload)
+
+        return decode
+
+    return {"Lease": build(Lease), "AutoscalerState": build(AutoscalerState)}
+
+
+class KubeStore:
+    def __init__(
+        self,
+        api_server: str | None = None,
+        token: str | None = None,
+        ca_file: str | None = None,
+        namespace: str | None = None,
+    ):
+        host = os.environ.get("KUBERNETES_SERVICE_HOST")
+        port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+        self.base = api_server or (f"https://{host}:{port}" if host else "http://127.0.0.1:8001")
+        self.token = token
+        if self.token is None and os.path.exists(f"{SA_DIR}/token"):
+            with open(f"{SA_DIR}/token") as f:
+                self.token = f.read().strip()
+        self.namespace = namespace or self._default_namespace()
+        self._ctx: ssl.SSLContext | None = None
+        ca = ca_file or (f"{SA_DIR}/ca.crt" if os.path.exists(f"{SA_DIR}/ca.crt") else None)
+        if self.base.startswith("https"):
+            self._ctx = ssl.create_default_context(cafile=ca)
+        self._watch_threads: list[threading.Thread] = []
+        self._watching = True
+
+    @staticmethod
+    def _default_namespace() -> str:
+        if os.path.exists(f"{SA_DIR}/namespace"):
+            with open(f"{SA_DIR}/namespace") as f:
+                return f.read().strip()
+        return os.environ.get("POD_NAMESPACE", "default")
+
+    # -- REST plumbing -----------------------------------------------------
+
+    def _url(self, kind: str, namespace: str, name: str = "", query: str = "") -> str:
+        prefix, plural, _, _ = _KINDS[kind]
+        url = f"{self.base}{prefix}/namespaces/{namespace}/{plural}"
+        if name:
+            url += f"/{name}"
+        if query:
+            url += f"?{query}"
+        return url
+
+    def _request(self, method: str, url: str, body: dict | None = None, content_type: str = "application/json") -> Any:
+        data = json.dumps(body).encode() if body is not None else None
+        req = urllib.request.Request(url, data=data, method=method)
+        req.add_header("Accept", "application/json")
+        if data is not None:
+            req.add_header("Content-Type", content_type)
+        if self.token:
+            req.add_header("Authorization", f"Bearer {self.token}")
+        try:
+            with urllib.request.urlopen(req, timeout=30, context=self._ctx) as resp:
+                payload = resp.read()
+                return json.loads(payload) if payload else {}
+        except urllib.error.HTTPError as e:
+            detail = e.read().decode(errors="replace")[:300]
+            if e.code == 404:
+                raise NotFound(f"{method} {url}: {detail}") from None
+            if e.code == 409:
+                if "AlreadyExists" in detail or method == "POST":
+                    raise AlreadyExists(detail) from None
+                raise Conflict(detail) from None
+            raise RuntimeError(f"{method} {url}: {e.code} {detail}") from None
+
+    # -- record kinds (ConfigMap-backed) -----------------------------------
+
+    def _record_cm_name(self, kind: str, name: str) -> str:
+        return f"rec-{kind.lower()}-{name}".replace("_", "-").replace(".", "-")
+
+    def _record_encode(self, kind: str, obj: Any) -> dict:
+        import dataclasses
+
+        payload = dataclasses.asdict(obj)
+        return {
+            "apiVersion": "v1",
+            "kind": "ConfigMap",
+            "metadata": {
+                "name": self._record_cm_name(kind, obj.meta.name),
+                "namespace": obj.meta.namespace,
+                "labels": {RECORD_LABEL: kind},
+            },
+            "data": {"payload": json.dumps(payload)},
+        }
+
+    def _record_decode(self, kind: str, doc: dict) -> Any:
+        payload = json.loads(doc["data"]["payload"])
+        obj = _record_types()[kind](payload)
+        obj.meta.resource_version = int(doc["metadata"].get("resourceVersion", 0) or 0)
+        return obj
+
+    # -- Store interface ---------------------------------------------------
+
+    def create(self, kind: str, obj: Any) -> Any:
+        if kind not in _KINDS:
+            doc = self._request(
+                "POST",
+                self._url(KIND_CONFIGMAP, obj.meta.namespace),
+                self._record_encode(kind, obj),
+            )
+            return self._record_decode(kind, doc)
+        _, _, encode, decode = _KINDS[kind]
+        doc = self._request("POST", self._url(kind, obj.meta.namespace), encode(obj))
+        return decode(doc)
+
+    def get(self, kind: str, name: str, namespace: str = "default") -> Any:
+        if kind not in _KINDS:
+            doc = self._request(
+                "GET", self._url(KIND_CONFIGMAP, namespace, self._record_cm_name(kind, name))
+            )
+            return self._record_decode(kind, doc)
+        _, _, _, decode = _KINDS[kind]
+        return decode(self._request("GET", self._url(kind, namespace, name)))
+
+    def list(self, kind: str, namespace: str | None = "default", selector: dict[str, str] | None = None) -> list[Any]:
+        if kind not in _KINDS:
+            # Record kinds: labelSelector'd ConfigMap list.
+            cms = self.list(KIND_CONFIGMAP, namespace, {RECORD_LABEL: kind})
+            out = []
+            for cm in cms:
+                obj = self._record_decode(kind, {"data": cm.data, "metadata": {"resourceVersion": cm.meta.resource_version}})
+                if match_labels(obj.meta.labels, selector):
+                    out.append(obj)
+            return out
+        _, plural, _, decode = _KINDS[kind]
+        query = ""
+        if selector:
+            query = "labelSelector=" + ",".join(f"{k}%3D{v}" for k, v in selector.items())
+        if namespace is None:
+            prefix = _KINDS[kind][0]
+            url = f"{self.base}{prefix}/{plural}" + (f"?{query}" if query else "")
+        else:
+            url = self._url(kind, namespace, query=query)
+        doc = self._request("GET", url)
+        out = []
+        for item in doc.get("items", []):
+            try:
+                out.append(decode(item))
+            except Exception as e:
+                # One undecodable (foreign) object must not poison the
+                # whole control plane.
+                log.warning("skipping undecodable %s %s: %s", kind, (item.get("metadata") or {}).get("name"), e)
+        return out
+
+    def update(self, kind: str, obj: Any, check_version: bool = True) -> Any:
+        if kind not in _KINDS:
+            doc = self._record_encode(kind, obj)
+            if check_version and obj.meta.resource_version:
+                doc["metadata"]["resourceVersion"] = str(obj.meta.resource_version)
+            out = self._request(
+                "PUT",
+                self._url(KIND_CONFIGMAP, obj.meta.namespace, doc["metadata"]["name"]),
+                doc,
+            )
+            return self._record_decode(kind, out)
+        _, _, encode, decode = _KINDS[kind]
+        doc = encode(obj)
+        status = doc.pop("status", None)
+        if check_version and obj.meta.resource_version:
+            doc["metadata"]["resourceVersion"] = str(obj.meta.resource_version)
+        out = self._request("PUT", self._url(kind, obj.meta.namespace, obj.meta.name), doc)
+        if status is not None and kind == mt.KIND_MODEL:
+            # The Model CRD enables the status subresource: main-resource
+            # PUTs strip .status, so status changes go to /status.
+            status_doc = {
+                "apiVersion": doc["apiVersion"],
+                "kind": doc["kind"],
+                "metadata": {
+                    "name": obj.meta.name,
+                    "resourceVersion": out.get("metadata", {}).get("resourceVersion"),
+                },
+                "status": status,
+            }
+            try:
+                out = self._request(
+                    "PUT",
+                    self._url(kind, obj.meta.namespace, obj.meta.name) + "/status",
+                    status_doc,
+                )
+            except (NotFound, Conflict):
+                pass  # subresource disabled (dev servers) or raced; next
+                # reconcile converges status
+        return decode(out)
+
+    def mutate(self, kind: str, name: str, fn, namespace: str = "default", retries: int = 10) -> Any:
+        for _ in range(retries):
+            obj = self.get(kind, name, namespace)
+            fn(obj)
+            try:
+                return self.update(kind, obj)
+            except Conflict:
+                continue
+        raise Conflict(f"{kind} {namespace}/{name}: too many conflicts")
+
+    def delete(self, kind: str, name: str, namespace: str = "default") -> None:
+        if kind not in _KINDS:
+            self._request(
+                "DELETE",
+                self._url(KIND_CONFIGMAP, namespace, self._record_cm_name(kind, name)),
+            )
+            return
+        self._request("DELETE", self._url(kind, namespace, name))
+
+    def delete_all_of(self, kind: str, namespace: str = "default", selector: dict[str, str] | None = None) -> int:
+        objs = self.list(kind, namespace, selector)
+        for obj in objs:
+            try:
+                self.delete(kind, obj.meta.name, namespace)
+            except NotFound:
+                pass
+        return len(objs)
+
+    # -- watch -------------------------------------------------------------
+
+    def watch(self, kind: str | None = None) -> "queue.Queue[WatchEvent]":
+        """Streamed apiserver watch fanned into a queue. Each (re)connect
+        starts with a fresh LIST emitted as synthetic ADDED events, so
+        events dropped in the list->watch gap or during a reconnect window
+        are resynced — consumers are level-triggered and tolerate
+        repeats (same contract as the in-memory store's initial replay)."""
+        q: "queue.Queue[WatchEvent]" = queue.Queue()
+        kinds = [kind] if kind else list(_KINDS)
+        for k in kinds:
+            t = threading.Thread(
+                target=self._watch_loop, args=(k, q), name=f"kube-watch-{k}", daemon=True
+            )
+            t.start()
+            self._watch_threads.append(t)
+        return q
+
+    def unwatch(self, q) -> None:  # watches die with the process
+        pass
+
+    def _watch_loop(self, kind: str, q: "queue.Queue[WatchEvent]"):
+        _, _, _, decode = _KINDS[kind]
+        import time
+
+        while self._watching:
+            try:
+                # Open the watch FIRST, then resync via list (synthetic
+                # ADDED events): anything created in the gap arrives on the
+                # already-open stream, and duplicates are harmless to the
+                # level-triggered consumers. Each reconnect repeats the
+                # resync, covering events lost while disconnected.
+                url = self._url(kind, self.namespace, query="watch=true")
+                req = urllib.request.Request(url)
+                req.add_header("Accept", "application/json")
+                if self.token:
+                    req.add_header("Authorization", f"Bearer {self.token}")
+                with urllib.request.urlopen(req, timeout=330, context=self._ctx) as resp:
+                    list_doc = self._request("GET", self._url(kind, self.namespace))
+                    for item in list_doc.get("items", []):
+                        try:
+                            q.put(WatchEvent("ADDED", kind, decode(item)))
+                        except Exception:
+                            continue
+                    for line in resp:
+                        if not self._watching:
+                            return
+                        try:
+                            ev = json.loads(line)
+                            q.put(WatchEvent(ev["type"], kind, decode(ev["object"])))
+                        except Exception:
+                            # Undecodable event (foreign object, partial
+                            # line): skip; resync covers any gap.
+                            continue
+            except Exception as e:
+                if self._watching:
+                    log.warning("watch %s dropped (%s); resyncing", kind, e)
+                time.sleep(2)
+
+    def close(self):
+        self._watching = False

@@ -29,6 +29,22 @@ def _meta(obj) -> dict[str, Any]:
         m["annotations"] = dict(obj.meta.annotations)
     if obj.meta.finalizers:
         m["finalizers"] = list(obj.meta.finalizers)
+    if obj.meta.owner_uids:
+        # The only ownership edge the control plane creates is Model ->
+        # workload, and every owned object carries the model label; kube
+        # GC then cascades deletes the way the in-memory store does.
+        owner_name = obj.meta.labels.get("model", "")
+        if owner_name:
+            m["ownerReferences"] = [
+                {
+                    "apiVersion": f"{GROUP}/{VERSION}",
+                    "kind": "Model",
+                    "name": owner_name,
+                    "uid": uid,
+                    "controller": True,
+                }
+                for uid in obj.meta.owner_uids
+            ]
     return m
 
 
@@ -230,12 +246,19 @@ def model_manifest(model: Model) -> dict[str, Any]:
         spec["priorityClassName"] = s.priority_class_name
     if s.owner:
         spec["owner"] = s.owner
-    return {
+    doc = {
         "apiVersion": f"{GROUP}/{VERSION}",
         "kind": "Model",
         "metadata": _meta(model),
         "spec": spec,
     }
+    st = model.status
+    if st.replicas_all or st.replicas_ready or st.cache_loaded:
+        doc["status"] = {
+            "replicas": {"all": st.replicas_all, "ready": st.replicas_ready},
+            "cache": {"loaded": st.cache_loaded},
+        }
+    return doc
 
 
 MANIFEST_FNS = {
