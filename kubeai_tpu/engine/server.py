@@ -426,7 +426,9 @@ def build_engine_from_args(args) -> tuple[Engine, str]:
     # safetensors weights.
     from kubeai_tpu.engine.weights import load_engine_from_path
 
-    eng = load_engine_from_path(args.model, ec, tp=args.tensor_parallel_size)
+    eng = load_engine_from_path(
+        args.model, ec, tp=args.tensor_parallel_size, quantization=args.quantization
+    )
     return eng, args.served_model_name or args.model
 
 
@@ -449,6 +451,7 @@ def main(argv=None):
     parser.add_argument("--max-slots", type=int, default=8)
     parser.add_argument("--max-seq-len", type=int, default=2048)
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
+    parser.add_argument("--quantization", default="", choices=["", "int8"])
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 

@@ -66,13 +66,40 @@ def pad_vocab(params, config: ModelConfig, multiple: int) -> tuple[dict, ModelCo
     return params, config.replace(vocab_size=target)
 
 
+def quantize_model_params(params: dict, config: ModelConfig) -> dict:
+    """Weight-only int8: per-output-channel scales on the projection
+    weights, per-row scales on the embedding. Dense models only (MoE
+    expert einsums keep their dtype); norms and the router stay small and
+    full precision."""
+    from kubeai_tpu.ops.quant import quantize, quantize_rows
+
+    out = dict(params)
+    out["embed"] = quantize_rows(params["embed"])
+    if "lm_head" in params:
+        out["lm_head"] = quantize(params["lm_head"], contract_axis=-2)
+    layers = dict(params["layers"])
+    targets = ("wq", "wk", "wv", "wo") + (
+        () if config.num_experts > 0 else ("wg", "wu", "wd")
+    )
+    for t in targets:
+        layers[t] = quantize(layers[t], contract_axis=-2)
+    out["layers"] = layers
+    return out
+
+
 def load_engine_from_path(
     path: str,
     engine_config: EngineConfig | None = None,
     tp: int = 1,
     dtype: str = "bfloat16",
+    quantization: str = "",
 ) -> Engine:
     """Build an Engine from an HF-format checkpoint directory."""
+    if quantization:
+        if quantization != "int8":
+            raise ValueError(f"unsupported quantization {quantization!r} (supported: int8)")
+        if tp > 1:
+            raise ValueError("int8 quantization currently supports tensor-parallel-size 1")
     config = ModelConfig.from_json_file(path).replace(dtype=dtype)
     if jax.default_backend() == "tpu":
         config = config.replace(use_flash_prefill=True)
@@ -81,6 +108,8 @@ def load_engine_from_path(
         config = config.replace(tie_word_embeddings=True)
     params = llama.params_from_hf(sd, config)
     params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
+    if quantization == "int8":
+        params = quantize_model_params(params, config)
 
     ec = engine_config or EngineConfig()
     tokenizer = load_tokenizer(path)

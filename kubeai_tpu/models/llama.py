@@ -35,6 +35,7 @@ import numpy as np
 from kubeai_tpu.models.base import ModelConfig
 from kubeai_tpu.ops.attention import attention
 from kubeai_tpu.ops.norms import rms_norm
+from kubeai_tpu.ops.quant import qdot, qgather, qmatT
 from kubeai_tpu.ops.rope import apply_rope, rope_frequencies
 
 Params = dict[str, Any]
@@ -265,7 +266,7 @@ def apply(
     H, Kv, h = config.num_heads, config.num_kv_heads, config.head_dim_
     inv_freq = jnp.asarray(rope_frequencies(h, config.rope_theta, config.rope_scaling))
 
-    x = params["embed"].astype(jnp.dtype(config.dtype))[tokens]
+    x = qgather(params["embed"], tokens, jnp.dtype(config.dtype))
     if config.embed_scale:
         # Gemma multiplies embeddings by sqrt(hidden), rounded through the
         # compute dtype (HF casts the normalizer).
@@ -314,7 +315,7 @@ def apply(
 
     def layer(x, w, k_cache_l, v_cache_l, lora_l=None, sliding=None):
         def proj(inp, name):
-            out = inp @ w[name]
+            out = qdot(inp, w[name])
             if lora_l is not None:
                 out = out + _lora_delta(
                     inp, lora_l[name + "_A"], lora_l[name + "_B"], lora_rows, lora["scale"]
@@ -407,9 +408,9 @@ def apply(
     if logits_idx is not None:
         x = x[batch_idx, logits_idx[:, None]]  # [B, 1, D]
     if config.tie_word_embeddings:
-        logits = x @ params["embed"].astype(x.dtype).T
+        logits = qmatT(x, params["embed"])
     else:
-        logits = x @ params["lm_head"]
+        logits = qdot(x, params["lm_head"])
     logits = logits.astype(jnp.float32)
     if config.logit_softcap > 0.0:
         logits = config.logit_softcap * jnp.tanh(logits / config.logit_softcap)

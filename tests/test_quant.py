@@ -1,0 +1,138 @@
+"""int8 weight-only quantization: op-level exactness properties and
+model-level closeness + engine e2e."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from kubeai_tpu.engine.weights import quantize_model_params
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.ops.quant import dequantize, qdot, qgather, qmatT, quantize, quantize_rows
+
+CFG = ModelConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, dtype="float32",
+)
+
+
+class TestOps:
+    def test_qdot_matches_dequant(self):
+        rng = np.random.default_rng(0)
+        w = jnp.asarray(rng.normal(size=(32, 48)), jnp.float32)
+        x = jnp.asarray(rng.normal(size=(4, 32)), jnp.float32)
+        qw = quantize(w)
+        np.testing.assert_allclose(
+            np.asarray(qdot(x, qw)), np.asarray(x @ dequantize(qw)), rtol=1e-5, atol=1e-5
+        )
+        # Quantization error itself is small relative to the weights.
+        rel = np.abs(np.asarray(dequantize(qw) - w)).max() / np.abs(np.asarray(w)).max()
+        assert rel < 0.01
+
+    def test_stacked_scales_per_layer(self):
+        rng = np.random.default_rng(1)
+        w = np.stack([rng.normal(size=(16, 8)), 100 * rng.normal(size=(16, 8))])
+        qw = quantize(jnp.asarray(w, jnp.float32))
+        assert qw["int8_s"].shape == (2, 1, 8)  # per-layer, per-channel
+        np.testing.assert_allclose(
+            np.asarray(dequantize(qw)), w, rtol=2e-2, atol=2e-2 * 100
+        )
+
+    def test_qgather_and_qmatT(self):
+        rng = np.random.default_rng(2)
+        emb = jnp.asarray(rng.normal(size=(10, 16)), jnp.float32)
+        qe = quantize_rows(emb)
+        idx = jnp.asarray([[1, 5], [9, 0]])
+        np.testing.assert_allclose(
+            np.asarray(qgather(qe, idx, jnp.float32)),
+            np.asarray(dequantize(qe)[idx]),
+            rtol=1e-6,
+        )
+        x = jnp.asarray(rng.normal(size=(3, 16)), jnp.float32)
+        np.testing.assert_allclose(
+            np.asarray(qmatT(x, qe)), np.asarray(x @ dequantize(qe).T), rtol=1e-4, atol=1e-4
+        )
+
+
+class TestModel:
+    def test_quantized_model_close_and_half_memory(self):
+        params = llama.init_params(CFG, jax.random.key(0))
+        qparams = quantize_model_params(params, CFG)
+        tokens = jnp.asarray(np.random.default_rng(0).integers(0, 256, (2, 12)))
+        pos = jnp.broadcast_to(jnp.arange(12)[None, :], (2, 12))
+        ref, _ = llama.apply(params, CFG, tokens, pos)
+        got, _ = llama.apply(qparams, CFG, tokens, pos)
+        # Random-weight logits are ~N(0,1)-scale; int8 keeps them close.
+        err = np.abs(np.asarray(got) - np.asarray(ref)).max()
+        assert err < 0.15, err
+        # Greedy argmax agreement on the vast majority of positions.
+        agree = (np.argmax(np.asarray(got), -1) == np.argmax(np.asarray(ref), -1)).mean()
+        assert agree > 0.85
+
+        def nbytes(t):
+            return sum(x.nbytes for x in jax.tree_util.tree_leaves(t))
+
+        assert nbytes(qparams) < nbytes(params) * 0.5  # f32 -> int8 + scales
+
+    def test_quantized_prefill_decode(self):
+        params = quantize_model_params(llama.init_params(CFG, jax.random.key(0)), CFG)
+        cache = llama.init_cache(CFG, 1, 32)
+        logits, cache = llama.prefill(params, CFG, jnp.asarray([[1, 2, 3, 4]]), cache)
+        assert bool(jnp.isfinite(logits).all())
+        logits, cache = llama.decode_step(
+            params, CFG, jnp.asarray([[5]]), cache, jnp.asarray([4], jnp.int32)
+        )
+        assert bool(jnp.isfinite(logits).all())
+
+
+class TestEngineE2E:
+    def test_server_with_quantization_flag(self, tmp_path):
+        import json
+        import urllib.request
+
+        import torch
+        from transformers import LlamaConfig, LlamaForCausalLM
+
+        from kubeai_tpu.engine.server import EngineServer, build_engine_from_args
+        from kubeai_tpu.engine.weights import save_hf_checkpoint
+
+        torch.manual_seed(0)
+        hf = LlamaForCausalLM(
+            LlamaConfig(
+                vocab_size=256, hidden_size=64, intermediate_size=128,
+                num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+                tie_word_embeddings=False,
+            )
+        )
+        save_hf_checkpoint(
+            str(tmp_path / "ck"), CFG, {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+        )
+
+        import argparse
+
+        args = argparse.Namespace(
+            model=str(tmp_path / "ck"), served_model_name="q8", max_slots=2,
+            max_seq_len=64, tensor_parallel_size=1, quantization="int8",
+        )
+        eng, name = build_engine_from_args(args)
+        srv = EngineServer(eng, name, host="127.0.0.1", port=0)
+        srv.start()
+        try:
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps({"model": "q8", "prompt": "hi", "max_tokens": 4, "temperature": 0}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                body = json.loads(resp.read())
+            assert body["usage"]["completion_tokens"] >= 1
+        finally:
+            srv.stop()
+
+    def test_tp_with_quant_rejected(self, tmp_path):
+        from kubeai_tpu.engine.weights import load_engine_from_path
+
+        with pytest.raises(ValueError, match="tensor-parallel"):
+            load_engine_from_path("/nonexistent", tp=2, quantization="int8")
