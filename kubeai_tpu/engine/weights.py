@@ -60,9 +60,11 @@ def pad_vocab(params, config: ModelConfig, multiple: int) -> tuple[dict, ModelCo
         return params, config
     pad = target - V
     params = dict(params)
-    params["embed"] = jnp.pad(params["embed"], ((0, pad), (0, 0)))
+    # Host (numpy) trees stay on host — the quantizing loader depends on it.
+    xp = np if isinstance(params["embed"], np.ndarray) else jnp
+    params["embed"] = xp.pad(params["embed"], ((0, pad), (0, 0)))
     if "lm_head" in params:
-        params["lm_head"] = jnp.pad(params["lm_head"], ((0, 0), (0, pad)))
+        params["lm_head"] = xp.pad(params["lm_head"], ((0, 0), (0, pad)))
     return params, config.replace(vocab_size=target)
 
 
@@ -106,10 +108,15 @@ def load_engine_from_path(
     sd = load_state_dict(path)
     if "lm_head.weight" not in sd and not config.tie_word_embeddings:
         config = config.replace(tie_word_embeddings=True)
-    params = llama.params_from_hf(sd, config)
-    params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
     if quantization == "int8":
+        # Host-side build + quantize: the full-precision tree exists only
+        # in host RAM; the device sees int8 (+ scales) from the start.
+        params = llama.params_from_hf(sd, config, to_device=False)
+        params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
         params = quantize_model_params(params, config)
+    else:
+        params = llama.params_from_hf(sd, config)
+        params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
 
     ec = engine_config or EngineConfig()
     tokenizer = load_tokenizer(path)

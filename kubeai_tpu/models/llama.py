@@ -88,10 +88,14 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
     return params
 
 
-def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype=None) -> Params:
+def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype=None, to_device: bool = True) -> Params:
     """Convert an HF Llama-style state dict (name -> numpy array) into our
-    stacked-layer pytree. Linear weights are transposed to [in, out]."""
+    stacked-layer pytree. Linear weights are transposed to [in, out].
+    With to_device=False the tree stays numpy on host (jax dtypes like
+    bfloat16 are numpy-compatible via ml_dtypes) — the quantizing loader
+    uses this so full-precision weights never touch HBM."""
     dtype = dtype or jnp.dtype(config.dtype)
+    conv = (lambda a: jnp.asarray(a, dtype)) if to_device else (lambda a: np.asarray(a, dtype))
     L = config.num_layers
 
     def get(name):
@@ -100,7 +104,7 @@ def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype
     def stack(fmt, transpose=True):
         ws = [get(fmt.format(i)) for i in range(L)]
         arr = np.stack([w.T if transpose else w for w in ws])
-        return jnp.asarray(arr, dtype)
+        return conv(arr)
 
     layers: Params = {
         "ln1": stack("model.layers.{}.input_layernorm.weight", transpose=False),
@@ -129,7 +133,7 @@ def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype
                     for e in range(E)
                 ]
                 out.append(np.stack(per))
-            return jnp.asarray(np.stack(out), dtype)
+            return conv(np.stack(out))
 
         layers["ln2"] = stack(
             "model.layers.{}.post_attention_layernorm.weight", transpose=False
@@ -143,12 +147,12 @@ def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype
         layers["wu"] = stack("model.layers.{}.mlp.up_proj.weight")
         layers["wd"] = stack("model.layers.{}.mlp.down_proj.weight")
     params: Params = {
-        "embed": jnp.asarray(get("model.embed_tokens.weight"), dtype),
-        "final_norm": jnp.asarray(get("model.norm.weight"), dtype),
+        "embed": conv(get("model.embed_tokens.weight")),
+        "final_norm": conv(get("model.norm.weight")),
         "layers": layers,
     }
     if not config.tie_word_embeddings:
-        params["lm_head"] = jnp.asarray(get("lm_head.weight").T, dtype)
+        params["lm_head"] = conv(get("lm_head.weight").T)
     return params
 
 

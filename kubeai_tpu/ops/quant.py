@@ -13,6 +13,7 @@ from __future__ import annotations
 from typing import Any
 
 import jax.numpy as jnp
+import numpy as np
 
 QKEY, SKEY = "int8_q", "int8_s"
 
@@ -21,10 +22,20 @@ def is_quantized(w: Any) -> bool:
     return isinstance(w, dict) and QKEY in w
 
 
-def quantize(w: jnp.ndarray, contract_axis: int = -2) -> dict[str, jnp.ndarray]:
+def quantize(w, contract_axis: int = -2) -> dict[str, Any]:
     """Symmetric int8 with the absmax reduced ONLY over *contract_axis*
     (the dim a matmul sums over), so scales stay per-output-channel and —
-    for layer-stacked weights [L, in, out] — per-layer."""
+    for layer-stacked weights [L, in, out] — per-layer.
+
+    numpy inputs are quantized ON HOST with numpy outputs: the checkpoint
+    loader quantizes before any device transfer, so an 8B model never
+    materializes at full precision in HBM."""
+    if isinstance(w, np.ndarray):
+        w32 = np.asarray(w, np.float32)
+        amax = np.max(np.abs(w32), axis=contract_axis, keepdims=True)
+        scale = np.maximum(amax / 127.0, 1e-12)
+        q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
+        return {QKEY: q, SKEY: scale.astype(np.float32)}
     w32 = w.astype(jnp.float32)
     amax = jnp.max(jnp.abs(w32), axis=contract_axis, keepdims=True)
     scale = jnp.maximum(amax / 127.0, 1e-12)
@@ -32,13 +43,9 @@ def quantize(w: jnp.ndarray, contract_axis: int = -2) -> dict[str, jnp.ndarray]:
     return {QKEY: q, SKEY: scale.astype(jnp.float32)}
 
 
-def quantize_rows(w: jnp.ndarray) -> dict[str, jnp.ndarray]:
+def quantize_rows(w) -> dict[str, Any]:
     """Per-row scales (embedding tables: lookups scale row-wise)."""
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=-1, keepdims=True)
-    scale = jnp.maximum(amax / 127.0, 1e-12)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {QKEY: q, SKEY: scale.astype(jnp.float32)}
+    return quantize(w, contract_axis=-1)
 
 
 def dequantize(w: dict[str, jnp.ndarray], dtype=jnp.float32) -> jnp.ndarray:
