@@ -108,15 +108,14 @@ def load_engine_from_path(
     sd = load_state_dict(path)
     if "lm_head.weight" not in sd and not config.tie_word_embeddings:
         config = config.replace(tie_word_embeddings=True)
+    # int8: build + quantize on host so full-precision weights never touch
+    # HBM, then device_put the int8 tree ONCE (leaving it numpy would
+    # re-upload the model on every jitted step).
+    params = llama.params_from_hf(sd, config, to_device=quantization != "int8")
+    params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
     if quantization == "int8":
-        # Host-side build + quantize: the full-precision tree exists only
-        # in host RAM; the device sees int8 (+ scales) from the start.
-        params = llama.params_from_hf(sd, config, to_device=False)
-        params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
         params = quantize_model_params(params, config)
-    else:
-        params = llama.params_from_hf(sd, config)
-        params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
+        params = jax.device_put(params)
 
     ec = engine_config or EngineConfig()
     tokenizer = load_tokenizer(path)

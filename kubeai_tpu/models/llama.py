@@ -176,9 +176,13 @@ LORA_TARGETS = ("wq", "wk", "wv", "wo", "wg", "wu", "wd")
 
 def init_lora_bank(config: ModelConfig, n_adapters: int, rank: int, dtype=None) -> Params:
     """Zeroed stacked adapter bank for batched multi-LoRA (punica-style):
-    per target, A [L, N, in, r] and B [L, N, r, out]; adapter row 0 is the
-    identity (all-zero) adapter for requests without one. Static shapes —
-    installing an adapter is a device scatter, never a recompile."""
+    per target, A [L, N, in, r] and B [L, N, r, out]. *n_adapters* is the
+    TOTAL row count INCLUDING row 0, which is reserved as the identity
+    (all-zero) adapter for requests without one — callers wanting K real
+    adapters pass K+1. Beware: row indices beyond N are silently dropped
+    by JAX scatter/clamped by gather, which reads as "LoRA has no effect".
+    Static shapes — installing an adapter is a device scatter, never a
+    recompile."""
     dtype = dtype or jnp.dtype(config.dtype)
     D, F, L = config.hidden_size, config.intermediate_size, config.num_layers
     H, Kv, h = config.num_heads, config.num_kv_heads, config.head_dim_
@@ -235,11 +239,15 @@ def moe_mlp(x, wr, wg, wu, wd, num_experts_per_tok: int, capacity_factor: float 
 
 def _lora_delta(x, A_l, B_l, rows, scale):
     """Per-row LoRA delta: x [B, S, din], A_l [N, din, r], B_l [N, r, dout],
-    rows [B] adapter indices, scale [N] -> [B, S, dout]."""
-    A_sel = A_l[rows]  # [B, din, r]
-    B_sel = B_l[rows]  # [B, r, dout]
-    low = jnp.einsum("bsd,bdr->bsr", x, A_sel)
-    return jnp.einsum("bsr,bro->bso", low, B_sel) * scale[rows][:, None, None]
+    rows [B] adapter indices, scale [N] -> [B, S, dout] in x's dtype.
+    Compute happens at the promoted precision so a bank in either higher
+    (f32 adapters on bf16 base) or lower precision never downcasts x."""
+    compute_dtype = jnp.promote_types(x.dtype, A_l.dtype)
+    A_sel = A_l[rows].astype(compute_dtype)  # [B, din, r]
+    B_sel = B_l[rows].astype(compute_dtype)  # [B, r, dout]
+    low = jnp.einsum("bsd,bdr->bsr", x.astype(compute_dtype), A_sel)
+    out = jnp.einsum("bsr,bro->bso", low, B_sel) * scale[rows][:, None, None].astype(compute_dtype)
+    return out.astype(x.dtype)
 
 
 def apply(

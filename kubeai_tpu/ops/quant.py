@@ -30,17 +30,12 @@ def quantize(w, contract_axis: int = -2) -> dict[str, Any]:
     numpy inputs are quantized ON HOST with numpy outputs: the checkpoint
     loader quantizes before any device transfer, so an 8B model never
     materializes at full precision in HBM."""
-    if isinstance(w, np.ndarray):
-        w32 = np.asarray(w, np.float32)
-        amax = np.max(np.abs(w32), axis=contract_axis, keepdims=True)
-        scale = np.maximum(amax / 127.0, 1e-12)
-        q = np.clip(np.round(w32 / scale), -127, 127).astype(np.int8)
-        return {QKEY: q, SKEY: scale.astype(np.float32)}
-    w32 = w.astype(jnp.float32)
-    amax = jnp.max(jnp.abs(w32), axis=contract_axis, keepdims=True)
-    scale = jnp.maximum(amax / 127.0, 1e-12)
-    q = jnp.clip(jnp.round(w32 / scale), -127, 127).astype(jnp.int8)
-    return {QKEY: q, SKEY: scale.astype(jnp.float32)}
+    xp = np if isinstance(w, np.ndarray) else jnp
+    w32 = xp.asarray(w).astype(xp.float32)
+    amax = xp.max(xp.abs(w32), axis=contract_axis, keepdims=True)
+    scale = xp.maximum(amax / 127.0, 1e-12)
+    q = xp.clip(xp.round(w32 / scale), -127, 127).astype(xp.int8)
+    return {QKEY: q, SKEY: scale.astype(xp.float32)}
 
 
 def quantize_rows(w) -> dict[str, Any]:

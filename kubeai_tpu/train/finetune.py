@@ -1,0 +1,223 @@
+"""LoRA fine-tuning CLI — produces PEFT-format adapters the engine serves.
+
+Closes the adapter loop the reference leaves external (its LoRA story,
+proposals/lora-adapters.md + internal/modelcontroller/adapters.go, only
+serves adapters produced elsewhere):
+
+    python -m kubeai_tpu.train.finetune \
+        --model <hf-ckpt-dir> --data train.jsonl --output ./my-adapter \
+        --rank 8 --steps 100 --targets q_proj,v_proj
+
+The base model stays frozen; gradients flow only through a LoRA bank
+(row 1; row 0 is the identity) applied by the same decoder the serving
+engine runs, so trained adapters are bit-compatible with serving. Data is
+JSONL with {"text": ...} or {"prompt": ..., "completion": ...} rows
+(loss masked to the completion when split).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import logging
+import os
+
+import numpy as np
+
+log = logging.getLogger("kubeai_tpu.finetune")
+
+PEFT_NAMES = {
+    "wq": "q_proj", "wk": "k_proj", "wv": "v_proj", "wo": "o_proj",
+    "wg": "gate_proj", "wu": "up_proj", "wd": "down_proj",
+}
+
+
+def load_dataset(path: str, tokenizer, seq_len: int) -> list[tuple[list[int], list[int]]]:
+    """Returns (token_ids, loss_mask) pairs, truncated to seq_len."""
+    rows = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            doc = json.loads(line)
+            if "text" in doc:
+                ids = tokenizer.encode(doc["text"])
+                mask = [1] * len(ids)
+            else:
+                prompt_ids = tokenizer.encode(doc["prompt"])
+                completion_ids = tokenizer.encode(doc["completion"], add_bos=False)
+                ids = prompt_ids + completion_ids
+                mask = [0] * len(prompt_ids) + [1] * len(completion_ids)
+            rows.append((ids[:seq_len], mask[:seq_len]))
+    if not rows:
+        raise ValueError(f"no training rows in {path}")
+    return rows
+
+
+def make_batch(rows, batch_size: int, seq_len: int, rng) -> dict[str, np.ndarray]:
+    idx = rng.integers(0, len(rows), batch_size)
+    tokens = np.zeros((batch_size, seq_len), np.int32)
+    targets = np.zeros((batch_size, seq_len), np.int32)
+    mask = np.zeros((batch_size, seq_len), np.int32)
+    for i, j in enumerate(idx):
+        ids, m = rows[j]
+        n = min(len(ids) - 1, seq_len)
+        if n <= 0:
+            continue
+        tokens[i, :n] = ids[:n]
+        targets[i, :n] = ids[1 : n + 1]
+        mask[i, :n] = m[1 : n + 1]
+    return {"tokens": tokens, "targets": targets, "mask": mask}
+
+
+def save_peft_adapter(path: str, bank, config, rank: int, alpha: float, targets: list[str]):
+    """Write adapter_config.json + adapter_model.safetensors in the PEFT
+    layout engine/lora.py loads (A [r, in], B [out, r])."""
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump(
+            {
+                "peft_type": "LORA",
+                "r": rank,
+                "lora_alpha": alpha,
+                "target_modules": [PEFT_NAMES[t] for t in targets],
+            },
+            f,
+            indent=1,
+        )
+    tensors = {}
+    for t in targets:
+        A = np.asarray(bank[t + "_A"][:, 1, :, :rank], np.float32)  # [L, in, r]
+        B = np.asarray(bank[t + "_B"][:, 1, :rank, :], np.float32)  # [L, r, out]
+        hf = PEFT_NAMES[t]
+        prefix = "self_attn" if t in ("wq", "wk", "wv", "wo") else "mlp"
+        for li in range(config.num_layers):
+            base = f"base_model.model.model.layers.{li}.{prefix}.{hf}"
+            tensors[base + ".lora_A.weight"] = np.ascontiguousarray(A[li].T)  # [r, in]
+            tensors[base + ".lora_B.weight"] = np.ascontiguousarray(B[li].T)  # [out, r]
+    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
+
+
+def finetune(
+    model_path: str,
+    data_path: str,
+    output_path: str,
+    rank: int = 8,
+    alpha: float | None = None,
+    steps: int = 100,
+    batch_size: int = 4,
+    seq_len: int = 256,
+    lr: float = 1e-3,
+    targets: tuple[str, ...] = ("wq", "wv"),
+    seed: int = 0,
+    init_scale: float = 0.01,
+):
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from kubeai_tpu.engine.tokenizer import load_tokenizer
+    from kubeai_tpu.engine.weights import load_state_dict
+    from kubeai_tpu.models import llama
+    from kubeai_tpu.models.base import ModelConfig
+
+    alpha = alpha if alpha is not None else float(rank)
+    config = ModelConfig.from_json_file(model_path)
+    sd = load_state_dict(model_path)
+    if "lm_head.weight" not in sd and not config.tie_word_embeddings:
+        config = config.replace(tie_word_embeddings=True)
+    params = llama.params_from_hf(sd, config)
+    tokenizer = load_tokenizer(model_path)
+    rows = load_dataset(data_path, tokenizer, seq_len)
+    log.info("%d training rows", len(rows))
+
+    # Bank rows: 0 = identity, 1 = the adapter being trained (the bank
+    # size counts ALL rows, identity included). A gets a small random
+    # init, B stays zero (standard LoRA init: delta starts 0).
+    bank = llama.init_lora_bank(config, n_adapters=2, rank=rank, dtype=jnp.float32)
+    key = jax.random.key(seed)
+    for t in targets:
+        a_shape = bank[t + "_A"].shape  # [L, 2, in, r]
+        key, sub = jax.random.split(key)
+        init = jax.random.normal(sub, (a_shape[0], a_shape[2], a_shape[3]), jnp.float32) * init_scale
+        bank[t + "_A"] = bank[t + "_A"].at[:, 1].set(init)
+    bank["scale"] = bank["scale"].at[1].set(alpha / rank)
+
+    trainable_keys = [t + s for t in targets for s in ("_A", "_B")]
+
+    def split_bank(b):
+        return {k: b[k] for k in trainable_keys}
+
+    optimizer = optax.adamw(lr)
+    opt_state = optimizer.init(split_bank(bank))
+
+    def loss_fn(trainable, frozen_bank, batch):
+        b = dict(frozen_bank)
+        b.update(trainable)
+        B, S = batch["tokens"].shape
+        pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+        logits, _ = llama.apply(
+            params, config, batch["tokens"], pos,
+            lora=b, lora_rows=jnp.ones((B,), jnp.int32),
+        )
+        logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+        ll = jnp.take_along_axis(logp, batch["targets"][..., None], axis=-1)[..., 0]
+        m = batch["mask"].astype(jnp.float32)
+        return -(ll * m).sum() / jnp.maximum(m.sum(), 1.0)
+
+    @jax.jit
+    def step(trainable, opt_state, frozen_bank, batch):
+        loss, grads = jax.value_and_grad(loss_fn)(trainable, frozen_bank, batch)
+        updates, opt_state = optimizer.update(grads, opt_state, trainable)
+        trainable = optax.apply_updates(trainable, updates)
+        return loss, trainable, opt_state
+
+    rng = np.random.default_rng(seed)
+    trainable = split_bank(bank)
+    frozen = {k: v for k, v in bank.items() if k not in trainable_keys}
+    first_loss = last_loss = None
+    for i in range(steps):
+        batch = {k: jnp.asarray(v) for k, v in make_batch(rows, batch_size, seq_len, rng).items()}
+        loss, trainable, opt_state = step(trainable, opt_state, frozen, batch)
+        last_loss = float(loss)
+        if first_loss is None:
+            first_loss = last_loss
+        if i % 10 == 0 or i == steps - 1:
+            log.info("step %d loss %.4f", i, last_loss)
+
+    bank.update(trainable)
+    save_peft_adapter(output_path, bank, config, rank, alpha, list(targets))
+    log.info("adapter saved to %s (loss %.4f -> %.4f)", output_path, first_loss, last_loss)
+    return first_loss, last_loss
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser("kubeai-tpu-finetune")
+    parser.add_argument("--model", required=True)
+    parser.add_argument("--data", required=True)
+    parser.add_argument("--output", required=True)
+    parser.add_argument("--rank", type=int, default=8)
+    parser.add_argument("--alpha", type=float, default=None)
+    parser.add_argument("--steps", type=int, default=100)
+    parser.add_argument("--batch-size", type=int, default=4)
+    parser.add_argument("--seq-len", type=int, default=256)
+    parser.add_argument("--lr", type=float, default=1e-3)
+    parser.add_argument("--targets", default="q_proj,v_proj")
+    args = parser.parse_args(argv)
+    logging.basicConfig(level=logging.INFO)
+
+    rev = {v: k for k, v in PEFT_NAMES.items()}
+    targets = tuple(rev[t.strip()] for t in args.targets.split(","))
+    finetune(
+        args.model, args.data, args.output,
+        rank=args.rank, alpha=args.alpha, steps=args.steps,
+        batch_size=args.batch_size, seq_len=args.seq_len, lr=args.lr,
+        targets=targets,
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,97 @@
+"""Fine-tune loop: loss decreases and the produced PEFT adapter loads
+into the serving engine and changes outputs — the full LoRA loop."""
+
+import json
+
+import numpy as np
+import pytest
+import torch
+
+from kubeai_tpu.models.base import ModelConfig
+
+CFG = ModelConfig(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, dtype="float32",
+)
+
+
+@pytest.fixture(scope="module")
+def ckpt(tmp_path_factory):
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    from kubeai_tpu.engine.weights import save_hf_checkpoint
+
+    path = tmp_path_factory.mktemp("ft-ckpt")
+    torch.manual_seed(0)
+    hf = LlamaForCausalLM(
+        LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            tie_word_embeddings=False,
+        )
+    )
+    save_hf_checkpoint(str(path), CFG, {k: v.detach().numpy() for k, v in hf.state_dict().items()})
+    return str(path)
+
+
+@pytest.fixture(scope="module")
+def dataset(tmp_path_factory):
+    path = tmp_path_factory.mktemp("data") / "train.jsonl"
+    with open(path, "w") as f:
+        for i in range(16):
+            f.write(json.dumps({"prompt": f"Q{i}: say banana. A:", "completion": " banana!"}) + "\n")
+    return str(path)
+
+
+def test_finetune_reduces_loss_and_serves(ckpt, dataset, tmp_path):
+    from kubeai_tpu.engine.core import EngineConfig
+    from kubeai_tpu.engine.server import EngineServer
+    from kubeai_tpu.engine.weights import load_engine_from_path
+    from kubeai_tpu.train.finetune import finetune
+
+    first, last = finetune(
+        ckpt, dataset, str(tmp_path / "adapter"),
+        rank=4, steps=30, batch_size=4, seq_len=32, lr=5e-3,
+    )
+    assert last < first, (first, last)
+
+    # The adapter loads into a serving engine and changes generation.
+    eng = load_engine_from_path(
+        ckpt, EngineConfig(max_slots=2, max_seq_len=64, prefill_buckets=(16, 32)),
+        dtype="float32",
+    )
+    srv = EngineServer(eng, "base", host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        import urllib.request
+
+        def complete(model):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps({"model": model, "prompt": "Q9: say banana. A:", "max_tokens": 6, "temperature": 0}).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=120) as resp:
+                return json.loads(resp.read())["choices"][0]["text"]
+
+        base_out = complete("base")
+        ok, msg = srv.load_adapter("tuned", str(tmp_path / "adapter"))
+        assert ok, msg
+        tuned_out = complete("tuned")
+        assert tuned_out != base_out
+    finally:
+        srv.stop()
+
+
+def test_dataset_loading(dataset):
+    from kubeai_tpu.engine.tokenizer import ByteTokenizer
+    from kubeai_tpu.train.finetune import load_dataset, make_batch
+
+    rows = load_dataset(dataset, ByteTokenizer(), 64)
+    assert len(rows) == 16
+    ids, mask = rows[0]
+    # Loss masked to the completion region only.
+    assert 0 in mask and 1 in mask
+    batch = make_batch(rows, 4, 64, np.random.default_rng(0))
+    assert batch["tokens"].shape == (4, 64)
+    assert (batch["mask"].sum(1) > 0).all()
