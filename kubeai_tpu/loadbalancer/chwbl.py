@@ -82,15 +82,22 @@ def chwbl_choose(
     total_load: int,
     n_endpoints: int,
     allowed: Callable[[str], bool] | None = None,
+    stats: dict | None = None,
 ) -> str | None:
     """Pick an endpoint name for *key*, honoring adapter capability and the
     bounded-load condition; falls back to the first servable endpoint
     (ref: balance_chwbl.go:14-84). *allowed* additionally filters endpoints
     (retry exclusion); callers fall back to allowed=None when it empties
-    the candidate set."""
+    the candidate set. *stats*, when given, receives the lookup telemetry
+    the reference exports (initial target, iterations, fallback use;
+    ref: internal/metrics/metrics.go CHWBL instruments)."""
     fallback: str | None = None
     seen: set[str] = set()
+    slots_walked = 0
     for name in ring.walk(key):
+        slots_walked += 1
+        if stats is not None and not seen:
+            stats["initial"] = name
         # The walk yields one name per ring slot; loads can't change while
         # the group lock is held, so each distinct endpoint needs checking
         # only once (first occurrence preserves ring order).
@@ -104,7 +111,15 @@ def chwbl_choose(
             if fallback is None:
                 fallback = name
             if load_ok(endpoint_load(name), total_load, n_endpoints, load_factor):
+                if stats is not None:
+                    # Reference semantics: ring slots walked on success
+                    # (balance_chwbl.go:58 records n+1).
+                    stats.update(final=name, iterations=slots_walked, default=False)
                 return name
         if len(seen) == n_endpoints:
             break
+    if stats is not None:
+        # Reference semantics: the fallback path records the full ring size
+        # (balance_chwbl.go:74).
+        stats.update(final=fallback, iterations=len(ring), default=True)
     return fallback

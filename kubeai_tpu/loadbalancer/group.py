@@ -17,8 +17,45 @@ from dataclasses import dataclass, field
 
 from kubeai_tpu.loadbalancer.chwbl import HashRing, chwbl_choose
 
+from kubeai_tpu.metrics import default_registry
+
 LEAST_LOAD = "LeastLoad"
 PREFIX_HASH = "PrefixHash"
+
+# CHWBL lookup telemetry (parity: the reference's
+# kubeai_inference_requests_hash_lookup_* instruments,
+# ref: internal/metrics/metrics.go:16-27). Handles resolved once — this
+# sits on the per-request routing hot path.
+_M_LOOKUP_INITIAL = default_registry.counter(
+    "kubeai_inference_requests_hash_lookup_initial_total",
+    "ring lookups landing on each initial endpoint",
+)
+_M_LOOKUP_FINAL = default_registry.counter(
+    "kubeai_inference_requests_hash_lookup_final_total",
+    "ring lookups resolving to each endpoint",
+)
+_M_LOOKUP_DEFAULT = default_registry.counter(
+    "kubeai_inference_requests_hash_lookup_default_total",
+    "lookups that fell back past the load bound",
+)
+_M_LOOKUP_ITER = default_registry.histogram(
+    "kubeai_inference_requests_hash_lookup_iterations",
+    "ring slots walked per lookup",
+    buckets=(1, 4, 16, 64, 256, 1024, 4096),
+)
+
+
+def _record_chwbl_stats(stats: dict) -> None:
+    """Record telemetry for a RESOLVED lookup only (the reference records
+    nothing on a no-endpoint return, balance_chwbl.go:84)."""
+    if not stats.get("final"):
+        return
+    if stats.get("initial"):
+        _M_LOOKUP_INITIAL.inc(labels={"endpoint": stats["initial"]})
+    _M_LOOKUP_FINAL.inc(labels={"endpoint": stats["final"]})
+    if stats.get("default"):
+        _M_LOOKUP_DEFAULT.inc(labels={"endpoint": stats["final"]})
+    _M_LOOKUP_ITER.observe(stats.get("iterations", 0))
 
 
 @dataclass
@@ -117,7 +154,8 @@ class EndpointGroup:
         )
 
         if strategy == PREFIX_HASH:
-            return chwbl_choose(
+            stats: dict = {}
+            name = chwbl_choose(
                 self._ring,
                 key=adapter + prefix,
                 load_factor=mean_load_factor,
@@ -127,7 +165,10 @@ class EndpointGroup:
                 total_load=self._total_in_flight,
                 n_endpoints=len(self._endpoints),
                 allowed=allowed,
+                stats=stats,
             )
+            _record_chwbl_stats(stats)
+            return name
         if strategy == LEAST_LOAD:
             # Ties broken randomly: retries after an upstream failure must
             # be able to land on a different endpoint (the reference gets

@@ -1,0 +1,212 @@
+"""Chaos/e2e parity scenarios (ref: test/e2e/autoscaler-restart-under-load,
+test/e2e/rollouts): operator restart must not disturb replicas; a model
+spec change must roll pods without dropping requests."""
+
+import json
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.config.system import System
+from kubeai_tpu.manager import Manager
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+from tests.test_proxy_integration import FakeEngine
+
+
+def mk_system():
+    s = System().default_and_validate()
+    s.allow_pod_address_override = True
+    s.autoscaling.interval_seconds = 0.2
+    s.autoscaling.time_window_seconds = 2.0
+    return s
+
+
+def forge_ready(store, pod_name, engine):
+    def mutate(p):
+        p.status.ready = True
+        p.status.pod_ip = "127.0.0.1"
+        p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+        p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(engine.port)
+
+    store.mutate(KIND_POD, pod_name, mutate)
+
+
+def await_pods(store, n, timeout=10):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        if len(pods) == n:
+            return pods
+        time.sleep(0.05)
+    raise AssertionError(
+        f"expected {n} pods, have {len(store.list(KIND_POD, selector={mt.LABEL_MODEL: 'm1'}))}"
+    )
+
+
+def post(port, body, timeout=30):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+def test_operator_restart_under_load_keeps_replicas():
+    """Kill the manager mid-load and restart it on the SAME store (the
+    cluster persists state): replicas must hold steady thanks to the
+    persisted autoscaler averages — no scale-to-zero dip, no runaway."""
+    store = Store()
+    engines = [FakeEngine() for _ in range(2)]
+    try:
+        mgr = Manager(mk_system(), store=store, host="127.0.0.1", port=0)
+        mgr.start()
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name="m1"),
+                spec=ModelSpec(
+                    url="hf://a/b", resource_profile="cpu:1",
+                    min_replicas=0, max_replicas=4, target_requests=1,
+                ),
+            ),
+        )
+
+        stop = threading.Event()
+        failures = []
+
+        def load_loop():
+            while not stop.is_set():
+                try:
+                    status, _ = post(mgr.api.port, {"model": "m1", "prompt": "x"}, timeout=15)
+                    if status != 200:
+                        failures.append(status)
+                except Exception as e:
+                    failures.append(str(e))
+                time.sleep(0.05)
+
+        # Bring up 2 ready replicas under load.
+        t = threading.Thread(target=load_loop)
+        t.start()
+        pods = await_pods(store, 1)
+        forge_ready(store, pods[0].meta.name, engines[0])
+        store.mutate(mt.KIND_MODEL, "m1", lambda m: setattr(m.spec, "replicas", 2))
+        pods = await_pods(store, 2)
+        for p in pods:
+            if not p.status.ready:
+                forge_ready(store, p.meta.name, engines[1])
+        time.sleep(1.0)  # autoscaler observes load, persists averages
+        stop.set()
+        t.join(timeout=30)
+
+        replicas_before = store.get(mt.KIND_MODEL, "m1").spec.replicas
+        mgr.stop()  # operator killed
+
+        # Restart on the same store; replicas must not dip.
+        mgr2 = Manager(mk_system(), store=store, host="127.0.0.1", port=0)
+        mgr2.start()
+        try:
+            time.sleep(1.5)  # several autoscaler intervals
+            after = store.get(mt.KIND_MODEL, "m1").spec.replicas
+            assert after >= 1, "restart scaled the loaded model to zero"
+            assert len(store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})) >= 1
+            # And the restarted operator still serves.
+            status, _ = post(mgr2.api.port, {"model": "m1", "prompt": "y"}, timeout=20)
+            assert status == 200
+        finally:
+            mgr2.stop()
+    finally:
+        for e in engines:
+            e.stop()
+
+
+def test_rollout_without_downtime():
+    """Changing spec.args rolls pods surge-first; requests keep succeeding
+    throughout (ref: test/e2e/rollouts)."""
+    store = Store()
+    engines = []
+    mgr = Manager(mk_system(), store=store, host="127.0.0.1", port=0)
+    mgr.start()
+    try:
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name="m1"),
+                spec=ModelSpec(
+                    url="hf://a/b", resource_profile="cpu:1",
+                    replicas=2, min_replicas=2, autoscaling_disabled=True,
+                ),
+            ),
+        )
+
+        def make_ready_all():
+            made = False
+            for p in store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"}):
+                if not p.status.ready:
+                    eng = FakeEngine()
+                    engines.append(eng)
+                    forge_ready(store, p.meta.name, eng)
+                    made = True
+            return made
+
+        await_pods(store, 2)
+        make_ready_all()
+
+        stop = threading.Event()
+        failures = []
+        successes = [0]
+
+        def load_loop():
+            while not stop.is_set():
+                try:
+                    status, _ = post(mgr.api.port, {"model": "m1", "prompt": "x"}, timeout=15)
+                    if status == 200:
+                        successes[0] += 1
+                    else:
+                        failures.append(status)
+                except Exception as e:
+                    failures.append(str(e))
+                time.sleep(0.02)
+
+        t = threading.Thread(target=load_loop)
+        t.start()
+
+        # Trigger the rollout; keep forging readiness as new-hash pods appear.
+        old_hashes = {
+            p.meta.labels[mt.LABEL_POD_HASH]
+            for p in store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        }
+        store.mutate(mt.KIND_MODEL, "m1", lambda m: m.spec.args.append("--rolled"))
+        deadline = time.time() + 20
+        while time.time() < deadline:
+            make_ready_all()
+            pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+            hashes = {p.meta.labels[mt.LABEL_POD_HASH] for p in pods}
+            if len(pods) == 2 and hashes.isdisjoint(old_hashes) and all(
+                p.status.ready for p in pods
+            ):
+                break
+            time.sleep(0.05)
+        else:
+            raise AssertionError("rollout did not converge to 2 new-hash ready pods")
+
+        time.sleep(0.3)
+        stop.set()
+        t.join(timeout=30)
+        # The zero-downtime property is the failures assertion; the floor
+        # only guards against the load loop silently not running.
+        assert successes[0] >= 5, f"too few successful requests: {successes[0]}"
+        assert not failures, f"requests failed during rollout: {failures[:5]}"
+    finally:
+        mgr.stop()
+        for e in engines:
+            e.stop()
