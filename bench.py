@@ -59,9 +59,35 @@ def main():
     parser.add_argument("--tiny", action="store_true", help="CPU smoke mode")
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--max-tokens", type=int, default=None)
+    parser.add_argument("--watchdog", type=int, default=480, help="hard deadline (s); 0 disables")
     args = parser.parse_args()
 
     import threading
+
+    timer = None
+    if args.watchdog:
+        # A wedged accelerator tunnel can hang backend init indefinitely;
+        # emit the JSON line (value 0 = bench could not run) and hard-exit
+        # rather than hanging the caller.
+
+        def bail():
+            print(
+                json.dumps(
+                    {
+                        "metric": "engine_output_tokens_per_sec_per_chip",
+                        "value": 0.0,
+                        "unit": "tok/s",
+                        "vs_baseline": 0.0,
+                    }
+                ),
+                flush=True,
+            )
+            print(f"# watchdog: bench exceeded {args.watchdog}s (device init hang?)", file=sys.stderr)
+            os._exit(3)
+
+        timer = threading.Timer(args.watchdog, bail)
+        timer.daemon = True
+        timer.start()
 
     import numpy as np
 
@@ -108,6 +134,8 @@ def main():
     for t in threads:
         t.join()
     elapsed = time.monotonic() - t0
+    if timer is not None:
+        timer.cancel()  # measurement complete; teardown must not race bail()
     eng.stop()
 
     total_out = sum(r.completion_tokens for r in results)

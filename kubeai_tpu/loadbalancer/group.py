@@ -46,12 +46,14 @@ _M_LOOKUP_ITER = default_registry.histogram(
 
 
 def _record_chwbl_stats(stats: dict) -> None:
-    """Record telemetry for a RESOLVED lookup only (the reference records
-    nothing on a no-endpoint return, balance_chwbl.go:84)."""
-    if not stats.get("final"):
-        return
+    """Initial is recorded for every lookup (the reference records it
+    before the walk, balance_chwbl.go:22-27); final/iterations/default
+    only for resolved lookups (no-endpoint returns record nothing more,
+    balance_chwbl.go:84)."""
     if stats.get("initial"):
         _M_LOOKUP_INITIAL.inc(labels={"endpoint": stats["initial"]})
+    if not stats.get("final"):
+        return
     _M_LOOKUP_FINAL.inc(labels={"endpoint": stats["final"]})
     if stats.get("default"):
         _M_LOOKUP_DEFAULT.inc(labels={"endpoint": stats["final"]})

@@ -108,6 +108,7 @@ def test_operator_restart_under_load_keeps_replicas():
         stop.set()
         t.join(timeout=30)
 
+        assert not failures, f"requests failed pre-restart: {failures[:5]}"
         replicas_before = store.get(mt.KIND_MODEL, "m1").spec.replicas
         mgr.stop()  # operator killed
 
