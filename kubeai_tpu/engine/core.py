@@ -137,6 +137,12 @@ class Engine:
         self.m_ttft = default_registry.histogram(
             "kubeai_engine_ttft_seconds", "time to first token"
         )
+        self.m_hbm_used = default_registry.gauge(
+            "kubeai_engine_hbm_used_bytes", "accelerator memory in use"
+        )
+        self.m_hbm_limit = default_registry.gauge(
+            "kubeai_engine_hbm_limit_bytes", "accelerator memory capacity"
+        )
 
         self._init_device_state()
         self._build_step_fns(apply_fns)
@@ -182,6 +188,15 @@ class Engine:
             )[0]
             return tok, cache
 
+        def prefill_chunk_fn(params, tokens, start, last_idx, slot, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
+            logits, cache = llama.prefill_chunk_into(
+                params, mc, tokens, cache, slot, start, last_idx, lora=lora, lora_row=lora_row
+            )
+            tok = sample(
+                mask_pad(logits[:, -1]), key[None], temp[None], top_p[None], top_k[None]
+            )[0]
+            return tok, cache
+
         K = self.cfg.decode_chunk
 
         def decode_fn(params, cache, lengths, last_tokens, keys, active, temp, top_p, top_k, lora=None, lora_rows=None):
@@ -205,8 +220,17 @@ class Engine:
 
         if apply_fns is not None:  # test seam
             self._prefill_jit, self._decode_jit = apply_fns(prefill_fn, decode_fn)
+
+            def _no_chunked(*a, **k):
+                raise NotImplementedError(
+                    "apply_fns seam engines do not support chunked prefill; "
+                    "keep prompts within the largest prefill bucket"
+                )
+
+            self._prefill_chunk_jit = _no_chunked
         else:
             self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(8,))
+            self._prefill_chunk_jit = jax.jit(prefill_chunk_fn, donate_argnums=(9,))
             self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2, 3, 4))
 
     # -- public API --------------------------------------------------------
@@ -224,8 +248,9 @@ class Engine:
 
     def submit(self, prompt_ids: list[int], params: SamplingParams, adapter: str | None = None) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
-        retries another replica on 503)."""
-        max_prompt = min(max(self.cfg.prefill_buckets), self.cfg.max_seq_len - 1)
+        retries another replica on 503). Prompts beyond the largest prefill
+        bucket are chunk-prefilled, up to the slot capacity."""
+        max_prompt = self.cfg.max_seq_len - 1
         if len(prompt_ids) > max_prompt:
             raise ValueError(
                 f"prompt too long: {len(prompt_ids)} tokens > {max_prompt}"
@@ -321,6 +346,22 @@ class Engine:
     def loaded_adapters(self) -> list[str]:
         return self._adapters.names() if self._adapters else []
 
+    def refresh_memory_stats(self) -> None:
+        """Update the HBM gauges (an autoscaling signal the reference never
+        had — its metrics stop at proxy-side in-flight counts; SURVEY.md §7
+        step 5 calls for engine-side HBM/queue gauges). Summed over this
+        process's addressable devices — remote devices of a multi-host
+        slice can't report stats (each worker publishes its own)."""
+        used = limit = 0
+        for dev in jax.local_devices():
+            stats = getattr(dev, "memory_stats", lambda: None)()
+            if stats:
+                used += stats.get("bytes_in_use", 0)
+                limit += stats.get("bytes_limit", 0)
+        if limit:
+            self.m_hbm_used.set(used)
+            self.m_hbm_limit.set(limit)
+
     def queue_depth(self) -> int:
         return self._queue.qsize()
 
@@ -404,11 +445,6 @@ class Engine:
 
     def _prefill(self, slot_idx: int, req: Request):
         ids = req.prompt_ids
-        bucket = self._bucket(len(ids))
-        padded = np.zeros((1, bucket), np.int32)
-        padded[0, : len(ids)] = ids
-        length = jnp.int32(len(ids))
-
         sp = req.params
         seed = sp.seed if sp.seed is not None else (time.monotonic_ns() & 0xFFFFFFFF)
         key = jax.random.key(seed)
@@ -418,18 +454,46 @@ class Engine:
         if self._adapters is not None:
             lora_row = self._adapters.row_for(req.adapter)
             lora_args = {"lora": self._adapters.bank, "lora_row": jnp.int32(lora_row)}
-        tok, self._cache = self._prefill_jit(
-            self.params,
-            jnp.asarray(padded),
-            length,
-            jnp.int32(slot_idx),
-            key,
-            jnp.float32(sp.temperature),
-            jnp.float32(sp.top_p),
-            jnp.int32(sp.top_k),
-            self._cache,
-            **lora_args,
-        )
+
+        max_bucket = max(self.cfg.prefill_buckets)
+        if len(ids) <= max_bucket:
+            padded = np.zeros((1, self._bucket(len(ids))), np.int32)
+            padded[0, : len(ids)] = ids
+            tok, self._cache = self._prefill_jit(
+                self.params,
+                jnp.asarray(padded),
+                jnp.int32(len(ids)),
+                jnp.int32(slot_idx),
+                key,
+                jnp.float32(sp.temperature),
+                jnp.float32(sp.top_p),
+                jnp.int32(sp.top_k),
+                self._cache,
+                **lora_args,
+            )
+        else:
+            # Chunked prefill: full-bucket chunks at increasing offsets;
+            # only the final chunk's sampled token is kept.
+            tok = None
+            for start in range(0, len(ids), max_bucket):
+                chunk = ids[start : start + max_bucket]
+                is_last = start + max_bucket >= len(ids)
+                bucket = max_bucket if not is_last else self._bucket(len(chunk))
+                chunk_padded = np.zeros((1, bucket), np.int32)
+                chunk_padded[0, : len(chunk)] = chunk
+                tok, self._cache = self._prefill_chunk_jit(
+                    self.params,
+                    jnp.asarray(chunk_padded),
+                    jnp.int32(start),
+                    jnp.int32(len(chunk) - 1),
+                    jnp.int32(slot_idx),
+                    key,
+                    jnp.float32(sp.temperature),
+                    jnp.float32(sp.top_p),
+                    jnp.int32(sp.top_k),
+                    self._cache,
+                    **lora_args,
+                )
 
         budget = min(
             sp.max_tokens or self.cfg.default_max_tokens,

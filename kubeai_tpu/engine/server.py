@@ -138,6 +138,10 @@ def _make_handler(srv: EngineServer):
             if path in ("/health", "/healthz", "/readyz"):
                 self._json(200, {"status": "ok", "model": srv.model_name})
             elif path == "/metrics":
+                try:
+                    srv.engine.refresh_memory_stats()
+                except Exception:
+                    pass  # platform without memory_stats
                 body = default_registry.render().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")

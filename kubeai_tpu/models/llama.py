@@ -462,6 +462,28 @@ def prefill_into(params, config, tokens, cache, slot, length, lora=None, lora_ro
     )
 
 
+def prefill_chunk_into(params, config, tokens, cache, slot, start, last_idx, lora=None, lora_row=None):
+    """Prefill one CHUNK of a long prompt into cache row *slot* at absolute
+    offset *start* (traced scalar): chunked prefill keeps compile shapes
+    bounded by the largest bucket while supporting prompts up to the cache
+    capacity. Queries attend all previously-written cache positions (the
+    mask derives from absolute positions). Returns (logits [1,1,V] at
+    *last_idx* within the chunk, cache)."""
+    _, C = tokens.shape
+    pos = (start + jnp.arange(C, dtype=jnp.int32))[None, :]
+    return apply(
+        params,
+        config,
+        tokens,
+        pos,
+        cache,
+        logits_idx=jnp.reshape(last_idx, (1,)).astype(jnp.int32),
+        cache_rows=jnp.reshape(slot, (1,)).astype(jnp.int32),
+        lora=lora,
+        lora_rows=None if lora_row is None else jnp.reshape(lora_row, (1,)).astype(jnp.int32),
+    )
+
+
 def decode_step(params, config, tokens, cache, lengths, lora=None, lora_rows=None):
     """One decode step for [B, 1] tokens at positions *lengths* [B].
     Returns (logits [B, 1, V], cache)."""

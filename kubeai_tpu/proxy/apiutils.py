@@ -31,6 +31,7 @@ class Request:
     prefix: str = ""
     selectors: dict[str, str] = field(default_factory=dict)
     body: _Body | None = None
+    raw_body: bytes | None = None  # multipart passthrough (model field stripped)
     model_obj: object = None
 
     @property
@@ -40,6 +41,8 @@ class Request:
         return mt.LoadBalancing()
 
     def body_bytes(self) -> bytes:
+        if self.raw_body is not None:
+            return self.raw_body
         return self.body.to_bytes() if self.body else b""
 
 
@@ -65,10 +68,67 @@ def parse_label_selector(header: str | None) -> dict[str, str]:
     return out
 
 
+def parse_multipart_model(raw_body: bytes, content_type: str) -> tuple[str, bytes]:
+    """Extract the `model` form field from a multipart body and return
+    (model_value, body_without_model_field) — the FasterWhisper workaround
+    the reference carries (ref: apiutils/request.go:109-165: the engine
+    rejects unknown served-model names, so the field is stripped)."""
+    import email
+    import email.policy
+
+    idx = content_type.lower().find("boundary=")
+    if idx < 0:
+        raise APIError(400, "no boundary in multipart content-type")
+    boundary = content_type[idx + len("boundary=") :].split(";")[0].strip().strip('"')
+
+    delim = b"--" + boundary.encode()
+    parts = raw_body.split(delim)
+    model_value = ""
+    kept: list[bytes] = []
+    # parts[0] is the preamble, the last part is the closing "--\r\n".
+    for part in parts[1:-1]:
+        chunk = part.lstrip(b"\r\n")
+        header_blob, _, _body = chunk.partition(b"\r\n\r\n")
+        msg = email.message_from_bytes(header_blob, policy=email.policy.HTTP)
+        # Parse the disposition's `name` parameter properly: a substring
+        # test would also match filename="model" on a file part.
+        field = msg.get_param("name", header="Content-Disposition")
+        if field == "model":
+            model_value = _body.rstrip(b"\r\n").decode(errors="replace")
+            continue
+        kept.append(part)
+    if not model_value:
+        raise APIError(400, "missing 'model' form field")
+    if not kept:
+        raise APIError(400, "multipart body has no content parts besides 'model'")
+    new_body = delim + delim.join(kept) + delim + b"--\r\n"
+    return model_value, new_body
+
+
 def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, str]) -> Request:
     """Decode + validate + rewrite; parity: ParseRequest
-    (ref: apiutils/request.go:64-107)."""
+    (ref: apiutils/request.go:64-107). JSON bodies are rewritten (adapter
+    ids); multipart bodies (audio transcription) pass through with the
+    model field stripped."""
     import uuid
+
+    # Header names are case-insensitive; the dict preserves wire casing.
+    content_type = next(
+        (v for k, v in headers.items() if k.lower() == "content-type"), ""
+    )
+    if content_type.lower().startswith("multipart/form-data"):
+        requested, new_body = parse_multipart_model(raw_body, content_type)
+        model_name, adapter = split_model_adapter(requested)
+        selectors = parse_label_selector(headers.get("X-Label-Selector"))
+        model = model_client.lookup_model(model_name, adapter, selectors)
+        return Request(
+            id=uuid.uuid4().hex,
+            model_name=model_name,
+            adapter=adapter,
+            selectors=selectors,
+            raw_body=new_body,
+            model_obj=model,
+        )
 
     try:
         data = json.loads(raw_body or b"{}")

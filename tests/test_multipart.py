@@ -1,0 +1,95 @@
+"""Multipart (audio transcription) request parsing: model field extracted
+and stripped, remaining parts passed through byte-exact."""
+
+import pytest
+
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.proxy.apiutils import APIError, parse_multipart_model, parse_request
+from kubeai_tpu.runtime.store import ObjectMeta
+
+
+def build_multipart(fields: dict[str, bytes], boundary="testbound42") -> tuple[bytes, str]:
+    parts = []
+    for name, value in fields.items():
+        disp = f'Content-Disposition: form-data; name="{name}"'
+        if name == "file":
+            disp += '; filename="audio.wav"'
+        parts.append(
+            f"--{boundary}\r\n{disp}\r\n\r\n".encode() + value + b"\r\n"
+        )
+    body = b"".join(parts) + f"--{boundary}--\r\n".encode()
+    return body, f"multipart/form-data; boundary={boundary}"
+
+
+def test_model_extracted_and_stripped():
+    body, ctype = build_multipart(
+        {"model": b"whisper-1", "file": b"\x00\x01RIFFbinary", "language": b"en"}
+    )
+    model, new_body = parse_multipart_model(body, ctype)
+    assert model == "whisper-1"
+    assert b'name="model"' not in new_body
+    assert b"\x00\x01RIFFbinary" in new_body  # binary part intact
+    assert b'name="language"' in new_body
+    assert new_body.endswith(b"--testbound42--\r\n")
+
+
+def test_missing_model_field():
+    body, ctype = build_multipart({"file": b"x"})
+    with pytest.raises(APIError, match="model"):
+        parse_multipart_model(body, ctype)
+
+
+def test_no_boundary():
+    with pytest.raises(APIError, match="boundary"):
+        parse_multipart_model(b"x", "multipart/form-data")
+
+
+def test_file_named_model_not_mistaken_for_field():
+    """A file part whose FILENAME is 'model' must not be consumed as the
+    model field (review regression)."""
+    boundary = "bb1"
+    body = (
+        f'--{boundary}\r\nContent-Disposition: form-data; name="file"; filename="model"\r\n\r\n'.encode()
+        + b"BINARY"
+        + f"\r\n--{boundary}\r\n".encode()
+        + b'Content-Disposition: form-data; name="model"\r\n\r\nwhisper-1\r\n'
+        + f"--{boundary}--\r\n".encode()
+    )
+    model, new_body = parse_multipart_model(body, f"multipart/form-data; boundary={boundary}")
+    assert model == "whisper-1"
+    assert b"BINARY" in new_body
+
+
+def test_model_only_body_rejected():
+    body, ctype = build_multipart({"model": b"whisper"})
+    with pytest.raises(APIError, match="no content parts"):
+        parse_multipart_model(body, ctype)
+
+
+def test_header_casing_insensitive():
+    mc = FakeModelClient([Model(meta=ObjectMeta(name="whisper"), spec=ModelSpec(url="hf://a/b"))])
+    body, ctype = build_multipart({"model": b"whisper", "file": b"AUDIO"})
+    req = parse_request(
+        mc, body, "/openai/v1/audio/transcriptions", {"CONTENT-TYPE": ctype}
+    )
+    assert req.model_name == "whisper"
+
+
+class FakeModelClient:
+    def __init__(self, models):
+        self.models = {m.meta.name: m for m in models}
+
+    def lookup_model(self, name, adapter, selectors):
+        m = self.models.get(name)
+        if m is None:
+            raise APIError(404, "not found")
+        return m
+
+
+def test_parse_request_multipart_passthrough():
+    mc = FakeModelClient([Model(meta=ObjectMeta(name="whisper"), spec=ModelSpec(url="hf://a/b"))])
+    body, ctype = build_multipart({"model": b"whisper", "file": b"AUDIO"})
+    req = parse_request(mc, body, "/openai/v1/audio/transcriptions", {"Content-Type": ctype})
+    assert req.model_name == "whisper"
+    assert b"AUDIO" in req.body_bytes()
+    assert b'name="model"' not in req.body_bytes()
