@@ -45,6 +45,35 @@ def scrape_metrics(addr: str, timeout: float = 3.0) -> dict[str, float]:
     return parse_scraped_text(text)
 
 
+def scrape_engine_queue(addr: str, timeout: float = 3.0) -> float:
+    """GET an ENGINE pod's /metrics and return its queue depth — work
+    admitted past the proxy (saturation, cold starts) that the in-flight
+    gauge alone can't see."""
+    url = addr if addr.startswith("http") else f"http://{addr}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        text = resp.read().decode()
+    parsed = parse_prometheus_text(text)
+    return sum(v for _, v in parsed.get(ENGINE_QUEUE_METRIC, []))
+
+
+def engine_queue_scraper(lb, timeout: float = 2.0):
+    """Build the autoscaler's engine-queue callback over the load
+    balancer's endpoint view: sums queue depth across a model's ready
+    engine pods (unreachable pods contribute zero — the signal is an
+    additive hint, not a liveness check)."""
+
+    def scrape(model_name: str) -> float:
+        total = 0.0
+        for addr in lb.get_all_addresses(model_name):
+            try:
+                total += scrape_engine_queue(addr, timeout=timeout)
+            except Exception:
+                continue
+        return total
+
+    return scrape
+
+
 def parse_scraped_text(text: str) -> dict[str, float]:
     parsed = parse_prometheus_text(text)
     out: dict[str, float] = {}

@@ -71,6 +71,8 @@ class Manager:
             cache_reconciler=self.cache_reconciler,
             adapter_reconciler=self.adapter_reconciler,
         )
+        from kubeai_tpu.autoscaler.autoscaler import engine_queue_scraper
+
         self.autoscaler = Autoscaler(
             self.store,
             self.model_client,
@@ -81,6 +83,7 @@ class Manager:
             fixed_self_metric_addrs=self.system.fixed_self_metric_addrs,
             state_name=self.system.autoscaling.state_config_map_name,
             namespace=namespace,
+            engine_queue_scrape=engine_queue_scraper(self.lb),
         )
         self.proxy = ModelProxy(self.model_client, self.lb)
         self.api = OpenAIServer(self.proxy, self.model_client, host=host, port=port)

@@ -166,6 +166,34 @@ class TestScalingMath:
             peer.stop()
 
 
+class TestEngineQueueScrape:
+    def test_scraper_sums_engine_queues(self):
+        from kubeai_tpu.autoscaler.autoscaler import engine_queue_scraper
+
+        peers = [
+            FakeMetricsPeer("kubeai_engine_queue_depth 3\n"),
+            FakeMetricsPeer("kubeai_engine_queue_depth 2\n"),
+        ]
+
+        class LB:
+            def get_all_addresses(self, model):
+                return [p.addr for p in peers] + ["127.0.0.1:1"]  # one dead
+
+        try:
+            scrape = engine_queue_scraper(LB(), timeout=0.5)
+            assert scrape("m1") == 5.0
+        finally:
+            for p in peers:
+                p.stop()
+
+    def test_manager_wires_queue_signal(self):
+        from kubeai_tpu.config.system import System
+        from kubeai_tpu.manager import Manager
+
+        mgr = Manager(System().default_and_validate(), store=Store(), port=0)
+        assert mgr.autoscaler.engine_queue_scrape is not None
+
+
 class TestParse:
     def test_parse_scraped_text_sums_types(self):
         text = (
