@@ -36,40 +36,49 @@ class AutoscalerState:
     averages: dict[str, float] = field(default_factory=dict)
 
 
+def _fetch_metrics_text(addr: str, timeout: float) -> str:
+    url = addr if addr.startswith("http") else f"http://{addr}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
 def scrape_metrics(addr: str, timeout: float = 3.0) -> dict[str, float]:
     """GET metrics from one peer; returns model -> active count
     (ref: metrics.go:36-71)."""
-    url = addr if addr.startswith("http") else f"http://{addr}/metrics"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        text = resp.read().decode()
-    return parse_scraped_text(text)
+    return parse_scraped_text(_fetch_metrics_text(addr, timeout))
 
 
-def scrape_engine_queue(addr: str, timeout: float = 3.0) -> float:
-    """GET an ENGINE pod's /metrics and return its queue depth — work
-    admitted past the proxy (saturation, cold starts) that the in-flight
-    gauge alone can't see."""
-    url = addr if addr.startswith("http") else f"http://{addr}/metrics"
-    with urllib.request.urlopen(url, timeout=timeout) as resp:
-        text = resp.read().decode()
-    parsed = parse_prometheus_text(text)
-    return sum(v for _, v in parsed.get(ENGINE_QUEUE_METRIC, []))
+ENGINE_ACTIVE_METRIC = "kubeai_engine_active_slots"
 
 
-def engine_queue_scraper(lb, timeout: float = 2.0):
-    """Build the autoscaler's engine-queue callback over the load
-    balancer's endpoint view: sums queue depth across a model's ready
-    engine pods (unreachable pods contribute zero — the signal is an
-    additive hint, not a liveness check)."""
+def scrape_engine_load(addr: str, timeout: float = 3.0) -> float:
+    """GET an ENGINE pod's /metrics and return queued + active work as the
+    engine itself sees it."""
+    parsed = parse_prometheus_text(_fetch_metrics_text(addr, timeout))
+    return sum(v for _, v in parsed.get(ENGINE_QUEUE_METRIC, [])) + sum(
+        v for _, v in parsed.get(ENGINE_ACTIVE_METRIC, [])
+    )
+
+
+def engine_queue_scraper(lb, timeout: float = 2.0, max_workers: int = 8):
+    """Build the autoscaler's engine-load callback over the load balancer's
+    endpoint view. Pods are scraped concurrently so dead endpoints cost one
+    timeout per tick, not one per pod; unreachable pods contribute zero."""
+    from concurrent.futures import ThreadPoolExecutor
 
     def scrape(model_name: str) -> float:
-        total = 0.0
-        for addr in lb.get_all_addresses(model_name):
+        addrs = lb.get_all_addresses(model_name)
+        if not addrs:
+            return 0.0
+
+        def one(addr):
             try:
-                total += scrape_engine_queue(addr, timeout=timeout)
+                return scrape_engine_load(addr, timeout=timeout)
             except Exception:
-                continue
-        return total
+                return 0.0
+
+        with ThreadPoolExecutor(max_workers=min(max_workers, len(addrs))) as ex:
+            return float(sum(ex.map(one, addrs)))
 
     return scrape
 
@@ -175,9 +184,15 @@ class Autoscaler:
             if avg is None:
                 avg = SimpleMovingAverage([0.0] * self.window)
                 self._averages[name] = avg
+            # Proxied requests are counted by the active gauge for their
+            # whole lifetime INCLUDING time queued inside engines, so
+            # engine-side load is a subset of it, not an addition — adding
+            # would double-count saturation. max() covers the case the
+            # gauge can't see: traffic reaching engines without passing
+            # any operator replica.
             signal = actives.get(name, 0.0)
             if self.engine_queue_scrape is not None:
-                signal += self.engine_queue_scrape(name)
+                signal = max(signal, self.engine_queue_scrape(name))
             avg.next(signal)
             mean = avg.calculate()
             import math

@@ -17,7 +17,7 @@ import os
 import time
 import uuid
 
-from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler, engine_queue_scraper
 from kubeai_tpu.autoscaler.leader import Election
 from kubeai_tpu.config.system import System, load_system_config
 from kubeai_tpu.controller.adapters import AdapterReconciler
@@ -71,8 +71,6 @@ class Manager:
             cache_reconciler=self.cache_reconciler,
             adapter_reconciler=self.adapter_reconciler,
         )
-        from kubeai_tpu.autoscaler.autoscaler import engine_queue_scraper
-
         self.autoscaler = Autoscaler(
             self.store,
             self.model_client,

@@ -152,7 +152,10 @@ class TestScalingMath:
         finally:
             peer.stop()
 
-    def test_engine_queue_signal_added(self):
+    def test_engine_queue_signal_max_not_additive(self):
+        """Engine load is a subset of proxied actives (they count queued
+        time too): the combined signal is max(), never a double-counting
+        sum (review regression)."""
         store = Store()
         store.create(mt.KIND_MODEL, mk_model())
         peer = FakeMetricsPeer('kubeai_inference_requests_active{request_model="m1"} 2\n')
@@ -160,18 +163,18 @@ class TestScalingMath:
             asc, _ = mk_autoscaler(store, [peer.addr], window=1)
             asc.engine_queue_scrape = lambda name: 6.0
             asc.tick()
-            # (2 + 6) / 2 = 4
-            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 4
+            # max(2, 6) / 2 = 3 (additive would give 4)
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 3
         finally:
             peer.stop()
 
 
 class TestEngineQueueScrape:
-    def test_scraper_sums_engine_queues(self):
+    def test_scraper_sums_engine_load(self):
         from kubeai_tpu.autoscaler.autoscaler import engine_queue_scraper
 
         peers = [
-            FakeMetricsPeer("kubeai_engine_queue_depth 3\n"),
+            FakeMetricsPeer("kubeai_engine_queue_depth 3\nkubeai_engine_active_slots 1\n"),
             FakeMetricsPeer("kubeai_engine_queue_depth 2\n"),
         ]
 
@@ -181,7 +184,7 @@ class TestEngineQueueScrape:
 
         try:
             scrape = engine_queue_scraper(LB(), timeout=0.5)
-            assert scrape("m1") == 5.0
+            assert scrape("m1") == 6.0
         finally:
             for p in peers:
                 p.stop()
