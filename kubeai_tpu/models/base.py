@@ -31,7 +31,8 @@ class ModelConfig:
     # it are dropped (GShard semantics). Raise for exactness at the cost of
     # padding compute.
     moe_capacity_factor: float = 2.0
-    # Architecture variants (Gemma family).
+    # Architecture variants (Gemma family / Qwen2).
+    qkv_bias: bool = False  # Qwen2-style biases on q/k/v projections
     hidden_act: str = "silu"  # "silu" | "gelu_tanh"
     embed_scale: bool = False  # multiply embeddings by sqrt(hidden)
     rms_one_offset: bool = False  # RMSNorm weight is (1 + w)
@@ -89,6 +90,9 @@ class ModelConfig:
                 )
         model_type = get("model_type", "llama")
         gemma_kw = {}
+        if model_type == "qwen2":
+            # Qwen2 hardcodes q/k/v projection biases (modeling_qwen2).
+            gemma_kw["qkv_bias"] = True
         if model_type in ("gemma", "gemma2"):
             gemma_kw = dict(
                 hidden_act="gelu_tanh",

@@ -65,6 +65,10 @@ def init_params(config: ModelConfig, key: jax.Array, dtype=None) -> Params:
         "wv": w(next(keys), L, D, Kv * h),
         "wo": w(next(keys), L, H * h, D),
     }
+    if config.qkv_bias:
+        layers["bq"] = jnp.zeros((L, H * h), dtype)
+        layers["bk"] = jnp.zeros((L, Kv * h), dtype)
+        layers["bv"] = jnp.zeros((L, Kv * h), dtype)
     if config.post_norms:
         layers["ln1b"] = jnp.ones((L, D), dtype)
         layers["ln2b"] = jnp.ones((L, D), dtype)
@@ -113,6 +117,10 @@ def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype
         "wv": stack("model.layers.{}.self_attn.v_proj.weight"),
         "wo": stack("model.layers.{}.self_attn.o_proj.weight"),
     }
+    if config.qkv_bias:
+        layers["bq"] = stack("model.layers.{}.self_attn.q_proj.bias", transpose=False)
+        layers["bk"] = stack("model.layers.{}.self_attn.k_proj.bias", transpose=False)
+        layers["bv"] = stack("model.layers.{}.self_attn.v_proj.bias", transpose=False)
     if config.post_norms:
         # Gemma2 layout: post-attn + pre/post-feedforward norms.
         layers["ln1b"] = stack("model.layers.{}.post_attention_layernorm.weight", transpose=False)
@@ -328,6 +336,9 @@ def apply(
     def layer(x, w, k_cache_l, v_cache_l, lora_l=None, sliding=None):
         def proj(inp, name):
             out = qdot(inp, w[name])
+            bias_key = "b" + name[1:]  # wq -> bq
+            if config.qkv_bias and bias_key in w:
+                out = out + w[bias_key]
             if lora_l is not None:
                 out = out + _lora_delta(
                     inp, lora_l[name + "_A"], lora_l[name + "_B"], lora_rows, lora["scale"]

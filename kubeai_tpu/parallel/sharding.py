@@ -32,6 +32,15 @@ def llama_param_specs(config=None, fsdp: bool = False):
         "wv": P(None, d, "tp"),
         "wo": P(None, "tp", d),
     }
+    # Spec tree structure must match the param tree exactly — variant
+    # params are gated on the same config flags that create them.
+    if config is not None and config.qkv_bias:
+        layers["bq"] = P(None, "tp")
+        layers["bk"] = P(None, "tp")
+        layers["bv"] = P(None, "tp")
+    if config is not None and config.post_norms:
+        layers["ln1b"] = P(None, None)
+        layers["ln2b"] = P(None, None)
     if moe:
         layers["wr"] = P(None, d, None)
         layers["wg"] = P(None, "ep", d, "tp")

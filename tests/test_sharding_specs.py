@@ -1,0 +1,49 @@
+"""Spec trees must structurally match param trees for every model variant
+(a mismatch crashes shard_tree at load; review regression)."""
+
+import jax
+import pytest
+
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.parallel.sharding import llama_param_specs
+
+BASE = dict(
+    vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, dtype="float32",
+)
+
+VARIANTS = {
+    "llama": ModelConfig(**BASE),
+    "qwen2": ModelConfig(**BASE, qkv_bias=True),
+    "gemma2": ModelConfig(
+        **BASE, post_norms=True, rms_one_offset=True, embed_scale=True,
+        tie_word_embeddings=True, hidden_act="gelu_tanh",
+    ),
+    "mixtral": ModelConfig(**BASE, num_experts=4, num_experts_per_tok=2),
+}
+
+
+@pytest.mark.parametrize("name", list(VARIANTS))
+@pytest.mark.parametrize("fsdp", [False, True])
+def test_spec_tree_matches_param_tree(name, fsdp):
+    cfg = VARIANTS[name]
+    params = llama.init_params(cfg, jax.random.key(0))
+    specs = llama_param_specs(cfg, fsdp=fsdp)
+    # tree_map raises on any structural mismatch.
+    jax.tree_util.tree_map(lambda p, s: None, params, specs)
+    # And every spec's rank matches its param's rank.
+    def check(p, s):
+        assert len(s) <= p.ndim, (p.shape, s)
+
+    jax.tree_util.tree_map(check, params, specs)
+
+
+def test_tp_load_of_qwen2_variant(cpu_mesh_devices):
+    from kubeai_tpu.parallel import make_mesh, shard_tree
+
+    cfg = VARIANTS["qwen2"]
+    params = llama.init_params(cfg, jax.random.key(0))
+    mesh = make_mesh(tp=2)
+    sharded = shard_tree(params, llama_param_specs(cfg), mesh)
+    assert sharded["layers"]["bq"].shape == params["layers"]["bq"].shape
