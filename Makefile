@@ -1,0 +1,28 @@
+# Dev ergonomics (cf. the reference's Makefile targets).
+
+PY ?= python
+
+.PHONY: test test-fast bench bench-tiny dryrun loadgen-demo native clean
+
+test:
+	$(PY) -m pytest tests/ -q
+
+test-fast:  ## skip the slow e2e/model-parity suites
+	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_local.py \
+	    --ignore=tests/test_e2e_chaos.py --ignore=tests/test_finetune.py
+
+bench:
+	$(PY) bench.py
+
+bench-tiny:
+	$(PY) bench.py --tiny
+
+dryrun:  ## multi-chip sharding dryrun on 8 virtual CPU devices
+	$(PY) __graft_entry__.py 8
+
+native:  ## build the C++ fasthash extension explicitly
+	$(PY) -c "from kubeai_tpu.utils.native import load; print(load())"
+
+clean:
+	rm -rf build .pytest_cache
+	find . -name __pycache__ -type d -exec rm -rf {} +

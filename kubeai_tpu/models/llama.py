@@ -336,9 +336,10 @@ def apply(
     def layer(x, w, k_cache_l, v_cache_l, lora_l=None, sliding=None):
         def proj(inp, name):
             out = qdot(inp, w[name])
-            bias_key = "b" + name[1:]  # wq -> bq
-            if config.qkv_bias and bias_key in w:
-                out = out + w[bias_key]
+            # KeyError at trace time if a qkv_bias config meets a tree
+            # without biases — better than silently wrong logits.
+            if config.qkv_bias and name in ("wq", "wk", "wv"):
+                out = out + w["b" + name[1:]]
             if lora_l is not None:
                 out = out + _lora_delta(
                     inp, lora_l[name + "_A"], lora_l[name + "_B"], lora_rows, lora["scale"]

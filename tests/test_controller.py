@@ -112,6 +112,19 @@ class TestReconcile:
         new_hashes = {p.meta.labels[mt.LABEL_POD_HASH] for p in pods}
         assert new_hashes.isdisjoint(old_hashes)
 
+    def test_deleted_pod_recreated(self, env):
+        """Pod recovery: a pod that disappears (node loss, eviction) is
+        recreated on the next reconcile (ref: the reference's pod-recovery
+        integration case)."""
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(replicas=2))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        store.delete(KIND_POD, pods[0].meta.name)
+        assert len(store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})) == 1
+        reconcile_until_settled(rec, "m1")
+        assert len(store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})) == 2
+
     def test_model_delete_cascades_pods(self, env):
         store, _, rec = env
         store.create(mt.KIND_MODEL, mk_model(replicas=2))
