@@ -3,16 +3,16 @@
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 
 Measures steady-state output token throughput of the continuous-batching
-engine on a 1.3B-class Llama (bf16, random weights — tokens/s does not
-depend on weight values) under realistic concurrency. vs_baseline anchors
-against the only single-accelerator output-throughput number the
-reference publishes: 285.25 output tok/s (vLLM, Llama-3.2-11B on 1x L4;
-ref: docs/benchmarks/llama-3.2-11b-vision.md:12-30 / BASELINE.md). The
-model classes differ (1.3B vs 11B) so treat the ratio as an anchor, not
-an apples-to-apples comparison; later rounds add the 8B-class metric
-from BASELINE.json once quantized weights fit a single v5e chip.
+engine (random weights — tokens/s does not depend on weight values)
+under realistic concurrency. Presets: `1.3b` (default; bf16),
+`8b-int8` (the BASELINE.json headline config: Llama-3-8B shape on one
+16GB chip via int8), `tiny` (CPU smoke). vs_baseline anchors against the
+only single-accelerator output-throughput number the reference
+publishes: 285.25 output tok/s (vLLM, Llama-3.2-11B on 1x L4;
+ref: docs/benchmarks/llama-3.2-11b-vision.md:12-30 / BASELINE.md) — an
+anchor, not an apples-to-apples comparison.
 
-Usage: python bench.py [--tiny] [--json-only]
+Usage: python bench.py [--preset tiny|1.3b|8b-int8] [--watchdog S]
 """
 
 import argparse
@@ -26,20 +26,45 @@ sys.path.insert(0, os.path.dirname(os.path.abspath(__file__)))
 REFERENCE_SINGLE_ACCEL_TOKS = 285.25
 
 
-def build_engine(tiny: bool):
+def build_engine(preset: str):
     import jax
+    import numpy as np
 
     from kubeai_tpu.engine.core import Engine, EngineConfig
     from kubeai_tpu.engine.tokenizer import ByteTokenizer
     from kubeai_tpu.models import llama
     from kubeai_tpu.models.base import ModelConfig
 
-    if tiny:
+    if preset == "tiny":
         mc = ModelConfig(
             vocab_size=512, hidden_size=128, intermediate_size=256,
             num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
         )
         ec = EngineConfig(max_slots=4, max_seq_len=256, prefill_buckets=(32, 64, 128))
+        params = llama.init_params(mc, jax.random.key(0))
+    elif preset == "8b-int8":
+        # The BASELINE.json headline config: Llama-3-8B shape on ONE v5e
+        # chip via int8 weights. Built with the SAME init as the serving
+        # path, on the CPU backend, and quantized there — the accelerator
+        # only ever receives the int8 tree.
+        from kubeai_tpu.engine.weights import quantize_model_params
+
+        mc = ModelConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+            dtype="bfloat16",
+        )
+        if jax.default_backend() == "tpu":
+            # Match load_engine_from_path's real int8 serving config.
+            mc = mc.replace(use_flash_prefill=True)
+        ec = EngineConfig(
+            max_slots=16, max_seq_len=1024, prefill_buckets=(128, 256, 512),
+            decode_chunk=16,
+        )
+        with jax.default_device(jax.devices("cpu")[0]):
+            params = llama.init_params(mc, jax.random.key(0))
+            params = quantize_model_params(params, mc)
+        params = jax.device_put(params)
     else:
         # 1.3B-class Llama in bf16.
         mc = ModelConfig(
@@ -50,17 +75,26 @@ def build_engine(tiny: bool):
             max_slots=32, max_seq_len=1024, prefill_buckets=(128, 256, 512),
             decode_chunk=16,
         )
-    params = llama.init_params(mc, jax.random.key(0))
+        params = llama.init_params(mc, jax.random.key(0))
     return Engine(mc, params, ByteTokenizer(), ec)
 
 
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--tiny", action="store_true", help="CPU smoke mode")
+    parser.add_argument(
+        "--preset", default=None, choices=["tiny", "1.3b", "8b-int8"],
+        help="model preset (default 1.3b; 8b-int8 = BASELINE.json headline config)",
+    )
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--max-tokens", type=int, default=None)
-    parser.add_argument("--watchdog", type=int, default=480, help="hard deadline (s); 0 disables")
+    parser.add_argument(
+        "--watchdog", type=int, default=None,
+        help="hard deadline (s); 0 disables; default 480 (1200 for 8b-int8 setup)",
+    )
     args = parser.parse_args()
+    if args.watchdog is None:
+        args.watchdog = 1200 if args.preset == "8b-int8" else 480
 
     import threading
 
@@ -93,11 +127,13 @@ def main():
 
     from kubeai_tpu.engine.sampling import SamplingParams
 
-    n_requests = args.requests or (8 if args.tiny else 64)
-    max_tokens = args.max_tokens or (8 if args.tiny else 128)
-    prompt_len = 16 if args.tiny else 128
+    preset = args.preset or ("tiny" if args.tiny else "1.3b")
+    tiny = preset == "tiny"
+    n_requests = args.requests or (8 if tiny else 64)
+    max_tokens = args.max_tokens or (8 if tiny else 128)
+    prompt_len = 16 if tiny else 128
 
-    eng = build_engine(args.tiny)
+    eng = build_engine(preset)
     eng.start()
 
     rng = np.random.default_rng(0)

@@ -1,0 +1,33 @@
+"""Using the operator with the official OpenAI Python client.
+
+The operator's API is OpenAI-compatible: point base_url at
+http://<operator>:8000/openai/v1 and use any model (or model_adapter id)
+from /openai/v1/models. Works identically against a standalone engine
+(base_url http://<engine>:8000/v1).
+
+    pip install openai   # client side only; the operator needs nothing
+"""
+
+from openai import OpenAI
+
+client = OpenAI(base_url="http://localhost:8000/openai/v1", api_key="unused")
+
+# List models (adapters appear as "<model>_<adapter>").
+for m in client.models.list():
+    print(m.id)
+
+# Chat (streams through scale-from-zero on a cold model).
+stream = client.chat.completions.create(
+    model="gemma-2b-it-tpu",
+    messages=[{"role": "user", "content": "Say hi in three words."}],
+    stream=True,
+)
+for chunk in stream:
+    delta = chunk.choices[0].delta.content
+    if delta:
+        print(delta, end="", flush=True)
+print()
+
+# Embeddings (TextEmbedding-feature models).
+emb = client.embeddings.create(model="bge-embed-text-cpu", input=["hello world"])
+print(len(emb.data[0].embedding), "dims")
