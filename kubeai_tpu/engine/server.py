@@ -436,6 +436,31 @@ def build_engine_from_args(args) -> tuple[Engine, str]:
     return eng, args.served_model_name or args.model
 
 
+def maybe_init_distributed() -> None:
+    """Multi-host slice bootstrap: the controller stamps gang pods with
+    TPU_WORKER_ID + TPU_WORKER_HOSTNAMES (controller/engines/tpu.py);
+    rank 0's host serves as the jax.distributed coordinator so the gang
+    forms one device mesh across hosts. No-op for single-host pods."""
+    import os
+
+    hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+    if not hostnames:
+        return
+    hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
+    if len(hosts) < 2:
+        return
+    import jax
+
+    process_id = int(os.environ.get("TPU_WORKER_ID", "0"))
+    coordinator = f"{hosts[0]}:{os.environ.get('TPU_COORDINATOR_PORT', '8476')}"
+    log.info("joining slice: coordinator=%s rank=%d/%d", coordinator, process_id, len(hosts))
+    jax.distributed.initialize(
+        coordinator_address=coordinator,
+        num_processes=len(hosts),
+        process_id=process_id,
+    )
+
+
 def main(argv=None):
     # Honor JAX_PLATFORMS explicitly: plugin registration can override the
     # env var, and config only works before the first backend query.
@@ -446,6 +471,7 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", want)
+    maybe_init_distributed()
 
     parser = argparse.ArgumentParser("kubeai-tpu-engine")
     parser.add_argument("--model", required=True, help="checkpoint dir or test:tiny")
