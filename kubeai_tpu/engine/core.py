@@ -60,6 +60,13 @@ class EngineConfig:
     # the first load triggers one recompile of the step functions).
     max_adapters: int = 8
     max_lora_rank: int = 64
+    # Slot-level prefix caching: a new prompt sharing >= this many tokens
+    # with a free slot's resident sequence skips prefilling the shared
+    # prefix (KV for a matching prefix is identical by causality). This is
+    # what makes PrefixHash routing pay off inside the engine — the
+    # reference relies on vLLM's prefix cache for the same effect.
+    # 0 disables.
+    prefix_cache_min: int = 16
 
 
 @dataclass
@@ -143,6 +150,10 @@ class Engine:
         self.m_hbm_limit = default_registry.gauge(
             "kubeai_engine_hbm_limit_bytes", "accelerator memory capacity"
         )
+        self.m_prefix_cached = default_registry.counter(
+            "kubeai_engine_prefix_cached_tokens_total",
+            "prompt tokens skipped via slot prefix reuse",
+        )
 
         self._init_device_state()
         self._build_step_fns(apply_fns)
@@ -160,6 +171,17 @@ class Engine:
         self._top_p = jnp.ones((B,), jnp.float32)
         self._top_k = jnp.zeros((B,), jnp.int32)
         self._lora_rows = jnp.zeros((B,), jnp.int32)
+        # Prefix cache bookkeeping: per slot, the token ids whose KV is
+        # resident (the last entry may be unwritten — reuse clamps), and an
+        # epoch guarding against appends from a previous occupant's chunk.
+        self._kv_history: list[list[int]] = [[] for _ in range(B)]
+        # The token the next decode step will WRITE (KV at a position
+        # belongs to that step's input token, not its sampled output).
+        self._kv_pending: list[int | None] = [None] * B
+        # KV depends on the adapter weights: (row, row-generation), so a
+        # recycled or reloaded row can never alias an old sequence.
+        self._kv_lora_sig: list[tuple[int, int]] = [(0, 0)] * B
+        self._slot_epoch: list[int] = [0] * B
         if not hasattr(self, "_adapters"):
             self._adapters = None  # AdapterRuntime; survives _recover()
 
@@ -220,6 +242,9 @@ class Engine:
 
         if apply_fns is not None:  # test seam
             self._prefill_jit, self._decode_jit = apply_fns(prefill_fn, decode_fn)
+            # Reuse-eligible prompts take the chunked path, which the seam
+            # stubs out — disable prefix caching for seam engines.
+            self.cfg.prefix_cache_min = 0
 
             def _no_chunked(*a, **k):
                 raise NotImplementedError(
@@ -263,9 +288,9 @@ class Engine:
         self._wake.set()
         return req
 
-    def generate(self, prompt_ids: list[int], params: SamplingParams, timeout: float = 300):
+    def generate(self, prompt_ids: list[int], params: SamplingParams, timeout: float = 300, adapter: str | None = None):
         """Blocking convenience wrapper: returns (token_ids, text, FinishInfo)."""
-        req = self.submit(prompt_ids, params)
+        req = self.submit(prompt_ids, params, adapter=adapter)
         ids: list[int] = []
         chunks: list[str] = []
         deadline = time.monotonic() + timeout
@@ -416,10 +441,10 @@ class Engine:
             self.m_queue.set(self._queue.qsize())
             if req.cancelled.is_set():
                 continue
-            slot_idx = self._slots.index(None)
+            slot_idx = self._pick_slot(req)
             try:
                 tok_ref = self._prefill(slot_idx, req)
-                admitted.append((slot_idx, tok_ref))
+                admitted.append((slot_idx, self._slot_epoch[slot_idx], tok_ref))
             except Exception as e:  # surface engine errors to the client
                 log.exception("prefill failed")
                 req.out.put(("error", f"prefill failed: {e}"))
@@ -431,11 +456,42 @@ class Engine:
                     raise
         if admitted:
             # One host sync for all first tokens of this admission batch.
-            toks = jax.device_get([t for _, t in admitted])
-            for (slot_idx, _), tok in zip(admitted, toks):
+            toks = jax.device_get([t for _, _, t in admitted])
+            for (slot_idx, epoch, _), tok in zip(admitted, toks):
+                if self._slot_epoch[slot_idx] == epoch:
+                    # This token is what the next decode step writes.
+                    self._kv_pending[slot_idx] = int(tok)
                 if self._slots[slot_idx] is not None:
                     self._emit_token(slot_idx, int(tok))
         return bool(admitted)
+
+    @staticmethod
+    def _common_prefix_len(a: list[int], b: list[int]) -> int:
+        n = min(len(a), len(b))
+        for i in range(n):
+            if a[i] != b[i]:
+                return i
+        return n
+
+    def _lora_sig(self, adapter: str | None) -> tuple[int, int]:
+        if self._adapters is None:
+            return (0, 0)
+        return self._adapters.row_sig(adapter)
+
+    def _pick_slot(self, req: Request) -> int:
+        """Free slot with the longest resident common prefix (ties: lowest
+        index, so cold slots cycle deterministically)."""
+        best, best_common = -1, -1
+        sig = self._lora_sig(req.adapter)
+        for i, s in enumerate(self._slots):
+            if s is not None:
+                continue
+            common = 0
+            if self.cfg.prefix_cache_min and self._kv_lora_sig[i] == sig:
+                common = self._common_prefix_len(self._kv_history[i], req.prompt_ids)
+            if common > best_common:
+                best, best_common = i, common
+        return best
 
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -455,8 +511,19 @@ class Engine:
             lora_row = self._adapters.row_for(req.adapter)
             lora_args = {"lora": self._adapters.bank, "lora_row": jnp.int32(lora_row)}
 
+        # Prefix reuse: skip the prefix already resident in this slot's KV
+        # (the -1 clamps are safety margins: at least one token is always
+        # prefilled so last-token logits exist).
+        reuse = 0
+        if self.cfg.prefix_cache_min and self._kv_lora_sig[slot_idx] == self._lora_sig(req.adapter):
+            common = self._common_prefix_len(self._kv_history[slot_idx], ids)
+            common = min(common, len(self._kv_history[slot_idx]) - 1, len(ids) - 1)
+            if common >= self.cfg.prefix_cache_min:
+                reuse = common
+                self.m_prefix_cached.inc(reuse)
+
         max_bucket = max(self.cfg.prefill_buckets)
-        if len(ids) <= max_bucket:
+        if reuse == 0 and len(ids) <= max_bucket:
             padded = np.zeros((1, self._bucket(len(ids))), np.int32)
             padded[0, : len(ids)] = ids
             tok, self._cache = self._prefill_jit(
@@ -472,10 +539,10 @@ class Engine:
                 **lora_args,
             )
         else:
-            # Chunked prefill: full-bucket chunks at increasing offsets;
-            # only the final chunk's sampled token is kept.
+            # Chunked prefill from the reuse offset: full-bucket chunks at
+            # increasing offsets; only the final chunk's sample is kept.
             tok = None
-            for start in range(0, len(ids), max_bucket):
+            for start in range(reuse, len(ids), max_bucket):
                 chunk = ids[start : start + max_bucket]
                 is_last = start + max_bucket >= len(ids)
                 bucket = max_bucket if not is_last else self._bucket(len(chunk))
@@ -508,8 +575,16 @@ class Engine:
         self._slots[slot_idx] = slot
         self._n_active += 1
         self.m_active.set(self._n_active)
-        self.m_prefill.inc(len(ids))
+        self.m_prefill.inc(len(ids) - reuse)  # actual prefill work done
         self.m_ttft.observe(time.monotonic() - req.arrival)
+
+        # Prefix-cache bookkeeping: the slot now holds exactly the prompt's
+        # KV (positions beyond it are stale and unreachable by the mask).
+        # The first sampled token becomes the next decode step's WRITE.
+        self._kv_history[slot_idx] = list(ids)
+        self._kv_pending[slot_idx] = None  # set once the token id is known
+        self._kv_lora_sig[slot_idx] = self._lora_sig(req.adapter)
+        self._slot_epoch[slot_idx] += 1
 
         # Register slot in device state: position of the first generated
         # token is prompt_len; decode will write it there. The first token
@@ -542,13 +617,22 @@ class Engine:
             self._top_k,
             **lora_args,
         )
-        snapshot = [(i, s) for i, s in enumerate(self._slots) if s is not None]
+        snapshot = [
+            (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
+        ]
         return toks_seq, snapshot
 
     def _process_chunk(self, toks_seq, snapshot):
         tok_host = np.asarray(jax.device_get(toks_seq))  # [K, B]
         for k in range(tok_host.shape[0]):
-            for i, slot_obj in snapshot:
+            for i, slot_obj, epoch in snapshot:
+                # Record KV residency for prefix reuse: the step WROTE the
+                # pending (input) token; its sampled output becomes the
+                # next step's write. Skip if a new occupant reset the slot.
+                if self._slot_epoch[i] == epoch:
+                    if self._kv_pending[i] is not None:
+                        self._kv_history[i].append(self._kv_pending[i])
+                    self._kv_pending[i] = int(tok_host[k, i])
                 # Emit only while the slot still belongs to the request it
                 # held at dispatch time (it may finish mid-chunk, or have
                 # been freed and re-admitted since dispatch).

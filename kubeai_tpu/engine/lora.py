@@ -89,6 +89,11 @@ class AdapterRuntime:
         # Row 0 is the reserved no-adapter identity.
         self.bank = llama.init_lora_bank(config, max_adapters + 1, max_rank, dtype)
         self._rows: dict[str, int] = {}
+        # Per-row generation, bumped whenever a row's weights change
+        # (load/reload/unload): rows are recycled, so consumers caching
+        # anything derived from a row (e.g. KV prefix reuse) must key on
+        # (row, generation), never the bare index.
+        self._row_gen: dict[int, int] = {}
         self._lock = threading.Lock()
 
     def row_for(self, name: str | None) -> int:
@@ -96,6 +101,12 @@ class AdapterRuntime:
             return 0
         with self._lock:
             return self._rows.get(name, 0)
+
+    def row_sig(self, name: str | None) -> tuple[int, int]:
+        """(row, generation) identity of the adapter's current weights."""
+        with self._lock:
+            row = self._rows.get(name, 0) if name else 0
+            return row, self._row_gen.get(row, 0)
 
     def names(self) -> list[str]:
         with self._lock:
@@ -136,6 +147,7 @@ class AdapterRuntime:
                 bank[B_key] = bank[B_key].at[:, row].set(jnp.asarray(Bm, dtype))
             bank["scale"] = bank["scale"].at[row].set(scale)
             self._rows[name] = row
+            self._row_gen[row] = self._row_gen.get(row, 0) + 1
 
     def unload(self, name: str) -> bool:
         with self._lock:
@@ -146,4 +158,5 @@ class AdapterRuntime:
                 if key.endswith("_A") or key.endswith("_B"):
                     self.bank[key] = self.bank[key].at[:, row].set(0.0)
             self.bank["scale"] = self.bank["scale"].at[row].set(0.0)
+            self._row_gen[row] = self._row_gen.get(row, 0) + 1
             return True

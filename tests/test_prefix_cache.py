@@ -1,0 +1,137 @@
+"""Slot prefix caching: reuse must never change results, must actually
+skip work, and must respect adapter identity."""
+
+import numpy as np
+import pytest
+
+import jax
+
+from kubeai_tpu.engine.core import Engine, EngineConfig
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.engine.tokenizer import ByteTokenizer
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+
+CFG = ModelConfig(
+    vocab_size=272, hidden_size=64, intermediate_size=128, num_layers=2,
+    num_heads=4, num_kv_heads=2, dtype="float32", max_position=1024,
+)
+
+
+def mk_engine(prefix_cache_min=16, seed=11):
+    params = llama.init_params(CFG, jax.random.key(seed))
+    eng = Engine(
+        CFG, params, ByteTokenizer(),
+        EngineConfig(
+            max_slots=2, max_seq_len=256, prefill_buckets=(32, 64, 128),
+            prefix_cache_min=prefix_cache_min,
+        ),
+    )
+    eng.start()
+    return eng
+
+
+@pytest.fixture(scope="module")
+def engines():
+    cached = mk_engine(prefix_cache_min=16)
+    uncached = mk_engine(prefix_cache_min=0)
+    yield cached, uncached
+    cached.stop()
+    uncached.stop()
+
+
+def test_multi_turn_reuses_and_matches(engines):
+    """Turn 2 extends turn 1's conversation: the cached engine must reuse
+    the resident prefix AND produce byte-identical greedy output to the
+    uncached engine."""
+    cached, uncached = engines
+    rng = np.random.default_rng(0)
+    turn1 = rng.integers(1, 200, 64).tolist()
+    p = SamplingParams(temperature=0.0, max_tokens=8)
+
+    out1_c = cached.generate(turn1, p)
+    out1_u = uncached.generate(turn1, p)
+    assert out1_c[0] == out1_u[0]
+
+    # Turn 2 = turn 1 + its reply + new user text (classic chat pattern).
+    turn2 = turn1 + out1_c[0] + rng.integers(1, 200, 16).tolist()
+    before = cached.m_prefix_cached.value()
+    out2_c = cached.generate(turn2, p)
+    out2_u = uncached.generate(turn2, p)
+    assert out2_c[0] == out2_u[0]
+    reused = cached.m_prefix_cached.value() - before
+    # The reply region must reuse too (KV history tracks written INPUT
+    # tokens — a one-off shift there would break exactly this assertion).
+    want = len(turn1) + len(out1_c[0]) - 2
+    assert reused >= want, f"expected >= {want} reused, got {reused}"
+
+
+def test_identical_prompt_reuse_matches(engines):
+    cached, uncached = engines
+    prompt = np.random.default_rng(1).integers(1, 200, 48).tolist()
+    p = SamplingParams(temperature=0.0, max_tokens=6)
+    first = cached.generate(prompt, p)
+    before = cached.m_prefix_cached.value()
+    second = cached.generate(prompt, p)
+    assert second[0] == first[0] == uncached.generate(prompt, p)[0]
+    assert cached.m_prefix_cached.value() > before
+
+
+def test_divergent_prompt_not_poisoned(engines):
+    """A prompt diverging early must not inherit the other conversation's
+    KV (correctness of the common-prefix computation)."""
+    cached, uncached = engines
+    rng = np.random.default_rng(2)
+    a = rng.integers(1, 200, 40).tolist()
+    b = list(a)
+    b[4] = (b[4] + 1) % 199 + 1  # diverge at token 4 (< prefix_cache_min)
+    p = SamplingParams(temperature=0.0, max_tokens=6)
+    cached.generate(a, p)
+    out_b_c = cached.generate(b, p)
+    out_b_u = uncached.generate(b, p)
+    assert out_b_c[0] == out_b_u[0]
+
+
+def test_short_common_prefix_not_reused(engines):
+    cached, _ = engines
+    rng = np.random.default_rng(3)
+    a = rng.integers(1, 200, 20).tolist()
+    b = a[:8] + rng.integers(1, 200, 12).tolist()  # only 8 common < min 16
+    p = SamplingParams(temperature=0.0, max_tokens=4)
+    cached.generate(a, p)
+    before = cached.m_prefix_cached.value()
+    cached.generate(b, p)
+    assert cached.m_prefix_cached.value() == before
+
+
+def test_adapter_row_recycling_does_not_alias(tmp_path):
+    """Unloading adapter A and loading B into the recycled row must not
+    let B's requests reuse KV computed under A (review regression)."""
+    import sys
+    sys.path.insert(0, "/root/repo/tests")
+    from test_lora import write_peft_checkpoint
+
+    eng = mk_engine(prefix_cache_min=8, seed=12)
+    try:
+        write_peft_checkpoint(str(tmp_path / "a"), CFG, seed=1)
+        write_peft_checkpoint(str(tmp_path / "b"), CFG, seed=2)
+        prompt = np.random.default_rng(5).integers(1, 200, 32).tolist()
+        p = SamplingParams(temperature=0.0, max_tokens=4)
+
+        eng.load_adapter("a", str(tmp_path / "a"))
+        eng.generate(prompt, p, )  # warm base slot
+        out_a = eng.generate(prompt, p)  # adapter-less baseline reuse ok
+        eng.unload_adapter("a")
+        eng.load_adapter("b", str(tmp_path / "b"))  # recycles row 1
+
+        # Fresh engine truth for adapter b.
+        fresh = mk_engine(prefix_cache_min=0, seed=12)
+        try:
+            fresh.load_adapter("b", str(tmp_path / "b"))
+            want = fresh.generate(prompt, p, adapter="b")
+        finally:
+            fresh.stop()
+        got = eng.generate(prompt, p, adapter="b")
+        assert got[0] == want[0]
+    finally:
+        eng.stop()
