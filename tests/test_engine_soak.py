@@ -22,10 +22,7 @@ CFG = ModelConfig(
 
 
 def test_mixed_concurrent_soak(tmp_path):
-    import sys
-
-    sys.path.insert(0, "/root/repo/tests")
-    from test_lora import write_peft_checkpoint
+    from tests.test_lora import write_peft_checkpoint
 
     params = llama.init_params(CFG, jax.random.key(3))
     eng = Engine(

@@ -107,9 +107,7 @@ def test_short_common_prefix_not_reused(engines):
 def test_adapter_row_recycling_does_not_alias(tmp_path):
     """Unloading adapter A and loading B into the recycled row must not
     let B's requests reuse KV computed under A (review regression)."""
-    import sys
-    sys.path.insert(0, "/root/repo/tests")
-    from test_lora import write_peft_checkpoint
+    from tests.test_lora import write_peft_checkpoint
 
     eng = mk_engine(prefix_cache_min=8, seed=12)
     try:
