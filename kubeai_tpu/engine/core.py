@@ -289,6 +289,31 @@ class Engine:
         self._wake.set()
         if self._thread:
             self._thread.join(timeout=10)
+            if self._thread.is_alive():
+                # Scheduler wedged mid-dispatch (e.g. hung device call):
+                # mutating slot state here would race it. Callers time out;
+                # the process is going down anyway.
+                log.warning("engine loop did not exit; skipping in-flight cleanup")
+                return
+        # Fail anything still in flight so callers never hang on shutdown.
+        self._fail_inflight("engine shutting down")
+
+    def _fail_inflight(self, message: str) -> None:
+        """Error out every slotted and queued request and reset counters
+        (shared by shutdown and device-error recovery)."""
+        for i, slot in enumerate(self._slots):
+            if slot is not None:
+                self._slots[i] = None
+                slot.req.out.put(("error", message))
+        self._n_active = 0
+        self.m_active.set(0)
+        while True:
+            try:
+                req = self._queue.get_nowait()
+            except queue.Empty:
+                break
+            req.out.put(("error", message))
+        self.m_queue.set(0)
 
     def submit(self, prompt_ids: list[int], params: SamplingParams, adapter: str | None = None) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
@@ -301,6 +326,8 @@ class Engine:
             )
         if adapter and (self._adapters is None or self._adapters.row_for(adapter) == 0):
             raise ValueError(f"adapter {adapter!r} is not loaded")
+        if not self._running:
+            raise RuntimeError("engine is not running")
         req = Request(prompt_ids=prompt_ids, params=params, adapter=adapter)
         self._queue.put_nowait(req)
         self.m_queue.set(self._queue.qsize())
@@ -441,13 +468,7 @@ class Engine:
                 pending = None
 
     def _recover(self):
-        for i, slot in enumerate(self._slots):
-            if slot is not None:
-                self._slots[i] = None
-                self._n_active -= 1
-                slot.req.out.put(("error", "engine reset after device error"))
-        self._n_active = 0
-        self.m_active.set(0)
+        self._fail_inflight("engine reset after device error")
         self._init_device_state()
 
     def _admit_waiting(self) -> bool:

@@ -136,6 +136,10 @@ def main(argv=None):
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
     parser.add_argument("--models", default=None, help="YAML file of Model manifests to apply at boot")
+    parser.add_argument(
+        "--catalog", default=None,
+        help="comma-separated curated catalog entries to apply at boot (see kubeai_tpu.catalog)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -155,6 +159,17 @@ def main(argv=None):
         from kubeai_tpu.catalog import apply_manifest_file
 
         apply_manifest_file(mgr.store, args.models)
+    if args.catalog:
+        from kubeai_tpu.catalog import CATALOG, apply_catalog
+
+        names = [n.strip() for n in args.catalog.split(",") if n.strip()]
+        unknown = [n for n in names if n not in CATALOG]
+        if unknown:
+            mgr.stop()
+            parser.error(
+                f"unknown catalog entries {unknown}; available: {sorted(CATALOG)}"
+            )
+        apply_catalog(mgr.store, names)
 
     try:
         while True:

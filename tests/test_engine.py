@@ -220,3 +220,36 @@ class TestHTTP:
             assert stop not in out
             assert out == text.split(stop)[0]
             assert body["choices"][0]["finish_reason"] == "stop"
+
+
+class TestShutdown:
+    def test_stop_fails_inflight_instead_of_hanging(self):
+        from kubeai_tpu.engine.core import build_test_engine
+
+        eng = build_test_engine(seed=5)
+        eng.start()
+        # Warm compile so the long request actually occupies a slot.
+        eng.generate(eng.tokenizer.encode("warm"), SamplingParams(temperature=0.0, max_tokens=2))
+        req = eng.submit(
+            eng.tokenizer.encode("long running"),
+            SamplingParams(temperature=0.9, max_tokens=200, seed=1),
+        )
+        import time as _time
+
+        _time.sleep(0.3)  # let it get admitted
+        eng.stop()
+        deadline = _time.time() + 10
+        saw_error = False
+        ev = None
+        while _time.time() < deadline:
+            try:
+                ev = req.out.get(timeout=2)
+            except Exception:
+                break
+            if ev[0] == "error":
+                saw_error = True
+                break
+            if ev[0] == "done":
+                break
+        assert saw_error or (ev is not None and ev[0] == "done")
+        assert eng.active_slots() == 0
