@@ -77,20 +77,33 @@ def default_probes(container: Container, startup_seconds: int = 10800):
     )
 
 
+_QUANTITY_SUFFIXES = (
+    "Ei", "Pi", "Ti", "Gi", "Mi", "Ki",  # binary
+    "E", "P", "T", "G", "M", "k", "K", "m",  # decimal (+legacy "K", milli "m")
+)
+
+
 def _mul_quantity(q: str, n: int) -> str:
-    """Multiply a k8s-style quantity string by an integer count."""
+    """Multiply a k8s-style quantity string by an integer count.
+    Handles the full suffix set and fractional values ("0.5Gi" x 3 ->
+    "1.5Gi"); raises on unparseable quantities rather than silently
+    under-requesting resources (ref: model_controller.go:289-301
+    multiplies via apimachinery's exact Quantity arithmetic)."""
     if n == 1:
         return q
-    for suffix in ("Gi", "Mi", "Ki", "G", "M", "K", "m"):
-        if q.endswith(suffix):
-            try:
-                return f"{int(q[: -len(suffix)]) * n}{suffix}"
-            except ValueError:
-                return q
+    from decimal import Decimal, InvalidOperation
+
+    s = q.strip()
+    num, sfx = s, ""
+    for suffix in _QUANTITY_SUFFIXES:
+        if s.endswith(suffix):
+            num, sfx = s[: -len(suffix)], suffix
+            break
     try:
-        return str(int(q) * n)
-    except ValueError:
-        try:
-            return str(float(q) * n)
-        except ValueError:
-            return q
+        val = Decimal(num) * n
+    except InvalidOperation:
+        raise ValueError(f"unparseable resource quantity {q!r}") from None
+    text = format(val, "f")
+    if "." in text:
+        text = text.rstrip("0").rstrip(".")
+    return f"{text}{sfx}"

@@ -1,15 +1,24 @@
-"""Continuous-batching inference engine (JetStream-style slots).
+"""Continuous-batching inference engine (JetStream-style slots, paged KV).
 
 The TPU-native replacement for the engine containers the reference
 orchestrates but never implements (ref: charts/kubeai/values.yaml:39-75
 engine image matrix; SURVEY.md §2.9). Architecture:
 
-- A fixed pool of **decode slots** backed by one big KV cache
-  [L, max_slots, max_seq_len, Kv, h] that lives on device and is donated
-  through every jitted step (no per-step copies).
-- **Prefill** pads the prompt to a power-of-two bucket and writes straight
-  into the admitted slot's cache rows via `llama.prefill_into` (one
-  compilation per bucket).
+- A fixed pool of **decode slots** backed by a **paged KV pool**
+  [L, Kv, pages, page, h] that lives on device and is donated through
+  every jitted step (no per-step copies). Each slot maps its sequence
+  onto pool pages through a block table; pages holding full, content-
+  addressed prefixes are ref-counted and **shared across slots**
+  (engine/paging.py), so a hot system prompt is prefilled once and
+  reused by every concurrent request that shares it — the engine-side
+  complement to PrefixHash routing. Pages for prompt+budget are
+  reserved at admission (requests wait, never die mid-decode), and HBM
+  is consumed proportional to actual sequence lengths, not
+  max_slots x max_seq_len.
+- **Prefill** pads the prompt to a power-of-two bucket and writes
+  through the slot's block table (one compilation per bucket); requests
+  resuming after a shared-prefix hit take the chunked path from the
+  reuse offset.
 - **Decode** runs all slots every step in a single jitted call that also
   samples (per-slot temperature/top-k/top-p arrays) and advances per-slot
   PRNG keys device-side; only the sampled token ids [max_slots] cross back
@@ -60,12 +69,24 @@ class EngineConfig:
     # the first load triggers one recompile of the step functions).
     max_adapters: int = 8
     max_lora_rank: int = 64
-    # Slot-level prefix caching: a new prompt sharing >= this many tokens
-    # with a free slot's resident sequence skips prefilling the shared
-    # prefix (KV for a matching prefix is identical by causality). This is
-    # what makes PrefixHash routing pay off inside the engine — the
-    # reference relies on vLLM's prefix cache for the same effect.
-    # 0 disables.
+    # Non-greedy sampling candidate space (see engine/sampling.py);
+    # <= 0 samples the exact full distribution (full-vocab sort).
+    max_top_k: int = 128
+    # Paged KV: tokens per page. 64 keeps TPU tiling happy (page x head
+    # dims land on (16,128)+ bf16 tiles) while giving fine-grained HBM
+    # accounting; tests use smaller pages for sharper assertions.
+    page_size: int = 64
+    # Total pool pages (incl. reserved trash page 0). 0 = auto-size to
+    # max_slots * ceil(max_seq_len/page_size) + 1, i.e. the same HBM as
+    # a dense slot cache — sharing then shows up as headroom. Operators
+    # can overcommit (more slots than fully-backed sequences) or shrink.
+    num_pages: int = 0
+    # Cross-slot prefix caching: a prompt whose resident shared prefix
+    # (whole pages, content-addressed — engine/paging.py) is >= this many
+    # tokens skips prefilling it (KV for a matching prefix is identical
+    # by causality). This is what makes PrefixHash routing pay off inside
+    # the engine — the reference relies on vLLM's prefix cache for the
+    # same effect. 0 disables.
     prefix_cache_min: int = 16
 
 
@@ -152,7 +173,16 @@ class Engine:
         )
         self.m_prefix_cached = default_registry.counter(
             "kubeai_engine_prefix_cached_tokens_total",
-            "prompt tokens skipped via slot prefix reuse",
+            "prompt tokens skipped via shared-prefix page reuse",
+        )
+        self.m_pages_used = default_registry.gauge(
+            "kubeai_engine_kv_pages_used", "KV pool pages referenced by live slots"
+        )
+        self.m_pages_cached = default_registry.gauge(
+            "kubeai_engine_kv_pages_cached", "free KV pages retaining reusable prefixes"
+        )
+        self.m_pages_total = default_registry.gauge(
+            "kubeai_engine_kv_pages_total", "allocatable KV pool pages"
         )
 
         self._init_device_state()
@@ -161,8 +191,28 @@ class Engine:
     # -- device state ------------------------------------------------------
 
     def _init_device_state(self):
+        from kubeai_tpu.engine.paging import PagePool
+
         B = self.cfg.max_slots
-        self._cache = llama.init_cache(self.model_config, B, self.cfg.max_seq_len)
+        ps = self.cfg.page_size
+        self._max_pages = -(-self.cfg.max_seq_len // ps)
+        P = self.cfg.num_pages or (B * self._max_pages + 1)
+        self._pool = PagePool(P, ps)
+        self._cache = llama.init_paged_cache(self.model_config, P, ps)
+        # Host-authoritative block tables, uploaded per dispatch (tiny).
+        self._page_table = np.zeros((B, self._max_pages), np.int32)
+        self._slot_pages: list[list[int]] = [[] for _ in range(B)]
+        # Pages content-registered at plan time whose prefill has NOT yet
+        # succeeded (cleared by _register): a failed prefill must
+        # unregister exactly these so never-written KV can't be reused.
+        self._slot_fresh: list[list[int]] = [[] for _ in range(B)]
+        # Decode-token budget reserved by _plan_admission, consumed by
+        # _register — ONE computation, because the page reservation must
+        # exactly cover the slot's decode budget.
+        self._slot_budget: list[int] = [0] * B
+        self.m_pages_total.set(P - 1)
+        self.m_pages_used.set(0)
+        self.m_pages_cached.set(0)
         self._lengths = jnp.zeros((B,), jnp.int32)
         self._last_tokens = jnp.zeros((B,), jnp.int32)
         self._active = jnp.zeros((B,), jnp.bool_)
@@ -171,9 +221,10 @@ class Engine:
         self._top_p = jnp.ones((B,), jnp.float32)
         self._top_k = jnp.zeros((B,), jnp.int32)
         self._lora_rows = jnp.zeros((B,), jnp.int32)
-        # Prefix cache bookkeeping: per slot, the token ids whose KV is
-        # resident (the last entry may be unwritten — reuse clamps), and an
-        # epoch guarding against appends from a previous occupant's chunk.
+        # Prefix bookkeeping: per slot, the token ids whose KV has been
+        # written to the slot's pages (generated-token pages are content-
+        # registered from this at free time), and an epoch guarding
+        # against appends from a previous occupant's chunk.
         self._kv_history: list[list[int]] = [[] for _ in range(B)]
         # The token the next decode step will WRITE (KV at a position
         # belongs to that step's input token, not its sampled output).
@@ -182,6 +233,9 @@ class Engine:
         # recycled or reloaded row can never alias an old sequence.
         self._kv_lora_sig: list[tuple[int, int]] = [(0, 0)] * B
         self._slot_epoch: list[int] = [0] * B
+        # Requests that fit a free slot but not the KV pool wait here
+        # (strict FIFO: no later request overtakes them).
+        self._deferred: list[Request] = []
         if not hasattr(self, "_adapters"):
             self._adapters = None  # AdapterRuntime; survives _recover()
 
@@ -197,9 +251,14 @@ class Engine:
                 return logits.at[..., n_valid:].set(-jnp.inf)
             return logits
 
-        def prefill_fn(params, tokens, length, slot, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
-            logits, cache = llama.prefill_into(
-                params, mc, tokens, cache, slot, length, lora=lora, lora_row=lora_row
+        mtk = self.cfg.max_top_k
+
+        def prefill_fn(params, tokens, length, table, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
+            """Cold single-prompt prefill through block table [1, max_pages]."""
+            logits, cache = llama.prefill_paged_cold(
+                params, mc, tokens, cache, table, length[None],
+                lora=lora,
+                lora_rows=None if lora_row is None else lora_row[None],
             )
             tok = sample(
                 mask_pad(logits[:, -1]),
@@ -207,47 +266,48 @@ class Engine:
                 temp[None],
                 top_p[None],
                 top_k[None],
+                max_top_k=mtk,
             )[0]
             return tok, cache
 
-        def prefill_batch_fn(params, tokens, lengths, slots, keys, temp, top_p, top_k, cache, lora=None, lora_rows=None):
-            """Admit several same-bucket requests in ONE prefill: tokens
-            [N, S] land in cache rows *slots* [N]; returns sampled first
-            tokens [N]. Cuts cold-burst TTFT ~Nx vs serial admission."""
-            logits, cache = llama.apply(
-                params, mc, tokens,
-                jnp.broadcast_to(jnp.arange(tokens.shape[1], dtype=jnp.int32)[None, :], tokens.shape),
-                cache,
-                logits_idx=lengths - 1,
-                cache_rows=slots,
-                lora=lora,
-                lora_rows=lora_rows,
-                left_aligned=True,
+        def prefill_batch_fn(params, tokens, lengths, tables, keys, temp, top_p, top_k, cache, lora=None, lora_rows=None):
+            """Admit several same-bucket cold requests in ONE prefill:
+            tokens [N, S] land in the pages of *tables* [N, max_pages];
+            returns sampled first tokens [N]. Cuts cold-burst TTFT ~Nx
+            vs serial admission."""
+            logits, cache = llama.prefill_paged_cold(
+                params, mc, tokens, cache, tables, lengths,
+                lora=lora, lora_rows=lora_rows,
             )
-            toks = sample(mask_pad(logits[:, -1]), keys, temp, top_p, top_k)
+            toks = sample(mask_pad(logits[:, -1]), keys, temp, top_p, top_k, max_top_k=mtk)
             return toks, cache
 
-        def prefill_chunk_fn(params, tokens, start, last_idx, slot, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
-            logits, cache = llama.prefill_chunk_into(
-                params, mc, tokens, cache, slot, start, last_idx, lora=lora, lora_row=lora_row
+        def prefill_chunk_fn(params, tokens, start, last_idx, table, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
+            """One chunk of a long or prefix-resuming prompt."""
+            logits, cache = llama.prefill_paged(
+                params, mc, tokens, cache, table, start[None], last_idx[None],
+                lora=lora,
+                lora_rows=None if lora_row is None else lora_row[None],
             )
             tok = sample(
-                mask_pad(logits[:, -1]), key[None], temp[None], top_p[None], top_k[None]
+                mask_pad(logits[:, -1]), key[None], temp[None], top_p[None], top_k[None],
+                max_top_k=mtk,
             )[0]
             return tok, cache
 
         K = self.cfg.decode_chunk
 
-        def decode_fn(params, cache, lengths, last_tokens, keys, active, temp, top_p, top_k, lora=None, lora_rows=None):
+        def decode_fn(params, cache, tables, lengths, last_tokens, keys, active, temp, top_p, top_k, lora=None, lora_rows=None):
             """K fused decode+sample steps; returns token ids [K, B]."""
 
             def body(carry, _):
                 cache, lengths, last, keys = carry
-                logits, cache = llama.decode_step(
-                    params, mc, last[:, None], cache, lengths, lora=lora, lora_rows=lora_rows
+                logits, cache = llama.decode_step_paged(
+                    params, mc, last[:, None], cache, tables, lengths,
+                    lora=lora, lora_rows=lora_rows,
                 )
                 step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-                toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k)
+                toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk)
                 toks = jnp.where(active, toks, last)
                 lengths = jnp.where(active, lengths + 1, lengths)
                 return (cache, lengths, toks, step_keys[:, 1]), toks
@@ -275,7 +335,9 @@ class Engine:
             self._prefill_jit = jax.jit(prefill_fn, donate_argnums=(8,))
             self._prefill_chunk_jit = jax.jit(prefill_chunk_fn, donate_argnums=(9,))
             self._prefill_batch_jit = jax.jit(prefill_batch_fn, donate_argnums=(8,))
-            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 2, 3, 4))
+            # tables (arg 2) are host-authoritative and re-uploaded per
+            # dispatch — not donated.
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 3, 4, 5))
 
     # -- public API --------------------------------------------------------
 
@@ -305,8 +367,12 @@ class Engine:
             if slot is not None:
                 self._slots[i] = None
                 slot.req.out.put(("error", message))
+                self._release_slot_pages(i)
         self._n_active = 0
         self.m_active.set(0)
+        for req in self._deferred:
+            req.out.put(("error", message))
+        self._deferred.clear()
         while True:
             try:
                 req = self._queue.get_nowait()
@@ -319,7 +385,12 @@ class Engine:
         """Enqueue a request; raises queue.Full when saturated (the proxy
         retries another replica on 503). Prompts beyond the largest prefill
         bucket are chunk-prefilled, up to the slot capacity."""
-        max_prompt = self.cfg.max_seq_len - 1
+        # The prompt plus at least one generated token must fit both the
+        # position space and the page pool (minus the trash page).
+        max_prompt = min(
+            self.cfg.max_seq_len,
+            (self._pool.num_pages - 1) * self.cfg.page_size,
+        ) - 1
         if len(prompt_ids) > max_prompt:
             raise ValueError(
                 f"prompt too long: {len(prompt_ids)} tokens > {max_prompt}"
@@ -330,7 +401,7 @@ class Engine:
             raise RuntimeError("engine is not running")
         req = Request(prompt_ids=prompt_ids, params=params, adapter=adapter)
         self._queue.put_nowait(req)
-        self.m_queue.set(self._queue.qsize())
+        self.m_queue.set(self.queue_depth())
         self._wake.set()
         return req
 
@@ -434,7 +505,9 @@ class Engine:
             self.m_hbm_limit.set(limit)
 
     def queue_depth(self) -> int:
-        return self._queue.qsize()
+        # Deferred requests (admitted off the queue but waiting for KV
+        # pages) are still queued work from the autoscaler's viewpoint.
+        return self._queue.qsize() + len(self._deferred)
 
     def active_slots(self) -> int:
         return self._n_active
@@ -473,66 +546,109 @@ class Engine:
 
     def _admit_waiting(self) -> bool:
         admitted: list[tuple[int, Any]] = []  # (slot_idx, epoch, first_token_ref)
-        singles: list[tuple[int, "Request"]] = []
+        singles: list[tuple[int, int, "Request", int]] = []  # (seq, slot, req, reuse)
         groups: dict[int, list[tuple[int, "Request"]]] = {}  # bucket -> items
         taken: set[int] = set()
         max_bucket = max(self.cfg.prefill_buckets)
+        seq = 0
         while self._n_active + len(taken) < self.cfg.max_slots:
-            try:
-                req = self._queue.get_nowait()
-            except queue.Empty:
-                break
-            self.m_queue.set(self._queue.qsize())
+            # Pool-blocked requests wait at the head of the line (strict
+            # FIFO — nothing overtakes them).
+            if self._deferred:
+                req = self._deferred.pop(0)
+            else:
+                try:
+                    req = self._queue.get_nowait()
+                except queue.Empty:
+                    break
+                self.m_queue.set(self.queue_depth())
             if req.cancelled.is_set():
                 continue
-            slot_idx = self._pick_slot(req, exclude=taken)
+            if req.adapter and (
+                self._adapters is None or self._adapters.row_for(req.adapter) == 0
+            ):
+                # Validated at submit(), but the adapter may have been
+                # unloaded while the request sat in the queue — running
+                # it against the base model would be silently wrong.
+                req.out.put(("error", f"adapter {req.adapter!r} is not loaded"))
+                continue
+            plan = self._plan_admission(req, taken)
+            if plan is None:
+                # KV pool can't back prompt+budget yet; wait for a free.
+                self._deferred.insert(0, req)
+                self.m_queue.set(self.queue_depth())
+                break
+            slot_idx, reuse = plan
             taken.add(slot_idx)
             # Cold, bucket-sized requests batch into one prefill call;
             # reuse/long requests go through the single/chunked path.
             if (
                 self._prefill_batch_jit is not None
-                and self._reuse_for(slot_idx, req) == 0
+                and reuse == 0
                 and len(req.prompt_ids) <= max_bucket
             ):
                 groups.setdefault(self._bucket(len(req.prompt_ids)), []).append((slot_idx, req))
             else:
-                singles.append((slot_idx, req))
+                singles.append((seq, slot_idx, req, reuse))
+            seq += 1
 
         # Lone-member groups take the single path (its fast single-shot
-        # call avoids the batch padding).
+        # call avoids the batch padding). seq -1: a lone cold request
+        # must dispatch before any same-round claimant of its pages
+        # (claims only ever reference earlier-drained requests, and
+        # groups — all cold — dispatch first).
         for bucket in list(groups):
             if len(groups[bucket]) == 1:
-                singles.append(groups.pop(bucket)[0])
+                slot_idx, req = groups.pop(bucket)[0]
+                singles.append((-1, slot_idx, req, 0))
 
         work: list[tuple[list, Any]] = []  # (items, thunk)
-        for slot_idx, req in singles:
-            def one(slot_idx=slot_idx, req=req):
-                tok_ref = self._prefill(slot_idx, req, self._reuse_for(slot_idx, req))
-                admitted.append((slot_idx, self._slot_epoch[slot_idx], tok_ref))
-
-            work.append(([(slot_idx, req)], one))
+        # Groups first: shared pages registered by a cold group member
+        # must be written before a reuse single reads them (device-stream
+        # order follows dispatch order).
         for bucket, items in groups.items():
             def batch(items=items, bucket=bucket):
                 for slot_idx, epoch, tok_ref in self._prefill_group(items, bucket):
                     admitted.append((slot_idx, epoch, tok_ref))
 
             work.append((items, batch))
+        for _, slot_idx, req, reuse in sorted(singles, key=lambda t: t[0]):
+            def one(slot_idx=slot_idx, req=req, reuse=reuse):
+                tok_ref = self._prefill(slot_idx, req, reuse)
+                admitted.append((slot_idx, self._slot_epoch[slot_idx], tok_ref))
+
+            work.append(([(slot_idx, req)], one))
 
         for w, (items, thunk) in enumerate(work):
             try:
                 thunk()
             except Exception as e:
                 log.exception("prefill failed")
+                poisoned = False
                 for slot_idx, req in items:
                     if self._slots[slot_idx] is None:
                         req.out.put(("error", f"prefill failed: {e}"))
-                # A failed jitted prefill may have consumed the donated
-                # cache — escalate to _loop's recovery. Requests drained
-                # from the queue but not yet prefilled would otherwise be
-                # silently dropped (their callers would hang): error them
-                # out before raising.
+                        # The prefill never wrote this slot's pages: any
+                        # plan-time content registration must be undone
+                        # so the never-written KV can't be prefix-reused.
+                        fresh = self._slot_fresh[slot_idx]
+                        self._slot_fresh[slot_idx] = []
+                        if any(self._pool.refcount(p) > 1 for p in fresh):
+                            # A same-round request already claimed one of
+                            # these pages — its prefill would read
+                            # garbage. Escalate to full recovery.
+                            poisoned = True
+                        self._pool.unregister_pages(fresh)
+                        self._release_slot_pages(slot_idx)
+                # Escalate to _loop's recovery when the failure can't be
+                # contained to this request: a failed jitted prefill may
+                # have consumed the donated cache, and a same-round
+                # claimant of the failed slot's pages would read garbage
+                # (poisoned). Requests drained from the queue but not yet
+                # prefilled would otherwise be silently dropped (their
+                # callers would hang): error them out before raising.
                 kbuf = self._cache["k"]
-                if getattr(kbuf, "is_deleted", lambda: False)():
+                if poisoned or getattr(kbuf, "is_deleted", lambda: False)():
                     for later_items, _ in work[w + 1 :]:
                         for slot_idx, req in later_items:
                             if self._slots[slot_idx] is None:
@@ -549,46 +665,77 @@ class Engine:
                     self._emit_token(slot_idx, int(tok))
         return bool(admitted)
 
-    @staticmethod
-    def _common_prefix_len(a: list[int], b: list[int]) -> int:
-        n = min(len(a), len(b))
-        for i in range(n):
-            if a[i] != b[i]:
-                return i
-        return n
-
     def _lora_sig(self, adapter: str | None) -> tuple[int, int]:
         if self._adapters is None:
             return (0, 0)
         return self._adapters.row_sig(adapter)
 
-    def _pick_slot(self, req: Request, exclude: set[int] | None = None) -> int:
-        """Free slot with the longest resident common prefix (ties: lowest
-        index, so cold slots cycle deterministically)."""
-        best, best_common = -1, -1
-        sig = self._lora_sig(req.adapter)
-        for i, s in enumerate(self._slots):
-            if s is not None or (exclude and i in exclude):
-                continue
-            common = 0
-            if self.cfg.prefix_cache_min and self._kv_lora_sig[i] == sig:
-                common = self._common_prefix_len(self._kv_history[i], req.prompt_ids)
-            if common > best_common:
-                best, best_common = i, common
-        return best
+    def _update_page_gauges(self) -> None:
+        self.m_pages_used.set(self._pool.used())
+        self.m_pages_cached.set(self._pool.cached_pages())
 
-    def _reuse_for(self, slot_idx: int, req: Request) -> int:
-        """Resident-prefix tokens this request may skip in this slot
-        (0 below the threshold; the -1 clamps keep at least one token
-        prefilled so last-token logits exist)."""
-        if not self.cfg.prefix_cache_min:
-            return 0
-        if self._kv_lora_sig[slot_idx] != self._lora_sig(req.adapter):
-            return 0
+    def _plan_admission(self, req: Request, taken: set[int]) -> tuple[int, int] | None:
+        """Reserve a slot + KV pages for *req*: claim resident shared-
+        prefix pages (cross-slot reuse), allocate private pages covering
+        the whole prompt+budget (so decode can never run out mid-flight),
+        and write the slot's block-table row. Returns (slot_idx,
+        reuse_tokens), or None when the pool can't back it yet."""
+        from kubeai_tpu.engine.paging import pages_for
+
+        slot_idx = next(
+            i for i, s in enumerate(self._slots) if s is None and i not in taken
+        )
         ids = req.prompt_ids
-        common = self._common_prefix_len(self._kv_history[slot_idx], ids)
-        common = min(common, len(self._kv_history[slot_idx]) - 1, len(ids) - 1)
-        return common if common >= self.cfg.prefix_cache_min else 0
+        ps = self.cfg.page_size
+        budget = max(
+            min(
+                req.params.max_tokens or self.cfg.default_max_tokens,
+                self.cfg.max_seq_len - len(ids) - 1,
+            ),
+            0,
+        )
+        n_total = pages_for(len(ids) + budget, ps)
+        sig = self._lora_sig(req.adapter)
+        claimed: list[int] = []
+        if self.cfg.prefix_cache_min:
+            claimed = self._pool.match_prefix(ids, sig)
+            if claimed and len(claimed) * ps < self.cfg.prefix_cache_min:
+                self._pool.release(claimed)
+                claimed = []
+        if n_total - len(claimed) > self._pool.available():
+            self._pool.release(claimed)
+            return None
+        row = claimed + self._pool.allocate(n_total - len(claimed))
+        if self.cfg.prefix_cache_min:
+            # Register the cold prompt pages NOW so a same-round request
+            # with the same prefix shares them (its prefill dispatches
+            # after ours — see _admit_waiting's ordering).
+            self._slot_fresh[slot_idx] = self._pool.register_chain(ids, sig, row)
+        self._slot_budget[slot_idx] = budget
+        self._slot_pages[slot_idx] = row
+        self._page_table[slot_idx, :] = 0
+        self._page_table[slot_idx, : len(row)] = row
+        reuse = len(claimed) * ps
+        if reuse:
+            self.m_prefix_cached.inc(reuse)
+        self._update_page_gauges()
+        return slot_idx, reuse
+
+    def _release_slot_pages(self, slot_idx: int, register: bool = False) -> None:
+        row = self._slot_pages[slot_idx]
+        if not row:
+            return
+        if register and self.cfg.prefix_cache_min:
+            # Content-register every full page this slot wrote (prompt
+            # AND generated tokens): a follow-up turn extending this
+            # conversation hits them from any slot.
+            self._pool.register_chain(
+                self._kv_history[slot_idx], self._kv_lora_sig[slot_idx], row
+            )
+        self._pool.release(row)
+        self._slot_pages[slot_idx] = []
+        self._page_table[slot_idx, :] = 0
+        self._update_page_gauges()
 
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -596,7 +743,10 @@ class Engine:
                 return b
         return self.cfg.prefill_buckets[-1]
 
-    def _prefill(self, slot_idx: int, req: Request, reuse: int | None = None):
+    def _prefill(self, slot_idx: int, req: Request, reuse: int = 0):
+        """Prefill *req* (pages already reserved by _plan_admission) into
+        its slot's block-table pages, skipping the first *reuse* tokens
+        (their KV lives in claimed shared pages)."""
         ids = req.prompt_ids
         sp = req.params
         seed = sp.seed if sp.seed is not None else (time.monotonic_ns() & 0xFFFFFFFF)
@@ -608,11 +758,7 @@ class Engine:
             lora_row = self._adapters.row_for(req.adapter)
             lora_args = {"lora": self._adapters.bank, "lora_row": jnp.int32(lora_row)}
 
-        if reuse is None:
-            reuse = self._reuse_for(slot_idx, req)
-        if reuse:
-            self.m_prefix_cached.inc(reuse)
-
+        table = jnp.asarray(self._page_table[slot_idx : slot_idx + 1])
         max_bucket = max(self.cfg.prefill_buckets)
         if reuse == 0 and len(ids) <= max_bucket:
             padded = np.zeros((1, self._bucket(len(ids))), np.int32)
@@ -621,7 +767,7 @@ class Engine:
                 self.params,
                 jnp.asarray(padded),
                 jnp.int32(len(ids)),
-                jnp.int32(slot_idx),
+                table,
                 key,
                 jnp.float32(sp.temperature),
                 jnp.float32(sp.top_p),
@@ -644,7 +790,7 @@ class Engine:
                     jnp.asarray(chunk_padded),
                     jnp.int32(start),
                     jnp.int32(len(chunk) - 1),
-                    jnp.int32(slot_idx),
+                    table,
                     key,
                     jnp.float32(sp.temperature),
                     jnp.float32(sp.top_p),
@@ -661,10 +807,10 @@ class Engine:
         stays a device ref — the caller batches the host sync."""
         ids = req.prompt_ids
         sp = req.params
-        budget = min(
-            sp.max_tokens or self.cfg.default_max_tokens,
-            self.cfg.max_seq_len - len(ids) - 1,
-        )
+        # The budget was fixed at plan time — the page reservation covers
+        # exactly prompt+budget, so it must not be recomputed here.
+        budget = self._slot_budget[slot_idx]
+        self._slot_fresh[slot_idx] = []  # prefill succeeded; content valid
         slot = _Slot(
             req=req,
             detok=IncrementalDetokenizer(self.tokenizer),
@@ -708,7 +854,7 @@ class Engine:
 
         tokens = np.zeros((n_pad, bucket), np.int32)
         lengths = np.zeros((n_pad,), np.int32)
-        slots_arr = np.zeros((n_pad,), np.int32)
+        tables = np.zeros((n_pad, self._max_pages), np.int32)
         temps = np.ones((n_pad,), np.float32)
         top_ps = np.ones((n_pad,), np.float32)
         top_ks = np.zeros((n_pad,), np.int32)
@@ -720,7 +866,7 @@ class Engine:
             sp = req.params
             tokens[j, : len(ids)] = ids
             lengths[j] = len(ids)
-            slots_arr[j] = slot_idx
+            tables[j] = self._page_table[slot_idx]
             temps[j] = sp.temperature
             top_ps[j] = sp.top_p
             top_ks[j] = sp.top_k
@@ -736,7 +882,7 @@ class Engine:
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(lengths),
-            jnp.asarray(slots_arr),
+            jnp.asarray(tables),
             jnp.stack(keys),
             jnp.asarray(temps),
             jnp.asarray(top_ps),
@@ -759,6 +905,7 @@ class Engine:
         toks_seq, self._cache, self._lengths, self._last_tokens, self._keys = self._decode_jit(
             self.params,
             self._cache,
+            jnp.asarray(self._page_table),
             self._lengths,
             self._last_tokens,
             self._keys,
@@ -838,6 +985,7 @@ class Engine:
         self._n_active -= 1
         self.m_active.set(self._n_active)
         self._active = self._active.at[slot_idx].set(False)
+        self._release_slot_pages(slot_idx, register=True)
         if deliver:
             if flush:
                 # Deliver held-back chars; detok.text() additionally decodes

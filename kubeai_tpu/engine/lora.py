@@ -127,7 +127,12 @@ class AdapterRuntime:
                     raise RuntimeError(f"adapter capacity {self.max_adapters} exhausted")
                 row = free[0]
 
-            bank = self.bank
+            # Build the update against a shallow COPY and publish it with
+            # one reference assignment at the end: the engine thread reads
+            # self.bank without a lock, and mutating the live dict target-
+            # by-target would let a decode chunk dispatched mid-reload run
+            # with mixed old/new A/B weights.
+            bank = dict(self.bank)
             L = self.config.num_layers
             dtype = bank["wq_A"].dtype
             for target, layers in targets.items():
@@ -146,6 +151,7 @@ class AdapterRuntime:
                 bank[A_key] = bank[A_key].at[:, row].set(jnp.asarray(A, dtype))
                 bank[B_key] = bank[B_key].at[:, row].set(jnp.asarray(Bm, dtype))
             bank["scale"] = bank["scale"].at[row].set(scale)
+            self.bank = bank  # atomic snapshot publish
             self._rows[name] = row
             self._row_gen[row] = self._row_gen.get(row, 0) + 1
 
@@ -154,9 +160,11 @@ class AdapterRuntime:
             row = self._rows.pop(name, None)
             if row is None:
                 return False
-            for key in list(self.bank):
+            bank = dict(self.bank)  # atomic snapshot publish (see load)
+            for key in list(bank):
                 if key.endswith("_A") or key.endswith("_B"):
-                    self.bank[key] = self.bank[key].at[:, row].set(0.0)
-            self.bank["scale"] = self.bank["scale"].at[row].set(0.0)
+                    bank[key] = bank[key].at[:, row].set(0.0)
+            bank["scale"] = bank["scale"].at[row].set(0.0)
+            self.bank = bank
             self._row_gen[row] = self._row_gen.get(row, 0) + 1
             return True

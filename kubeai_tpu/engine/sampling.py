@@ -37,16 +37,20 @@ def sample(
     temperature: jnp.ndarray,  # [B]
     top_p: jnp.ndarray,  # [B]
     top_k: jnp.ndarray,  # [B] int32; 0 = disabled
+    max_top_k: int = MAX_TOP_K,  # static candidate-space cap; <=0 = full vocab
 ) -> jnp.ndarray:
     """Sample one token per slot. Returns [B] int32."""
     B, V = logits.shape
 
-    # Work in the top-MAX_TOP_K candidate space; for top_k==0/top_p==1 the
-    # tail beyond MAX_TOP_K is negligible for any trained model, and greedy
-    # (temperature 0) uses the exact argmax below.
-    vals, idxs = jax.lax.top_k(logits, min(MAX_TOP_K, V))  # [B, K] sorted desc
+    # Work in the top-max_top_k candidate space; for top_k==0/top_p==1 the
+    # tail beyond it is negligible for any trained model, and greedy
+    # (temperature 0) uses the exact argmax below. Operators wanting exact
+    # full-distribution sampling set EngineConfig.max_top_k <= 0 and pay
+    # the full-vocab sort.
+    cap = V if max_top_k <= 0 else min(max_top_k, V)
+    vals, idxs = jax.lax.top_k(logits, cap)  # [B, K] sorted desc
 
-    k = jnp.where(top_k <= 0, MAX_TOP_K, jnp.minimum(top_k, MAX_TOP_K))
+    k = jnp.where(top_k <= 0, cap, jnp.minimum(top_k, cap))
     rank = jnp.arange(vals.shape[1])[None, :]
     vals = jnp.where(rank < k[:, None], vals, -jnp.inf)
 

@@ -23,6 +23,7 @@ from __future__ import annotations
 import argparse
 import json
 import logging
+import os
 import queue
 import re
 import threading
@@ -81,20 +82,9 @@ class EngineServer:
     def _resolve_adapter_path(name: str, path: str) -> str:
         """Remote adapter sources are staged to local disk first (the
         reference does this with an exec'd loader sidecar,
-        ref: internal/modelcontroller/adapters.go:143-160). The staging dir
-        is keyed by the URL hash so a re-load with a new URL never reuses a
-        stale download (loader.load skips populated destinations); the
-        name was validated against a strict charset by load_adapter."""
-        if path.startswith("file://"):
-            return path[len("file://") :]
-        if "://" in path:
-            from kubeai_tpu.loader import load
-            from kubeai_tpu.utils.xxh import xxh64
-
-            dest = f"/tmp/kubeai-adapters/{name}-{xxh64(path) & 0xFFFFFFFF:08x}"
-            load(path, dest)
-            return dest
-        return path
+        ref: internal/modelcontroller/adapters.go:143-160); the name was
+        validated against a strict charset by load_adapter."""
+        return _stage_remote(path, "/tmp/kubeai-adapters", prefix=f"{name}-")
 
     def unload_adapter(self, name: str) -> tuple[bool, str]:
         with self._adapters_lock:
@@ -416,22 +406,57 @@ def _make_handler(srv: EngineServer):
 # CLI — the entrypoint engine pods run.
 
 
+def _stage_remote(url: str, base_dir: str, prefix: str = "") -> str:
+    """Shared remote-source staging: file:// strips to a local path,
+    other schemes (hf/s3/gs/oss) download into base_dir under a dest
+    keyed by the URL hash — so a changed URL never reuses a stale
+    download (loader.load skips already-populated destinations) — and
+    plain paths pass through."""
+    if url.startswith("file://"):
+        return url[len("file://") :]
+    if "://" in url:
+        from kubeai_tpu.loader import load
+        from kubeai_tpu.utils.xxh import xxh64
+
+        dest = os.path.join(base_dir, f"{prefix}{xxh64(url) & 0xFFFFFFFFFFFF:012x}")
+        log.info("staging %s -> %s", url, dest)
+        load(url, dest)
+        return dest
+    return url
+
+
+def _resolve_model_path(model: str) -> str:
+    """Stage remote model sources to local disk so the weight loader
+    always reads a directory — without this, every hf:// TPUEngine pod
+    without a cacheProfile would crashloop at startup
+    (load_engine_from_path only reads local checkpoints)."""
+    return _stage_remote(
+        model, os.environ.get("KUBEAI_MODEL_STAGING_DIR", "/tmp/kubeai-models")
+    )
+
+
 def build_engine_from_args(args) -> tuple[Engine, str]:
     from kubeai_tpu.engine.core import EngineConfig, build_test_engine
 
     ec = EngineConfig(
         max_slots=args.max_slots,
         max_seq_len=args.max_seq_len,
+        page_size=getattr(args, "page_size", 64),
+        num_pages=getattr(args, "kv_pages", 0),
+        prefix_cache_min=getattr(args, "prefix_cache_min", 16),
     )
     if args.model.startswith("test:"):
         eng = build_test_engine(engine_config=ec)
         return eng, args.served_model_name or args.model
     # Real checkpoint path: HF-format directory with config.json +
-    # safetensors weights.
+    # safetensors weights; remote URLs are staged to local disk first.
     from kubeai_tpu.engine.weights import load_engine_from_path
 
     eng = load_engine_from_path(
-        args.model, ec, tp=args.tensor_parallel_size, quantization=args.quantization
+        _resolve_model_path(args.model),
+        ec,
+        tp=args.tensor_parallel_size,
+        quantization=args.quantization,
     )
     return eng, args.served_model_name or args.model
 
@@ -482,6 +507,17 @@ def main(argv=None):
     parser.add_argument("--max-seq-len", type=int, default=2048)
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
     parser.add_argument("--quantization", default="", choices=["", "int8"])
+    parser.add_argument(
+        "--page-size", type=int, default=64, help="KV pool tokens per page"
+    )
+    parser.add_argument(
+        "--kv-pages", type=int, default=0,
+        help="total KV pool pages (0 = auto: max_slots * max_seq_len/page_size + 1)",
+    )
+    parser.add_argument(
+        "--prefix-cache-min", type=int, default=16,
+        help="min shared-prefix tokens to reuse across slots (0 disables)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 

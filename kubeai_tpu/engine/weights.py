@@ -104,7 +104,10 @@ def load_engine_from_path(
             raise ValueError("int8 quantization currently supports tensor-parallel-size 1")
     config = ModelConfig.from_json_file(path).replace(dtype=dtype)
     if jax.default_backend() == "tpu":
-        config = config.replace(use_flash_prefill=True)
+        config = config.replace(
+            use_flash_prefill=True,
+            use_paged_kernel=config.sliding_window == 0,
+        )
     sd = load_state_dict(path)
     if "lm_head.weight" not in sd and not config.tie_word_embeddings:
         config = config.replace(tie_word_embeddings=True)

@@ -47,6 +47,10 @@ class ModelConfig:
     # Use the Pallas flash-attention kernel for prefill (set by the engine
     # on TPU; only valid without softcap/sliding-window).
     use_flash_prefill: bool = False
+    # Use the Pallas paged-attention kernel for decode over the paged KV
+    # pool (set by the engine on TPU; only valid without sliding-window —
+    # softcap is supported). The portable path gathers pages via XLA.
+    use_paged_kernel: bool = False
     dtype: str = "bfloat16"
 
     @property

@@ -169,9 +169,24 @@ def params_from_hf(state_dict: dict[str, np.ndarray], config: ModelConfig, dtype
 
 
 def init_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> Params:
-    """Slot-based contiguous KV cache: [L, B, max_len, Kv, head_dim]."""
+    """Slot-based contiguous KV cache: [L, B, max_len, Kv, head_dim].
+    Used by training/eval and the dryrun; the serving engine uses the
+    paged pool below."""
     dtype = dtype or jnp.dtype(config.dtype)
     shape = (config.num_layers, batch, max_len, config.num_kv_heads, config.head_dim_)
+    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+
+
+def init_paged_cache(config: ModelConfig, num_pages: int, page_size: int, dtype=None) -> Params:
+    """Paged KV pool: [L, Kv, P, page_size, head_dim]. Sequences map onto
+    pages through a per-slot block table ([B, max_pages] int32 of pool
+    indices); page 0 is the engine's trash page (see engine/paging.py).
+    The [Kv, P, page, h] per-layer layout matches the TPU paged-attention
+    kernel's expected [num_kv_heads, total_pages, page_size, head_dim]."""
+    dtype = dtype or jnp.dtype(config.dtype)
+    shape = (
+        config.num_layers, config.num_kv_heads, num_pages, page_size, config.head_dim_,
+    )
     return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
 
 
@@ -270,15 +285,25 @@ def apply(
     lora_rows: jnp.ndarray | None = None,  # [B] adapter index per batch row
     left_aligned: bool = False,  # caller guarantees positions == arange(S)
     return_hidden: bool = False,  # final-norm hidden states instead of logits
+    page_table: jnp.ndarray | None = None,  # [B, max_pages] pool page per seq page
 ):
     """Run the decoder. Returns (logits, new_cache).
 
-    With a cache: new K/V are scattered into cache[:, row, positions[b, s]]
-    and attention spans the whole cache row, masked to keys <= query
-    position. *cache_rows* maps batch rows onto cache rows (continuous
-    batching prefills a single sequence into an arbitrary slot of the big
-    decode cache); default is row b = batch b. Without a cache (training /
-    one-shot scoring): attention is causal over the S new tokens only.
+    With a dense cache (init_cache): new K/V are scattered into
+    cache[:, row, positions[b, s]] and attention spans the whole cache
+    row, masked to keys <= query position. *cache_rows* maps batch rows
+    onto cache rows (continuous batching prefills a single sequence into
+    an arbitrary slot of the big decode cache); default is row b = batch b.
+
+    With a paged cache (init_paged_cache) + *page_table*: position p of
+    batch row b lives in pool page page_table[b, p // page] at offset
+    p % page. Writes scatter through the table (positions beyond the
+    table's span are redirected to trash page 0); attention reads gather
+    each row's pages back into a contiguous [B, max_pages*page] view and
+    use the same position-derived mask.
+
+    Without a cache (training / one-shot scoring): attention is causal
+    over the S new tokens only.
 
     logits shape: [B, S, V], or [B, 1, V] if logits_idx is given.
     """
@@ -309,8 +334,30 @@ def apply(
         and config.attn_softcap == 0.0
         and config.sliding_window == 0
     )
+    # Paged decode kernel: single-token queries over the block-table
+    # pool, TPU only (no interpret path), no sliding window.
+    use_paged_kernel = (
+        config.use_paged_kernel
+        and page_table is not None
+        and S == 1
+        and config.sliding_window == 0
+    )
 
-    if cache is not None:
+    paged = page_table is not None
+    if paged:
+        page = cache["k"].shape[3]
+        max_pages = page_table.shape[1]
+        skv = max_pages * page
+        key_positions = jnp.arange(skv)[None, None, :]  # [1, 1, Skv]
+        # Write indices: pool page + in-page offset per (b, s) token.
+        # Out-of-span positions (bucket padding past the table, decode
+        # overrun after a sequence finished) go to trash page 0 so they
+        # can never corrupt a live page.
+        w_idx = jnp.clip(positions // page, 0, max_pages - 1)
+        w_pages = jnp.take_along_axis(page_table, w_idx, axis=1)
+        w_pages = jnp.where(positions < skv, w_pages, 0)
+        w_offs = positions % page
+    elif cache is not None:
         skv = cache["k"].shape[2]
         key_positions = jnp.arange(skv)[None, None, :]  # [1, 1, Skv]
     else:
@@ -355,7 +402,19 @@ def apply(
         v = proj(attn_in, "wv").reshape(B, S, Kv, h)
         q, k = apply_rope(q, k, positions, inv_freq)
 
-        if k_cache_l is not None:
+        if k_cache_l is not None and paged:
+            # k_cache_l: [Kv, P, page, h]; scatter new K/V through the
+            # block table. Decode on TPU reads pages in place via the
+            # Pallas paged-attention kernel; the portable path gathers
+            # each row's pages into a contiguous [B, Skv, Kv, h] view.
+            k_full = k_cache_l.at[:, w_pages, w_offs].set(k.transpose(2, 0, 1, 3))
+            v_full = v_cache_l.at[:, w_pages, w_offs].set(v.transpose(2, 0, 1, 3))
+            if use_paged_kernel:
+                k_att = v_att = None  # kernel reads pages directly
+            else:
+                k_att = k_full[:, page_table].transpose(1, 2, 3, 0, 4).reshape(B, skv, Kv, h)
+                v_att = v_full[:, page_table].transpose(1, 2, 3, 0, 4).reshape(B, skv, Kv, h)
+        elif k_cache_l is not None:
             k_full = k_cache_l.at[rows, positions].set(k)
             v_full = v_cache_l.at[rows, positions].set(v)
             if cache_rows is None:
@@ -366,7 +425,16 @@ def apply(
             k_full, v_full = k, v
             k_att, v_att = k, v
 
-        if use_flash:
+        if use_paged_kernel:
+            from kubeai_tpu.ops.paged_attention import paged_decode_attention
+
+            attn_out = paged_decode_attention(
+                q, k_full, v_full, page_table,
+                kv_lengths=positions[:, 0] + 1,  # keys 0..pos inclusive
+                scale=config.query_scale,
+                softcap=config.attn_softcap,
+            )
+        elif use_flash:
             # Prefill positions are arange(S): plain causal over the first
             # S cache columns == the position-derived mask.
             from kubeai_tpu.ops.flash_attention import flash_attention_tpu
@@ -502,4 +570,51 @@ def decode_step(params, config, tokens, cache, lengths, lora=None, lora_rows=Non
     return apply(
         params, config, tokens, lengths[:, None].astype(jnp.int32), cache,
         lora=lora, lora_rows=lora_rows,
+    )
+
+
+# -- paged-cache variants (engine serving path; see init_paged_cache) -------
+
+
+def prefill_paged(params, config, tokens, pool, page_table, start, last_idx, lora=None, lora_rows=None):
+    """Prefill [B, S] left-aligned token chunks at absolute offset
+    *start* [B] into the paged *pool* through *page_table* [B, max_pages].
+    Handles both whole-prompt prefill (start=0) and chunked continuation
+    (start>0, e.g. resuming after a shared-prefix hit). Returns (logits
+    [B, 1, V] at *last_idx* [B] within the chunk, pool)."""
+    B, S = tokens.shape
+    start = jnp.reshape(start, (-1,)).astype(jnp.int32)
+    pos = start[:, None] + jnp.arange(S, dtype=jnp.int32)[None, :]
+    return apply(
+        params, config, tokens, pos, pool,
+        logits_idx=jnp.reshape(last_idx, (-1,)).astype(jnp.int32),
+        lora=lora, lora_rows=lora_rows,
+        page_table=page_table,
+        # Flash prefill's plain-causal fast path needs positions ==
+        # arange(S), i.e. a cold start-0 prefill; chunked continuations
+        # carry real offsets. Callers split on that statically.
+        left_aligned=False,
+    )
+
+
+def prefill_paged_cold(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None):
+    """Whole-prompt paged prefill (positions arange(S)); eligible for the
+    flash-attention fast path. Returns (logits [B, 1, V] at lengths-1,
+    pool)."""
+    B, S = tokens.shape
+    pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+    return apply(
+        params, config, tokens, pos, pool,
+        logits_idx=jnp.reshape(lengths, (-1,)).astype(jnp.int32) - 1,
+        lora=lora, lora_rows=lora_rows,
+        page_table=page_table, left_aligned=True,
+    )
+
+
+def decode_step_paged(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None):
+    """One paged decode step for [B, 1] tokens at positions *lengths* [B].
+    Returns (logits [B, 1, V], pool)."""
+    return apply(
+        params, config, tokens, lengths[:, None].astype(jnp.int32), pool,
+        lora=lora, lora_rows=lora_rows, page_table=page_table,
     )

@@ -1,0 +1,59 @@
+"""Paged decode attention for TPU.
+
+Decode attention over the paged KV pool without materializing a
+gathered per-slot view: the Pallas kernel walks each sequence's block
+table and streams pages HBM->VMEM with double-buffered async copies, so
+KV bytes are read exactly once (the portable XLA path in
+models/llama.py gathers pages into a contiguous view first, costing a
+second pass over the KV bytes — acceptable on CPU tests, wasteful on a
+bandwidth-bound TPU decode step).
+
+Backed by JAX's library kernel
+(jax.experimental.pallas.ops.tpu.paged_attention); this wrapper adapts
+the engine's conventions: q scaling (the kernel computes raw qk),
+[B, 1, H, h] query shape, and a compute-block size that divides the
+table width. TPU-only — callers gate on backend (the kernel has no
+interpret path) and fall back to the gather view elsewhere.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def _compute_block(pages_per_sequence: int, want: int = 8) -> int:
+    """Largest divisor of pages_per_sequence that is <= want (the kernel
+    requires pages_per_sequence % pages_per_compute_block == 0)."""
+    for cand in range(min(want, pages_per_sequence), 0, -1):
+        if pages_per_sequence % cand == 0:
+            return cand
+    return 1
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, 1, H, h] single-token queries
+    k_pages: jnp.ndarray,  # [Kv, P, page, h]
+    v_pages: jnp.ndarray,  # [Kv, P, page, h]
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    kv_lengths: jnp.ndarray,  # [B] int32 — number of VALID kv tokens
+    scale: float | None = None,
+    softcap: float = 0.0,
+) -> jnp.ndarray:
+    """Returns [B, 1, H, h] attention output."""
+    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
+
+    B, S, H, h = q.shape
+    assert S == 1, "paged kernel is decode-only (S=1)"
+    if scale is None:
+        scale = h**-0.5
+    qk = (q[:, 0] * scale).astype(q.dtype)  # kernel computes raw q.k
+    out = paged_attention(
+        qk,
+        k_pages,
+        v_pages,
+        kv_lengths.astype(jnp.int32),
+        page_table.astype(jnp.int32),
+        pages_per_compute_block=_compute_block(page_table.shape[1]),
+        attn_logits_soft_cap=softcap if softcap > 0.0 else None,
+    )
+    return out[:, None].astype(q.dtype)

@@ -61,8 +61,16 @@ def llama_param_specs(config=None, fsdp: bool = False):
 
 
 def cache_specs():
-    """KV cache [L, B, S, Kv, h]: batch over dp, KV heads over tp."""
+    """Dense KV cache [L, B, S, Kv, h]: batch over dp, KV heads over tp."""
     return {"k": P(None, "dp", None, "tp", None), "v": P(None, "dp", None, "tp", None)}
+
+
+def paged_cache_specs():
+    """Paged KV pool [L, Kv, P, page, h]: KV heads over tp. Pages are NOT
+    sharded — every tp rank holds its head-shard of every page, so block
+    tables stay replicated host-state and page indices are rank-agnostic
+    (the same indirection the dense cache's batch dim had for free)."""
+    return {"k": P(None, "tp", None, None, None), "v": P(None, "tp", None, None, None)}
 
 
 def activation_spec():

@@ -1,0 +1,60 @@
+"""Engine pod-generator helpers + server-side model staging."""
+
+import pytest
+
+from kubeai_tpu.controller.engines.common import _mul_quantity
+
+
+def test_mul_quantity_identity():
+    assert _mul_quantity("4", 1) == "4"
+    assert _mul_quantity("junk", 1) == "junk"  # n==1 never parses
+
+
+@pytest.mark.parametrize(
+    "q,n,want",
+    [
+        ("2", 3, "6"),
+        ("500m", 2, "1000m"),
+        ("1Gi", 4, "4Gi"),
+        ("0.5Gi", 3, "1.5Gi"),
+        ("1.5G", 2, "3G"),
+        ("2Ti", 2, "4Ti"),
+        ("8Ei", 2, "16Ei"),
+        ("100k", 3, "300k"),
+        ("0.25", 8, "2"),
+    ],
+)
+def test_mul_quantity_values(q, n, want):
+    assert _mul_quantity(q, n) == want
+
+
+def test_mul_quantity_unparseable_raises():
+    with pytest.raises(ValueError):
+        _mul_quantity("abcGi", 2)
+
+
+def test_resolve_model_path_local_passthrough(tmp_path):
+    from kubeai_tpu.engine.server import _resolve_model_path
+
+    assert _resolve_model_path(str(tmp_path)) == str(tmp_path)
+    assert _resolve_model_path(f"file://{tmp_path}") == str(tmp_path)
+
+
+def test_resolve_model_path_stages_remote(monkeypatch, tmp_path):
+    """hf:// (and s3/gs/oss) sources must be staged to a local dir before
+    the weight loader sees them (ADVICE round 1: un-staged hf:// URLs
+    crashlooped every TPUEngine pod without a cacheProfile)."""
+    import kubeai_tpu.loader as loader
+    from kubeai_tpu.engine import server
+
+    calls = []
+    monkeypatch.setattr(loader, "load", lambda src, dest: calls.append((src, dest)))
+    monkeypatch.setenv("KUBEAI_MODEL_STAGING_DIR", str(tmp_path))
+
+    got = server._resolve_model_path("hf://org/model")
+    assert calls and calls[0][0] == "hf://org/model"
+    assert got == calls[0][1]
+    assert got.startswith(str(tmp_path))
+    # Same URL -> same staging dir; different URL -> different dir.
+    assert server._resolve_model_path("hf://org/model") == got
+    assert server._resolve_model_path("hf://org/other") != got

@@ -1,0 +1,121 @@
+"""Paged decode-attention kernel vs the portable gather path.
+
+Runs the TPU Pallas kernel under pltpu.force_tpu_interpret_mode() on
+CPU. The kernel computes with KV in bf16 (a no-op for the engine's real
+bf16 pools; see paged_attention_kernel's _maybe_dequantize), so the
+reference casts KV through bf16 too."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.experimental.pallas import tpu as pltpu
+
+from kubeai_tpu.ops.attention import attention
+from kubeai_tpu.ops.paged_attention import _compute_block, paged_decode_attention
+
+
+def test_compute_block_divides():
+    for mp in (1, 2, 3, 4, 6, 8, 16, 20):
+        cb = _compute_block(mp)
+        assert mp % cb == 0 and 1 <= cb <= 8
+
+
+@pytest.mark.parametrize(
+    "B,H,Kv,lens",
+    [
+        (1, 8, 2, [64]),          # full table, grouped heads
+        (2, 8, 2, [37, 52]),      # partial lengths, batch
+        (1, 16, 2, [41]),         # groups == 8 (non-reshape kernel path)
+        (2, 4, 4, [1, 64]),       # MHA-ish, extreme lengths
+    ],
+)
+def test_paged_kernel_matches_gather_path(B, H, Kv, lens):
+    h, P, ps, mp = 128, 1 + 8 * 4, 16, 4
+    rng = np.random.default_rng(0)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, h)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
+    table = jnp.asarray(
+        rng.choice(np.arange(1, P), size=(B, mp), replace=False).astype(np.int32)
+    )
+    kv_len = jnp.asarray(lens, jnp.int32)
+
+    # Reference: gather + masked dense attention, KV rounded through
+    # bf16 to match the kernel's internal compute dtype.
+    kb = kp.astype(jnp.bfloat16).astype(jnp.float32)
+    vb = vp.astype(jnp.bfloat16).astype(jnp.float32)
+    k_att = kb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
+    v_att = vb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
+    mask = jnp.arange(mp * ps)[None, None, :] < kv_len[:, None, None]
+    want = attention(q, k_att, v_att, mask)
+
+    with pltpu.force_tpu_interpret_mode():
+        got = paged_decode_attention(q, kp, vp, table, kv_len)
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-3
+    )
+
+
+def test_decode_step_paged_kernel_wiring():
+    """llama.decode_step_paged with use_paged_kernel=True must match the
+    gather path (validates the kv_lengths=pos+1 and scale plumbing in
+    apply(), not just the op)."""
+    from kubeai_tpu.models import llama
+    from kubeai_tpu.models.base import ModelConfig
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=256, intermediate_size=512,
+        num_layers=2, num_heads=2, num_kv_heads=1, head_dim=128,
+        dtype="float32", max_position=512,
+    )
+    params = llama.init_params(cfg, jax.random.key(0))
+    rng = np.random.default_rng(2)
+    B, ps, mp = 2, 16, 4
+    pool = llama.init_paged_cache(cfg, num_pages=1 + B * mp, page_size=ps)
+    table = jnp.asarray(
+        np.arange(1, 1 + B * mp, dtype=np.int32).reshape(B, mp)
+    )
+    lengths = jnp.asarray([3, 7], jnp.int32)
+    # Prefill some context first so decode attends over real KV.
+    toks = jnp.asarray(rng.integers(1, 200, (B, 16)), jnp.int32)
+    _, pool = llama.prefill_paged_cold(params, cfg, toks, pool, table, lengths)
+
+    step_tok = jnp.asarray(rng.integers(1, 200, (B, 1)), jnp.int32)
+    logits_ref, _ = llama.decode_step_paged(
+        params, cfg, step_tok, {k: v.copy() for k, v in pool.items()}, table, lengths
+    )
+    cfg_k = cfg.replace(use_paged_kernel=True)
+    with pltpu.force_tpu_interpret_mode():
+        logits_kern, _ = llama.decode_step_paged(
+            params, cfg_k, step_tok, pool, table, lengths
+        )
+    np.testing.assert_allclose(
+        np.asarray(logits_kern), np.asarray(logits_ref), rtol=5e-2, atol=5e-2
+    )
+
+
+def test_paged_kernel_applies_scale_and_softcap():
+    B, H, Kv, h, P, ps, mp = 1, 4, 2, 128, 9, 16, 4
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((B, 1, H, h)), jnp.float32)
+    kp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
+    vp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
+    table = jnp.asarray(np.arange(1, 5).reshape(B, mp).astype(np.int32))
+    kv_len = jnp.asarray([50], jnp.int32)
+
+    kb = kp.astype(jnp.bfloat16).astype(jnp.float32)
+    vb = vp.astype(jnp.bfloat16).astype(jnp.float32)
+    k_att = kb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
+    v_att = vb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
+    mask = jnp.arange(mp * ps)[None, None, :] < kv_len[:, None, None]
+    want = attention(q, k_att, v_att, mask, scale=0.25, softcap=30.0)
+
+    with pltpu.force_tpu_interpret_mode():
+        got = paged_decode_attention(
+            q, kp, vp, table, kv_len, scale=0.25, softcap=30.0
+        )
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-3
+    )

@@ -1,0 +1,125 @@
+"""PagePool allocator: refcounts, content addressing, LRU eviction.
+
+Host-side unit tests (no device) for the paged-KV bookkeeping that
+backs the engine's cross-slot prefix sharing (engine/paging.py)."""
+
+import pytest
+
+from kubeai_tpu.engine.paging import PagePool, pages_for
+
+
+def ids(n, start=0):
+    return list(range(start, start + n))
+
+
+def test_pages_for():
+    assert pages_for(0, 16) == 0
+    assert pages_for(1, 16) == 1
+    assert pages_for(16, 16) == 1
+    assert pages_for(17, 16) == 2
+
+
+def test_allocate_release_roundtrip():
+    pool = PagePool(num_pages=5, page_size=16)
+    assert pool.available() == 4
+    pages = pool.allocate(3)
+    assert len(set(pages)) == 3 and 0 not in pages
+    assert pool.available() == 1
+    pool.release(pages)
+    assert pool.available() == 4
+
+
+def test_allocate_over_capacity_raises():
+    pool = PagePool(num_pages=3, page_size=16)
+    with pytest.raises(RuntimeError):
+        pool.allocate(3)
+
+
+def test_match_claims_registered_chain():
+    pool = PagePool(num_pages=8, page_size=4)
+    prompt = ids(10)  # 2 full pages + partial
+    row = pool.allocate(3)
+    pool.register_chain(prompt, (0, 0), row)
+    # Same prompt, longer: both full pages hit.
+    hit = pool.match_prefix(ids(12), (0, 0))
+    assert hit == row[:2]
+    pool.release(hit)
+    # Different adapter signature: no hit.
+    assert pool.match_prefix(ids(12), (1, 0)) == []
+    # Diverging second page: only the first page hits.
+    div = ids(4) + ids(8, start=100)
+    assert pool.match_prefix(div, (0, 0)) == row[:1]
+
+
+def test_match_is_strictly_shorter_than_prompt():
+    """At least one token must remain to prefill (last-token logits)."""
+    pool = PagePool(num_pages=8, page_size=4)
+    prompt = ids(8)  # exactly 2 pages
+    row = pool.allocate(2)
+    pool.register_chain(prompt, (0, 0), row)
+    hit = pool.match_prefix(prompt, (0, 0))
+    assert hit == row[:1]  # second page NOT claimed
+
+
+def test_release_keeps_registered_pages_cached_for_future_hits():
+    pool = PagePool(num_pages=4, page_size=4)
+    row = pool.allocate(2)
+    pool.register_chain(ids(8), (0, 0), row)
+    pool.release(row)
+    assert pool.cached_pages() == 2
+    assert pool.available() == 3  # cached pages are still allocatable
+    hit = pool.match_prefix(ids(9), (0, 0))
+    assert hit == row
+    assert pool.cached_pages() == 0  # claimed back out of the cached set
+
+
+def test_eviction_lru_order_and_unregistration():
+    pool = PagePool(num_pages=3, page_size=4)  # 2 usable pages
+    a = pool.allocate(1)
+    pool.register_chain(ids(4), (0, 0), a)
+    pool.release(a)
+    b = pool.allocate(1)
+    pool.register_chain(ids(4, start=50), (0, 0), b)
+    pool.release(b)
+    # Free list empty, both cached; allocating must evict `a` (LRU).
+    c = pool.allocate(1)
+    assert c == a
+    assert pool.match_prefix(ids(5), (0, 0)) == []  # a's content gone
+    assert pool.match_prefix(ids(5, start=50), (0, 0)) == b  # b survives
+
+
+def test_shared_refcount_across_claims():
+    pool = PagePool(num_pages=4, page_size=4)
+    row = pool.allocate(1)
+    pool.register_chain(ids(4), (0, 0), row)
+    h1 = pool.match_prefix(ids(6), (0, 0))
+    h2 = pool.match_prefix(ids(6), (0, 0))
+    assert h1 == h2 == row  # ref = 3
+    pool.release(row)
+    pool.release(h1)
+    assert pool.cached_pages() == 0  # still referenced by h2
+    pool.release(h2)
+    assert pool.cached_pages() == 1
+
+
+def test_duplicate_registration_keeps_first_mapping():
+    pool = PagePool(num_pages=4, page_size=4)
+    r1 = pool.allocate(1)
+    r2 = pool.allocate(1)
+    pool.register_chain(ids(4), (0, 0), r1)
+    pool.register_chain(ids(4), (0, 0), r2)  # same content, different page
+    hit = pool.match_prefix(ids(5), (0, 0))
+    assert hit == r1
+    pool.release(hit)
+    pool.release(r1)
+    pool.release(r2)
+    # r2 was never registered -> back on the free list, not cached.
+    assert pool.cached_pages() == 1
+
+
+def test_double_release_asserts():
+    pool = PagePool(num_pages=3, page_size=4)
+    row = pool.allocate(1)
+    pool.release(row)
+    with pytest.raises(AssertionError):
+        pool.release(row)

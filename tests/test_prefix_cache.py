@@ -1,5 +1,8 @@
-"""Slot prefix caching: reuse must never change results, must actually
-skip work, and must respect adapter identity."""
+"""Cross-slot prefix caching over the paged KV pool: reuse must never
+change results, must actually skip work, must respect adapter identity,
+and must work across slots (the round-2 upgrade over slot-local reuse)."""
+
+import time
 
 import numpy as np
 import pytest
@@ -16,14 +19,16 @@ CFG = ModelConfig(
     vocab_size=272, hidden_size=64, intermediate_size=128, num_layers=2,
     num_heads=4, num_kv_heads=2, dtype="float32", max_position=1024,
 )
+PS = 16  # page size used throughout; reuse is page-granular
 
 
-def mk_engine(prefix_cache_min=16, seed=11):
+def mk_engine(prefix_cache_min=16, seed=11, max_slots=2, num_pages=0, max_seq_len=256):
     params = llama.init_params(CFG, jax.random.key(seed))
     eng = Engine(
         CFG, params, ByteTokenizer(),
         EngineConfig(
-            max_slots=2, max_seq_len=256, prefill_buckets=(32, 64, 128),
+            max_slots=max_slots, max_seq_len=max_seq_len,
+            prefill_buckets=(32, 64, 128), page_size=PS, num_pages=num_pages,
             prefix_cache_min=prefix_cache_min,
         ),
     )
@@ -40,10 +45,15 @@ def engines():
     uncached.stop()
 
 
+def full_pages_tokens(n: int) -> int:
+    """Tokens covered by the full pages of an n-token written history."""
+    return (n // PS) * PS
+
+
 def test_multi_turn_reuses_and_matches(engines):
     """Turn 2 extends turn 1's conversation: the cached engine must reuse
-    the resident prefix AND produce byte-identical greedy output to the
-    uncached engine."""
+    the resident prefix pages AND produce byte-identical greedy output to
+    the uncached engine."""
     cached, uncached = engines
     rng = np.random.default_rng(0)
     turn1 = rng.integers(1, 200, 64).tolist()
@@ -60,9 +70,9 @@ def test_multi_turn_reuses_and_matches(engines):
     out2_u = uncached.generate(turn2, p)
     assert out2_c[0] == out2_u[0]
     reused = cached.m_prefix_cached.value() - before
-    # The reply region must reuse too (KV history tracks written INPUT
-    # tokens — a one-off shift there would break exactly this assertion).
-    want = len(turn1) + len(out1_c[0]) - 2
+    # The reply region's pages register at free from the written history
+    # (prompt + all but the last generated token); reuse is page-granular.
+    want = full_pages_tokens(len(turn1) + len(out1_c[0]) - 1)
     assert reused >= want, f"expected >= {want} reused, got {reused}"
 
 
@@ -74,17 +84,49 @@ def test_identical_prompt_reuse_matches(engines):
     before = cached.m_prefix_cached.value()
     second = cached.generate(prompt, p)
     assert second[0] == first[0] == uncached.generate(prompt, p)[0]
-    assert cached.m_prefix_cached.value() > before
+    # Identical prompt: all full pages hit, minus the strict-shorter
+    # clamp (the last token must be prefilled for logits).
+    assert cached.m_prefix_cached.value() - before == ((48 - 1) // PS) * PS
+
+
+def test_cross_slot_concurrent_share(engines):
+    """Two same-prefix requests IN FLIGHT TOGETHER share prefix pages:
+    the second claims pages the first registered at admission — the
+    scenario slot-local caching could never serve."""
+    cached, uncached = engines
+    prompt = np.random.default_rng(7).integers(1, 200, 64).tolist()
+    p = SamplingParams(temperature=0.0, max_tokens=8)
+    before = cached.m_prefix_cached.value()
+    r1 = cached.submit(list(prompt), p)
+    r2 = cached.submit(list(prompt), p)
+
+    def drain(r):
+        toks = []
+        while True:
+            ev = r.out.get(timeout=120)
+            if ev[0] == "token":
+                if ev[1] >= 0:
+                    toks.append(ev[1])
+            elif ev[0] == "done":
+                return toks
+            else:
+                raise RuntimeError(ev[1])
+
+    t1, t2 = drain(r1), drain(r2)
+    want = uncached.generate(prompt, p)[0]
+    assert t1 == want and t2 == want
+    # The second request must have claimed the first's prompt pages.
+    assert cached.m_prefix_cached.value() - before >= ((64 - 1) // PS) * PS
 
 
 def test_divergent_prompt_not_poisoned(engines):
     """A prompt diverging early must not inherit the other conversation's
-    KV (correctness of the common-prefix computation)."""
+    KV (content addressing is exact)."""
     cached, uncached = engines
     rng = np.random.default_rng(2)
     a = rng.integers(1, 200, 40).tolist()
     b = list(a)
-    b[4] = (b[4] + 1) % 199 + 1  # diverge at token 4 (< prefix_cache_min)
+    b[4] = (b[4] + 1) % 199 + 1  # diverge inside the first page
     p = SamplingParams(temperature=0.0, max_tokens=6)
     cached.generate(a, p)
     out_b_c = cached.generate(b, p)
@@ -96,12 +138,91 @@ def test_short_common_prefix_not_reused(engines):
     cached, _ = engines
     rng = np.random.default_rng(3)
     a = rng.integers(1, 200, 20).tolist()
-    b = a[:8] + rng.integers(1, 200, 12).tolist()  # only 8 common < min 16
+    b = a[:8] + rng.integers(1, 200, 12).tolist()  # diverge mid-page
     p = SamplingParams(temperature=0.0, max_tokens=4)
     cached.generate(a, p)
     before = cached.m_prefix_cached.value()
     cached.generate(b, p)
     assert cached.m_prefix_cached.value() == before
+
+
+def test_page_accounting_after_free(engines):
+    """Freed sequences return pages: used drops to 0 (cached pages are
+    free-but-content-resident, not used)."""
+    cached, _ = engines
+    prompt = np.random.default_rng(9).integers(1, 200, 20).tolist()
+    cached.generate(prompt, SamplingParams(temperature=0.0, max_tokens=4))
+    assert cached._pool.used() == 0
+    assert cached._pool.cached_pages() > 0
+
+
+def test_pool_backpressure_defers_then_completes():
+    """A request that fits a slot but not the KV pool waits (strict FIFO)
+    and completes once pages free up — never errors, never corrupts."""
+    # 8 usable pages of 16 = 128 tokens; each request needs
+    # pages_for(48 + 64) = 7 pages, so two can't fly together.
+    eng = mk_engine(prefix_cache_min=0, num_pages=9, max_seq_len=128)
+    ref = mk_engine(prefix_cache_min=0, num_pages=0, max_seq_len=128)
+    try:
+        rng = np.random.default_rng(4)
+        a = rng.integers(1, 200, 48).tolist()
+        b = rng.integers(1, 200, 48).tolist()
+        p = SamplingParams(temperature=0.0, max_tokens=64)
+        ra, rb = eng.submit(a, p), eng.submit(b, p)
+
+        def drain(r):
+            toks = []
+            while True:
+                ev = r.out.get(timeout=180)
+                if ev[0] == "token":
+                    if ev[1] >= 0:
+                        toks.append(ev[1])
+                elif ev[0] == "done":
+                    return toks
+                else:
+                    raise RuntimeError(ev[1])
+
+        ta, tb = drain(ra), drain(rb)
+        assert ta == ref.generate(a, p)[0]
+        assert tb == ref.generate(b, p)[0]
+        assert eng._pool.used() == 0
+    finally:
+        eng.stop()
+        ref.stop()
+
+
+def test_failed_prefill_unregisters_planned_pages():
+    """A prefill that fails after plan-time registration must unregister
+    those pages — otherwise a later same-prefix request would reuse
+    never-written (all-zero) KV (round-2 review regression)."""
+    eng = mk_engine(prefix_cache_min=16)
+    try:
+        prompt = np.random.default_rng(11).integers(1, 200, 48).tolist()
+        p = SamplingParams(temperature=0.0, max_tokens=4)
+
+        real = eng._prefill_jit
+
+        def boom(*a, **k):
+            raise RuntimeError("injected prefill failure")
+
+        eng._prefill_jit = boom
+        r = eng.submit(list(prompt), p)
+        ev = r.out.get(timeout=60)
+        assert ev[0] == "error" and "prefill failed" in ev[1]
+        # Wait for the scheduler to settle, then check no residue.
+        time.sleep(0.2)
+        assert eng._pool.match_prefix(list(prompt) + [1], (0, 0)) == []
+        assert eng._pool.used() == 0
+
+        # Restore and confirm the same prompt now runs cold + correctly.
+        eng._prefill_jit = real
+        ref = mk_engine(prefix_cache_min=0, seed=11)
+        try:
+            assert eng.generate(prompt, p)[0] == ref.generate(prompt, p)[0]
+        finally:
+            ref.stop()
+    finally:
+        eng.stop()
 
 
 def test_adapter_row_recycling_does_not_alias(tmp_path):
