@@ -687,10 +687,16 @@ class Engine:
         )
         ids = req.prompt_ids
         ps = self.cfg.page_size
+        # Clamp the decode budget to BOTH the position space and the
+        # whole pool's capacity: without the pool clamp, a request whose
+        # prompt+budget exceeds the pool (shrunk --kv-pages) would defer
+        # forever and head-of-line-block all admission.
+        usable_tokens = (self._pool.num_pages - 1) * ps
         budget = max(
             min(
                 req.params.max_tokens or self.cfg.default_max_tokens,
                 self.cfg.max_seq_len - len(ids) - 1,
+                usable_tokens - len(ids),
             ),
             0,
         )

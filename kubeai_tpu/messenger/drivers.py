@@ -9,9 +9,13 @@ with two built-in drivers:
                     reference integration tests' mem:// driver)
     file://<dir>    spool-directory queues (cross-process on one host)
 
-Cloud drivers (gcppubsub://, kafka://, ...) register via
-`register_driver` — deployments bring their client library; the scheme
-registry keeps them out of the core's import path.
+Cloud drivers ship in-repo and load lazily on first use of their
+scheme (keeping them out of the core's import path):
+
+    gcppubsub://projects/P/{topics/T,subscriptions/S}   (gcp_pubsub.py)
+    kafka://TOPIC  /  kafka://GROUP?topic=TOPIC          (kafka_driver.py)
+
+Additional schemes register via `register_driver`.
 """
 
 from __future__ import annotations
@@ -167,18 +171,41 @@ def _split(url: str) -> tuple[str, str]:
         if not ref:
             raise ValueError(f"file:// pubsub url needs a directory: {url!r}")
         return "file", ref
-    return parsed.scheme, (parsed.netloc + parsed.path).rstrip("/")
+    ref = (parsed.netloc + parsed.path).rstrip("/")
+    if parsed.query:
+        # kafka://GROUP?topic=T carries the topic in the query string.
+        ref = f"{ref}?{parsed.query}"
+    return parsed.scheme, ref
+
+
+def _load_cloud_driver(scheme: str) -> None:
+    """Lazy registration of the in-repo cloud drivers."""
+    if scheme == "gcppubsub":
+        from kubeai_tpu.messenger.gcp_pubsub import (
+            GcpPubSubSubscription,
+            GcpPubSubTopic,
+        )
+
+        register_driver("gcppubsub", GcpPubSubTopic, GcpPubSubSubscription)
+    elif scheme == "kafka":
+        from kubeai_tpu.messenger.kafka_driver import KafkaSubscription, KafkaTopic
+
+        register_driver("kafka", KafkaTopic, KafkaSubscription)
+
+
+def _driver(scheme: str) -> tuple:
+    if scheme not in _DRIVERS:
+        _load_cloud_driver(scheme)
+    if scheme not in _DRIVERS:
+        raise ValueError(f"no pubsub driver for scheme {scheme!r}")
+    return _DRIVERS[scheme]
 
 
 def open_topic(url: str) -> Topic:
     scheme, ref = _split(url)
-    if scheme not in _DRIVERS:
-        raise ValueError(f"no pubsub driver for scheme {scheme!r}")
-    return _DRIVERS[scheme][0](ref)
+    return _driver(scheme)[0](ref)
 
 
 def open_subscription(url: str) -> Subscription:
     scheme, ref = _split(url)
-    if scheme not in _DRIVERS:
-        raise ValueError(f"no pubsub driver for scheme {scheme!r}")
-    return _DRIVERS[scheme][1](ref)
+    return _driver(scheme)[1](ref)

@@ -409,8 +409,14 @@ def apply(
             # each row's pages into a contiguous [B, Skv, Kv, h] view.
             k_full = k_cache_l.at[:, w_pages, w_offs].set(k.transpose(2, 0, 1, 3))
             v_full = v_cache_l.at[:, w_pages, w_offs].set(v.transpose(2, 0, 1, 3))
-            if use_paged_kernel:
-                k_att = v_att = None  # kernel reads pages directly
+            if use_paged_kernel or use_flash:
+                # Neither path reads the gathered view: the decode kernel
+                # walks pages in place, and flash prefill (left-aligned,
+                # positions arange(S)) attends exactly the just-computed
+                # k/v — gathering the full table width only to slice S
+                # columns would move max_pages*page/S times the needed
+                # KV bytes per layer.
+                k_att = v_att = None
             else:
                 k_att = k_full[:, page_table].transpose(1, 2, 3, 0, 4).reshape(B, skv, Kv, h)
                 v_att = v_full[:, page_table].transpose(1, 2, 3, 0, 4).reshape(B, skv, Kv, h)
@@ -435,12 +441,14 @@ def apply(
                 softcap=config.attn_softcap,
             )
         elif use_flash:
-            # Prefill positions are arange(S): plain causal over the first
-            # S cache columns == the position-derived mask.
+            # Prefill positions are arange(S): the cache columns 0..S-1
+            # were just written with exactly k/v, so plain causal over
+            # the fresh tensors == the position-derived mask over the
+            # cache — no cache read needed.
             from kubeai_tpu.ops.flash_attention import flash_attention_tpu
 
             attn_out = flash_attention_tpu(
-                q, k_att[:, :S], v_att[:, :S], causal=True, sm_scale=config.query_scale,
+                q, k, v, causal=True, sm_scale=config.query_scale,
                 interpret=jax.default_backend() != "tpu",
             )
         else:

@@ -1,0 +1,133 @@
+"""In-process fake Kafka broker for driver integration tests.
+
+Speaks the same pinned wire-protocol versions the driver uses
+(kafka_proto.py): Metadata v1, Produce v3, Fetch v4 (with real
+long-polling), FindCoordinator v1, OffsetCommit v2, OffsetFetch v3.
+Single node, every topic has one partition (0), topics auto-create.
+"""
+
+from __future__ import annotations
+
+import socket
+import socketserver
+import struct
+import threading
+import time
+
+from kubeai_tpu.messenger import kafka_proto as kp
+
+
+class FakeKafkaBroker:
+    def __init__(self):
+        self.logs: dict[str, list[tuple[bytes | None, bytes]]] = {}
+        self.committed: dict[tuple[str, str, int], int] = {}
+        self.lock = threading.Lock()
+        self.data_ready = threading.Condition(self.lock)
+        self.produce_errors = 0  # inject N produce failures
+        broker = self
+
+        class Handler(socketserver.BaseRequestHandler):
+            def handle(self):
+                sock: socket.socket = self.request
+                try:
+                    while True:
+                        head = self._read_n(sock, 4)
+                        if head is None:
+                            return
+                        size = struct.unpack(">i", head)[0]
+                        payload = self._read_n(sock, size)
+                        if payload is None:
+                            return
+                        r = kp.Reader(payload)
+                        api, version, corr, _client = kp.decode_request_header(r)
+                        body = broker.dispatch(api, version, r)
+                        sock.sendall(kp.encode_response(corr, body))
+                except (ConnectionError, OSError):
+                    return
+
+            @staticmethod
+            def _read_n(sock, n):
+                chunks = []
+                while n:
+                    try:
+                        c = sock.recv(n)
+                    except OSError:
+                        return None
+                    if not c:
+                        return None
+                    chunks.append(c)
+                    n -= len(c)
+                return b"".join(chunks)
+
+        class Server(socketserver.ThreadingTCPServer):
+            allow_reuse_address = True
+            daemon_threads = True
+
+        self.server = Server(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        self._thread = threading.Thread(target=self.server.serve_forever, daemon=True)
+        self._thread.start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    # -- API dispatch ------------------------------------------------------
+
+    def dispatch(self, api: int, version: int, r: kp.Reader) -> bytes:
+        if api == kp.API_METADATA:
+            topics = kp.decode_metadata_request_v1(r)
+            with self.lock:
+                names = list(self.logs) if topics is None else topics
+            return kp.encode_metadata_response_v1(
+                [kp.BrokerMeta(0, "127.0.0.1", self.port)],
+                0,
+                [
+                    kp.TopicMeta(name, [kp.PartitionMeta(0, 0)])
+                    for name in names
+                ],
+            )
+        if api == kp.API_PRODUCE:
+            topic, partition, record_set = kp.decode_produce_request_v3(r)
+            with self.lock:
+                if self.produce_errors > 0:
+                    self.produce_errors -= 1
+                    return kp.encode_produce_response_v3(topic, partition, 7, -1)
+                log = self.logs.setdefault(topic, [])
+                base = len(log)
+                for rec in kp.decode_record_batches(record_set):
+                    log.append((rec.key, rec.value))
+                self.data_ready.notify_all()
+            return kp.encode_produce_response_v3(topic, partition, 0, base)
+        if api == kp.API_FETCH:
+            topic, partition, offset, max_wait = kp.decode_fetch_request_v4(r)
+            deadline = time.monotonic() + max_wait / 1000
+            with self.lock:
+                while True:
+                    log = self.logs.setdefault(topic, [])
+                    if offset < len(log):
+                        records = log[offset : offset + 64]
+                        record_set = kp.encode_record_batch(offset, records)
+                        return kp.encode_fetch_response_v4(
+                            topic, partition, 0, len(log), record_set
+                        )
+                    remaining = deadline - time.monotonic()
+                    if remaining <= 0:
+                        return kp.encode_fetch_response_v4(
+                            topic, partition, 0, len(log), b""
+                        )
+                    self.data_ready.wait(timeout=remaining)
+        if api == kp.API_FIND_COORDINATOR:
+            kp.decode_find_coordinator_request_v1(r)
+            return kp.encode_find_coordinator_response_v1(0, "127.0.0.1", self.port)
+        if api == kp.API_OFFSET_COMMIT:
+            group, topic, partition, offset = kp.decode_offset_commit_request_v2(r)
+            with self.lock:
+                self.committed[(group, topic, partition)] = offset
+            return kp.encode_offset_commit_response_v2(topic, partition)
+        if api == kp.API_OFFSET_FETCH:
+            group, topic, partition = kp.decode_offset_fetch_request_v3(r)
+            with self.lock:
+                offset = self.committed.get((group, topic, partition), -1)
+            return kp.encode_offset_fetch_response_v3(topic, partition, offset)
+        raise ValueError(f"fake broker: unsupported api {api} v{version}")

@@ -1,0 +1,135 @@
+"""In-process fake Pub/Sub REST server (the emulator surface the
+gcppubsub:// driver talks to): publish, pull, acknowledge,
+modifyAckDeadline, with real ack-deadline redelivery."""
+
+from __future__ import annotations
+
+import base64
+import json
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+class FakePubSub:
+    def __init__(self, ack_deadline: float = 10.0):
+        self.ack_deadline = ack_deadline
+        self.lock = threading.Lock()
+        self.topic_subs: dict[str, list[str]] = {}  # topic -> subscriptions
+        self.queues: dict[str, list[bytes]] = {}
+        # sub -> ack_id -> (body, redelivery_deadline)
+        self.outstanding: dict[str, dict[str, tuple[bytes, float]]] = {}
+        self.publish_errors = 0  # inject N publish failures
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                length = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(length) or b"{}")
+                path = self.path  # /v1/projects/P/<kind>/<name>:<verb>
+                try:
+                    resource, _, verb = path[len("/v1/") :].rpartition(":")
+                    out = fake.handle(resource, verb, payload)
+                except KeyError as e:
+                    self.send_response(404)
+                    self.end_headers()
+                    self.wfile.write(json.dumps({"error": str(e)}).encode())
+                    return
+                except RuntimeError as e:
+                    self.send_response(503)
+                    self.end_headers()
+                    self.wfile.write(json.dumps({"error": str(e)}).encode())
+                    return
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self.server.server_address[1]
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    def create(self, topic: str, subscription: str):
+        """topic/subscription refs like projects/p/topics/t."""
+        with self.lock:
+            self.topic_subs.setdefault(topic, []).append(subscription)
+            self.queues.setdefault(subscription, [])
+            self.outstanding.setdefault(subscription, {})
+
+    # -- REST surface ------------------------------------------------------
+
+    def handle(self, resource: str, verb: str, payload: dict) -> dict:
+        with self.lock:
+            if verb == "publish":
+                if self.publish_errors > 0:
+                    self.publish_errors -= 1
+                    raise RuntimeError("injected publish failure")
+                subs = self.topic_subs.get(resource)
+                if subs is None:
+                    raise KeyError(f"topic {resource} not found")
+                ids = []
+                for m in payload.get("messages", []):
+                    body = base64.b64decode(m.get("data") or "")
+                    for sub in subs:
+                        self.queues[sub].append(body)
+                    ids.append(uuid.uuid4().hex)
+                return {"messageIds": ids}
+
+            if resource not in self.queues:
+                raise KeyError(f"subscription {resource} not found")
+            q = self.queues[resource]
+            out = self.outstanding[resource]
+
+            if verb == "pull":
+                # Redeliver expired outstanding messages first.
+                now = time.monotonic()
+                for ack_id, (body, deadline) in list(out.items()):
+                    if deadline <= now:
+                        del out[ack_id]
+                        q.insert(0, body)
+                n = int(payload.get("maxMessages") or 1)
+                received = []
+                while q and len(received) < n:
+                    body = q.pop(0)
+                    ack_id = uuid.uuid4().hex
+                    out[ack_id] = (body, now + self.ack_deadline)
+                    received.append(
+                        {
+                            "ackId": ack_id,
+                            "message": {
+                                "data": base64.b64encode(body).decode(),
+                                "messageId": uuid.uuid4().hex,
+                            },
+                        }
+                    )
+                return {"receivedMessages": received} if received else {}
+
+            if verb == "acknowledge":
+                for ack_id in payload.get("ackIds", []):
+                    out.pop(ack_id, None)
+                return {}
+
+            if verb == "modifyAckDeadline":
+                secs = float(payload.get("ackDeadlineSeconds") or 0)
+                now = time.monotonic()
+                for ack_id in payload.get("ackIds", []):
+                    if ack_id in out:
+                        body, _ = out[ack_id]
+                        if secs <= 0:
+                            del out[ack_id]
+                            q.insert(0, body)  # immediate redelivery
+                        else:
+                            out[ack_id] = (body, now + secs)
+                return {}
+
+        raise KeyError(f"unsupported verb {verb}")

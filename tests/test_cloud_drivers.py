@@ -1,0 +1,303 @@
+"""Cloud pub/sub drivers (gcppubsub://, kafka://) against in-repo fakes:
+publish/receive round-trip, Ack/Nack redelivery, crash-redelivery via
+committed offsets, injected-failure backoff, and the full messenger
+pipeline end-to-end over each bus (ref: internal/messenger tests +
+VERDICT r1 item 3)."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from kubeai_tpu.messenger import kafka_proto as kp
+from kubeai_tpu.messenger.drivers import open_subscription, open_topic
+from tests.kafka_fake import FakeKafkaBroker
+from tests.pubsub_fake import FakePubSub
+
+
+# -- kafka wire codec golden bytes ------------------------------------------
+
+
+def test_request_header_golden_bytes():
+    """Header layout pinned to the public spec: api_key int16,
+    api_version int16, correlation_id int32, client_id STRING."""
+    frame = kp.encode_request(3, 1, 7, "ab", b"XY")
+    assert frame == (
+        b"\x00\x00\x00\x0e"  # size = 14 (2+2+4+2+2 header + 2 body)
+        b"\x00\x03" b"\x00\x01" b"\x00\x00\x00\x07" b"\x00\x02ab" b"XY"
+    )
+
+
+def test_record_batch_golden_header_and_roundtrip():
+    batch = kp.encode_record_batch(5, [(b"k", b"hello"), (None, b"x")])
+    # baseOffset, batchLength, partitionLeaderEpoch(-1), magic=2
+    assert batch[:8] == b"\x00\x00\x00\x00\x00\x00\x00\x05"
+    assert batch[12:16] == b"\xff\xff\xff\xff"
+    assert batch[16] == 2
+    recs = kp.decode_record_batches(batch)
+    assert [(r.offset, r.key, r.value) for r in recs] == [
+        (5, b"k", b"hello"),
+        (6, None, b"x"),
+    ]
+
+
+def test_record_batch_crc_detects_corruption():
+    batch = bytearray(kp.encode_record_batch(0, [(None, b"payload")]))
+    batch[-1] ^= 0xFF
+    with pytest.raises(ValueError, match="crc"):
+        kp.decode_record_batches(bytes(batch))
+
+
+def test_varint_zigzag_roundtrip():
+    for v in (0, 1, -1, 63, 64, -64, -65, 300, -300, 2**31):
+        w = kp.Writer().varint(v)
+        assert kp.Reader(w.build()).varint() == v
+
+
+# -- kafka driver -----------------------------------------------------------
+
+
+@pytest.fixture()
+def kafka(monkeypatch):
+    broker = FakeKafkaBroker()
+    monkeypatch.setenv("KAFKA_BROKERS", f"127.0.0.1:{broker.port}")
+    yield broker
+    broker.close()
+
+
+def test_kafka_roundtrip_and_commit(kafka):
+    topic = open_topic("kafka://reqs")
+    sub = open_subscription("kafka://g1?topic=reqs")
+    topic.send(b"m1")
+    topic.send(b"m2")
+    a = sub.receive(timeout=5)
+    b = sub.receive(timeout=5)
+    assert (a.body, b.body) == (b"m1", b"m2")
+    a.ack()
+    b.ack()
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline:
+        if kafka.committed.get(("g1", "reqs", 0)) == 2:
+            break
+        time.sleep(0.01)
+    assert kafka.committed[("g1", "reqs", 0)] == 2
+    assert sub.receive(timeout=0.3) is None
+    sub.close()
+    topic.close()
+
+
+def test_kafka_nack_redelivers(kafka):
+    topic = open_topic("kafka://reqs")
+    sub = open_subscription("kafka://g1?topic=reqs")
+    topic.send(b"flaky")
+    m = sub.receive(timeout=5)
+    m.nack()
+    again = sub.receive(timeout=5)
+    assert again.body == b"flaky"
+    again.ack()
+    sub.close()
+    topic.close()
+
+
+def test_kafka_unacked_blocks_commit_and_redelivers_on_restart(kafka):
+    """Out-of-order acks commit only the contiguous prefix, so a crashed
+    consumer re-receives the unacked message (at-least-once)."""
+    topic = open_topic("kafka://reqs")
+    sub = open_subscription("kafka://g1?topic=reqs")
+    topic.send(b"m0")
+    topic.send(b"m1")
+    m0 = sub.receive(timeout=5)
+    m1 = sub.receive(timeout=5)
+    m1.ack()  # ack out of order; m0 unacked blocks the watermark
+    time.sleep(0.1)
+    assert kafka.committed.get(("g1", "reqs", 0)) is None
+    sub.close()  # crash
+
+    sub2 = open_subscription("kafka://g1?topic=reqs")
+    r0 = sub2.receive(timeout=5)
+    r1 = sub2.receive(timeout=5)
+    assert (r0.body, r1.body) == (b"m0", b"m1")  # both redelivered
+    r0.ack()
+    r1.ack()
+    deadline = time.monotonic() + 2
+    while time.monotonic() < deadline and kafka.committed.get(("g1", "reqs", 0)) != 2:
+        time.sleep(0.01)
+    assert kafka.committed[("g1", "reqs", 0)] == 2
+    sub2.close()
+    topic.close()
+
+
+def test_kafka_produce_error_raises(kafka):
+    topic = open_topic("kafka://reqs")
+    kafka.produce_errors = 1
+    with pytest.raises(RuntimeError, match="produce error"):
+        topic.send(b"x")
+    topic.send(b"ok")  # recovered
+    topic.close()
+
+
+def test_kafka_groups_are_independent(kafka):
+    topic = open_topic("kafka://reqs")
+    topic.send(b"fanout")
+    s1 = open_subscription("kafka://g1?topic=reqs")
+    s2 = open_subscription("kafka://g2?topic=reqs")
+    assert s1.receive(timeout=5).body == b"fanout"
+    assert s2.receive(timeout=5).body == b"fanout"
+    s1.close()
+    s2.close()
+    topic.close()
+
+
+# -- gcppubsub driver --------------------------------------------------------
+
+
+@pytest.fixture()
+def pubsub(monkeypatch):
+    fake = FakePubSub(ack_deadline=1.0)
+    fake.create("projects/p/topics/reqs", "projects/p/subscriptions/reqs")
+    monkeypatch.setenv("PUBSUB_EMULATOR_HOST", f"127.0.0.1:{fake.port}")
+    yield fake
+    fake.close()
+
+
+def test_pubsub_roundtrip_ack(pubsub):
+    topic = open_topic("gcppubsub://projects/p/topics/reqs")
+    sub = open_subscription("gcppubsub://projects/p/subscriptions/reqs")
+    topic.send(b"hello")
+    m = sub.receive(timeout=5)
+    assert m.body == b"hello"
+    m.ack()
+    assert sub.receive(timeout=0.3) is None
+
+
+def test_pubsub_nack_redelivers_immediately(pubsub):
+    topic = open_topic("gcppubsub://projects/p/topics/reqs")
+    sub = open_subscription("gcppubsub://projects/p/subscriptions/reqs")
+    topic.send(b"retry-me")
+    m = sub.receive(timeout=5)
+    m.nack()
+    again = sub.receive(timeout=5)
+    assert again.body == b"retry-me"
+    again.ack()
+
+
+def test_pubsub_deadline_expiry_redelivers(pubsub):
+    """An unacked message comes back after the ack deadline (the crash-
+    consumer case)."""
+    topic = open_topic("gcppubsub://projects/p/topics/reqs")
+    sub = open_subscription("gcppubsub://projects/p/subscriptions/reqs")
+    topic.send(b"lost")
+    m = sub.receive(timeout=5)
+    assert m.body == b"lost"
+    # No ack; deadline is 1s in this fixture.
+    time.sleep(1.1)
+    again = sub.receive(timeout=5)
+    assert again.body == b"lost"
+    again.ack()
+
+
+def test_pubsub_publish_error_raises(pubsub):
+    topic = open_topic("gcppubsub://projects/p/topics/reqs")
+    pubsub.publish_errors = 1
+    with pytest.raises(RuntimeError, match="503"):
+        topic.send(b"x")
+    topic.send(b"ok")
+
+
+def test_pubsub_bad_urls_rejected():
+    with pytest.raises(ValueError):
+        open_topic("gcppubsub://projects/p/subscriptions/wrongkind")
+    with pytest.raises(ValueError):
+        open_subscription("gcppubsub://projects/p/topics/wrongkind")
+    with pytest.raises(ValueError):
+        open_subscription("kafka://group-without-topic")
+
+
+# -- full messenger pipeline over each bus -----------------------------------
+
+
+class _Stack:
+    """Minimal model_client + lb + backend for the messenger pipeline
+    (same seams as tests/test_messenger.py)."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Backend(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                req = json.loads(self.rfile.read(n))
+                body = json.dumps({"echo": req.get("prompt")}).encode()
+                self.send_response(200)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self.server = ThreadingHTTPServer(("127.0.0.1", 0), Backend)
+        threading.Thread(target=self.server.serve_forever, daemon=True).start()
+        self.addr = f"127.0.0.1:{self.server.server_address[1]}"
+
+    def close(self):
+        self.server.shutdown()
+        self.server.server_close()
+
+    # model_client surface
+    def lookup_model(self, name, adapter, selectors):
+        from kubeai_tpu.api.model_types import Model, ModelSpec, ObjectMeta
+
+        return Model(meta=ObjectMeta(name=name), spec=ModelSpec(url="hf://x/y"))
+
+    def scale_at_least_one_replica(self, model):
+        pass
+
+    # lb surface
+    def await_best_address(self, req, timeout=None):
+        return self.addr, lambda: None
+
+
+@pytest.mark.parametrize("bus", ["kafka", "pubsub"])
+def test_messenger_pipeline_over_cloud_bus(bus, request):
+    fake = request.getfixturevalue(bus)  # noqa: F841 (env setup)
+    if bus == "kafka":
+        requests_url = "kafka://m-reqs?topic=m-reqs"
+        responses_url = "kafka://m-resps-topic"
+        # Topic and subscription refs differ for kafka: create the
+        # request topic by publishing through it below.
+        req_topic_url = "kafka://m-reqs"
+        resp_sub_url = "kafka://resp-reader?topic=m-resps-topic"
+    else:
+        fake.create("projects/p/topics/m-reqs", "projects/p/subscriptions/m-reqs")
+        fake.create("projects/p/topics/m-resps", "projects/p/subscriptions/m-resps")
+        requests_url = "gcppubsub://projects/p/subscriptions/m-reqs"
+        responses_url = "gcppubsub://projects/p/topics/m-resps"
+        req_topic_url = "gcppubsub://projects/p/topics/m-reqs"
+        resp_sub_url = "gcppubsub://projects/p/subscriptions/m-resps"
+
+    from kubeai_tpu.messenger.messenger import Messenger
+
+    stack = _Stack()
+    msgr = Messenger(requests_url, responses_url, stack, stack)
+    msgr.start()
+    try:
+        req_topic = open_topic(req_topic_url)
+        resp_sub = open_subscription(resp_sub_url)
+        envelope = {
+            "metadata": {"corr": "42"},
+            "path": "/v1/completions",
+            "body": {"model": "m", "prompt": "ping", "max_tokens": 1},
+        }
+        req_topic.send(json.dumps(envelope).encode())
+        resp = resp_sub.receive(timeout=15)
+        assert resp is not None, "no response on the bus"
+        out = json.loads(resp.body)
+        resp.ack()
+        assert out["metadata"] == {"corr": "42"}
+        assert out["status_code"] == 200
+        assert out["body"] == {"echo": "ping"}
+    finally:
+        msgr.stop()
+        stack.close()

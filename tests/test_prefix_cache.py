@@ -191,6 +191,27 @@ def test_pool_backpressure_defers_then_completes():
         ref.stop()
 
 
+def test_oversized_budget_clamps_to_pool_capacity():
+    """max_tokens beyond the whole pool must clamp, not deadlock the
+    admission queue forever (round-2 review regression)."""
+    # 8 usable pages of 16 = 128 tokens; max_seq_len far larger.
+    eng = mk_engine(prefix_cache_min=0, num_pages=9, max_seq_len=2048)
+    try:
+        prompt = np.random.default_rng(13).integers(1, 200, 20).tolist()
+        out = eng.generate(
+            prompt, SamplingParams(temperature=0.0, max_tokens=500), timeout=120
+        )
+        # Budget clamped to pool capacity: 128 - 20 = 108 tokens max.
+        assert 0 < len(out[0]) <= 108
+        # And the engine still serves afterwards.
+        out2 = eng.generate(
+            prompt, SamplingParams(temperature=0.0, max_tokens=4), timeout=120
+        )
+        assert len(out2[0]) == 4
+    finally:
+        eng.stop()
+
+
 def test_failed_prefill_unregisters_planned_pages():
     """A prefill that fails after plan-time registration must unregister
     those pages — otherwise a later same-prefix request would reuse
