@@ -2,7 +2,7 @@
 
 PY ?= python
 
-.PHONY: test test-fast bench bench-tiny dryrun loadgen-demo native clean
+.PHONY: test test-fast bench bench-tiny dryrun loadgen-demo native clean charts
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -26,3 +26,8 @@ native:  ## build the C++ fasthash extension explicitly
 clean:
 	rm -rf build .pytest_cache
 	find . -name __pycache__ -type d -exec rm -rf {} +
+
+charts: ## Render both Helm charts to build/manifests (helm-less template check)
+	mkdir -p build/manifests
+	$(PY) -m kubeai_tpu.utils.helmlite template charts/kubeai-tpu > build/manifests/operator.yaml
+	$(PY) -m kubeai_tpu.utils.helmlite template charts/models > build/manifests/models.yaml

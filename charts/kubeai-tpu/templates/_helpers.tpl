@@ -1,0 +1,13 @@
+{{- define "kubeai-tpu.name" -}}
+{{ .Values.nameOverride | default .Chart.Name | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{- define "kubeai-tpu.fullname" -}}
+{{ .Release.Name | trunc 63 | trimSuffix "-" }}
+{{- end }}
+
+{{- define "kubeai-tpu.labels" -}}
+app.kubernetes.io/name: {{ include "kubeai-tpu.name" . }}
+app.kubernetes.io/instance: {{ .Release.Name }}
+app.kubernetes.io/version: {{ .Chart.Version | quote }}
+{{- end }}

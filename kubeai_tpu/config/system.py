@@ -218,13 +218,74 @@ def _coerce(ftype, v):
     return v
 
 
+def _reference_compat(data: dict) -> dict:
+    """Accept the reference's config spellings alongside ours, so a
+    kubeai values.yaml / system ConfigMap ports over unchanged:
+
+    - modelServers.<E>.images.{default,<profile>: img}  ->
+      engineImages.<E>.{default, profiles}
+      (ref: internal/config/system.go:222-231)
+    - cacheProfiles.<N>.sharedFilesystem.{storageClassName,size} ->
+      flat sharedFilesystemStorageClass / sharedFilesystemStorage
+      (ref: internal/config/system.go:202-212)
+    - modelLoading.image -> modelLoaderImage
+    """
+    data = dict(data)
+    servers = data.pop("modelServers", None)
+    if servers and "engineImages" not in data:
+        images = {}
+        for engine, cfg in servers.items():
+            imgs = dict((cfg or {}).get("images") or {})
+            images[engine] = {
+                "default": imgs.pop("default", ""),
+                "profiles": imgs,
+            }
+        data["engineImages"] = images
+    loading = data.pop("modelLoading", None)
+    if loading and "modelLoaderImage" not in data:
+        if loading.get("image"):
+            data["modelLoaderImage"] = loading["image"]
+    caches = data.get("cacheProfiles")
+    if isinstance(caches, dict):
+        converted = {}
+        for name, prof in caches.items():
+            prof = dict(prof or {})
+            shared = prof.pop("sharedFilesystem", None)
+            if shared:
+                prof.setdefault(
+                    "sharedFilesystemStorageClass", shared.get("storageClassName", "")
+                )
+                prof.setdefault(
+                    "sharedFilesystemStorage", shared.get("size", "100Gi")
+                )
+            converted[name] = prof
+        data["cacheProfiles"] = converted
+    messaging = data.pop("messaging", None)
+    if messaging and "streams" not in data:
+        data["streams"] = messaging.get("streams") or []
+        if "errorMaxBackoffSeconds" in messaging:
+            data["messagingErrorMaxBackoffSeconds"] = messaging["errorMaxBackoffSeconds"]
+    if isinstance(data.get("streams"), list):
+        # The reference spells requestsURL/responsesURL (capitalized
+        # initialism); normalize to camelCase for the field mapper.
+        data["streams"] = [
+            {
+                {"requestsURL": "requestsUrl", "responsesURL": "responsesUrl"}.get(k, k): v
+                for k, v in (s or {}).items()
+            }
+            for s in data["streams"]
+        ]
+    return data
+
+
 def load_system_config(path: str | None = None, data: dict | None = None) -> System:
     """Load from YAML file or dict (CONFIG_PATH equivalent,
-    ref: cmd/main.go:40-46)."""
+    ref: cmd/main.go:40-46). Accepts both this framework's spellings and
+    the reference's (see _reference_compat)."""
     if path is not None:
         import yaml
 
         with open(path) as f:
             data = yaml.safe_load(f) or {}
-    sys_ = _build(System, data or {})
+    sys_ = _build(System, _reference_compat(data or {}))
     return sys_.default_and_validate()

@@ -103,8 +103,8 @@ def test_errors():
         parse_request(mc, b"{}", "/openai/v1/completions", {})
     assert e.value.code == 400  # missing model
     with pytest.raises(APIError) as e:
-        parse_request(mc, b'{"model":"nope"}', "/openai/v1/completions", {})
+        parse_request(mc, b'{"model":"nope","prompt":"x"}', "/openai/v1/completions", {})
     assert e.value.code == 404
     with pytest.raises(APIError) as e:
-        parse_request(mc, b'{"model":"m1"}', "/openai/v1/bogus", {})
+        parse_request(mc, b'{"model":"m1","prompt":"x"}', "/openai/v1/bogus", {})
     assert e.value.code == 404

@@ -1,0 +1,149 @@
+"""Helm chart parity: render charts/kubeai-tpu + charts/models with the
+in-repo helmlite renderer and validate the output against the real
+consumers — the system-config loader and the Model manifest parser
+(ref: charts/kubeai + charts/models; VERDICT r1 item 4)."""
+
+import os
+
+import pytest
+import yaml
+
+from kubeai_tpu.utils.helmlite import render_chart
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OPERATOR_CHART = os.path.join(REPO, "charts", "kubeai-tpu")
+MODELS_CHART = os.path.join(REPO, "charts", "models")
+
+
+@pytest.fixture(scope="module")
+def rendered():
+    return render_chart(OPERATOR_CHART, release_name="kubeai", namespace="kubeai-ns")
+
+
+def by_kind(docs, kind):
+    return [d for d in docs if d.get("kind") == kind]
+
+
+def test_operator_chart_renders_all_kinds(rendered):
+    kinds = sorted({d["kind"] for d in rendered})
+    assert kinds == [
+        "ClusterRole",
+        "ClusterRoleBinding",
+        "ConfigMap",
+        "CustomResourceDefinition",
+        "Deployment",
+        "Secret",
+        "Service",
+        "ServiceAccount",
+    ]
+
+
+def test_system_configmap_loads_into_system_config(rendered):
+    """The rendered ConfigMap must parse through the REAL config loader
+    with the TPU profile matrix intact."""
+    from kubeai_tpu.config.system import load_system_config
+
+    cm = by_kind(rendered, "ConfigMap")[0]
+    assert cm["metadata"]["name"] == "kubeai-config"
+    assert cm["metadata"]["namespace"] == "kubeai-ns"
+    sys_cfg = load_system_config(data=yaml.safe_load(cm["data"]["system.yaml"]))
+
+    # Engine image matrix (reference modelServers shape passes through).
+    assert sys_cfg.engine_images["TPUEngine"].default == "kubeai-tpu/engine:latest"
+    assert sys_cfg.engine_images["VLLM"].for_profile("google-tpu") == "vllm/vllm-tpu:latest"
+
+    # TPU resource-profile matrix, incl. the multi-host slice profile.
+    prof = sys_cfg.resource_profiles["tpu-v5e-2x2"]
+    assert prof.requests["google.com/tpu"] == "4"
+    assert prof.node_selector["cloud.google.com/gke-tpu-topology"] == "2x2"
+    multi = sys_cfg.resource_profiles["tpu-v5e-4x4"]
+    assert multi.hosts_per_replica == 4
+    assert sys_cfg.autoscaling.interval_seconds == 10
+    assert sys_cfg.secret_names.huggingface == "kubeai-huggingface"
+
+
+def test_deployment_matches_operator_manifest(rendered):
+    """helm template reproduces deploy/operator.yaml's deployment shape."""
+    with open(os.path.join(REPO, "deploy", "operator.yaml")) as f:
+        plain = {d["kind"]: d for d in yaml.safe_load_all(f)}
+    dep = by_kind(rendered, "Deployment")[0]
+    plain_dep = plain["Deployment"]
+    c = dep["spec"]["template"]["spec"]["containers"][0]
+    pc = plain_dep["spec"]["template"]["spec"]["containers"][0]
+    assert c["command"] == pc["command"]
+    assert [p["containerPort"] for p in c["ports"]] == [
+        p["containerPort"] for p in pc["ports"]
+    ]
+    assert c["env"][0]["name"] == "CONFIG_PATH"
+    assert dep["spec"]["replicas"] == plain_dep["spec"]["replicas"]
+    # RBAC rule parity.
+    role = by_kind(rendered, "ClusterRole")[0]
+    assert role["rules"] == plain["ClusterRole"]["rules"]
+
+
+def test_values_overrides_flow_through():
+    docs = render_chart(
+        OPERATOR_CHART,
+        sets={
+            "replicaCount": "3",
+            "autoscaling.intervalSeconds": "5",
+            "secrets.huggingface.name": "my-hf",
+            "messaging.streams": (
+                '[{"requestsUrl": "kafka://g?topic=req", '
+                '"responsesUrl": "kafka://resp", "maxHandlers": 2}]'
+            ),
+        },
+    )
+    from kubeai_tpu.config.system import load_system_config
+
+    dep = by_kind(docs, "Deployment")[0]
+    assert dep["spec"]["replicas"] == 3
+    cm = by_kind(docs, "ConfigMap")[0]
+    sys_cfg = load_system_config(data=yaml.safe_load(cm["data"]["system.yaml"]))
+    assert sys_cfg.autoscaling.interval_seconds == 5
+    assert sys_cfg.secret_names.huggingface == "my-hf"
+    assert sys_cfg.streams[0].requests_url == "kafka://g?topic=req"
+    assert sys_cfg.streams[0].max_handlers == 2
+
+
+def test_crds_included(rendered):
+    crd = by_kind(rendered, "CustomResourceDefinition")[0]
+    assert crd["spec"]["names"]["kind"] == "Model"
+
+
+def test_models_chart_disabled_by_default():
+    docs = render_chart(MODELS_CHART)
+    assert [d for d in docs if d.get("kind") == "Model"] == []
+
+
+def test_models_chart_renders_catalog_parity(tmp_path):
+    """Enabled entries must parse through the real manifest parser and
+    match the in-repo catalog's specs."""
+    from kubeai_tpu.catalog import CATALOG, model_from_manifest
+
+    overlay = tmp_path / "enable.yaml"
+    overlay.write_text(
+        yaml.safe_dump({"catalog": {name: {"enabled": True} for name in CATALOG}})
+    )
+    docs = render_chart(MODELS_CHART, value_files=[str(overlay)])
+    models = {d["metadata"]["name"]: d for d in docs if d.get("kind") == "Model"}
+    assert set(models) == set(CATALOG)
+    for name, doc in models.items():
+        m = model_from_manifest(doc)  # validates
+        want = CATALOG[name]
+        assert m.spec.url == want.url
+        assert m.spec.engine == want.engine
+        assert m.spec.resource_profile == want.resource_profile
+        assert m.spec.args == want.args
+        assert m.spec.load_balancing.strategy == want.load_balancing.strategy
+
+
+def test_helmlite_rejects_unsupported_syntax(tmp_path):
+    """Unsupported Go-template constructs fail loudly, not silently."""
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: c\nversion: 0.1.0\n")
+    (chart / "values.yaml").write_text("x: 1\n")
+    (chart / "templates" / "bad.yaml").write_text("a: {{ tpl .Values.x . }}\n")
+    with pytest.raises(ValueError, match="unsupported template function"):
+        render_chart(str(chart))
