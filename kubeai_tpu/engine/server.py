@@ -151,6 +151,15 @@ def _make_handler(srv: EngineServer):
 
         def do_POST(self):
             path = self.path.split("?")[0]
+            # Correlation id propagated by the proxy (X-Request-ID): one
+            # grep finds a request's proxy AND engine log lines.
+            # Sanitized — the engine port is reachable in-cluster without
+            # the proxy, and a raw header in log lines enables forging.
+            from kubeai_tpu.proxy.apiutils import sanitize_request_id
+
+            rid = sanitize_request_id(self.headers.get("X-Request-ID", ""))
+            if rid and path.startswith("/v1/"):
+                log.info("request id=%s engine=%s path=%s", rid, srv.model_name, path)
             try:
                 body = json.loads(self._read_body() or b"{}")
             except json.JSONDecodeError as e:

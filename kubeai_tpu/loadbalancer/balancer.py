@@ -80,9 +80,38 @@ class LoadBalancer:
     def _reconcile_model(self, model_name: str, namespace: str = "default"):
         pods = self.store.list(KIND_POD, namespace, {mt.LABEL_MODEL: model_name})
         observed: dict[str, Endpoint] = {}
+        ranks_ready: dict[str, set[int]] = {}
+        gang_size: dict[str, int] = {}
+        for pod in pods:
+            sid = pod.meta.labels.get("slice-id")
+            if sid is not None:
+                # Expected gang size comes from the controller-stamped
+                # env (NOT the observed pod count: a gang that lost a pod
+                # object entirely must still read as incomplete).
+                expected = 0
+                for c in pod.spec.containers[:1]:
+                    expected = int(
+                        c.env.get("TPU_HOSTS_PER_REPLICA")
+                        or len([h for h in c.env.get("TPU_WORKER_HOSTNAMES", "").split(",") if h])
+                        or 0
+                    )
+                gang_size[sid] = max(gang_size.get(sid, 0), expected, 1)
+                if pod_is_ready(pod):
+                    ranks_ready.setdefault(sid, set()).add(
+                        int(pod.meta.labels.get("slice-rank", "0"))
+                    )
         for pod in pods:
             if not pod_is_ready(pod):
                 continue
+            # Multi-host slice gangs: the replica's address is rank 0's
+            # endpoint, and only once the WHOLE gang is ready (a partial
+            # gang can't serve — its mesh hasn't formed).
+            sid = pod.meta.labels.get("slice-id")
+            if sid is not None:
+                if pod.meta.labels.get("slice-rank", "0") != "0":
+                    continue
+                if len(ranks_ready.get(sid, ())) < gang_size.get(sid, 1):
+                    continue
             ep = pod_endpoint(pod, self.allow_override)
             if ep is not None:
                 observed[pod.meta.name] = ep

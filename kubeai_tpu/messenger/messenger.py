@@ -119,13 +119,23 @@ class Messenger:
             self._respond(msg, metadata, e.code, {"error": {"message": e.message}})
             return
 
+        # Correlation id: a caller-supplied metadata request_id wins
+        # (sanitized — it goes into headers and log lines), else the
+        # parsed id; propagated to the engine via X-Request-ID and echoed
+        # in the response metadata (same contract as the HTTP proxy).
+        from kubeai_tpu.proxy.apiutils import sanitize_request_id
+
+        rid = sanitize_request_id(str(metadata.get("request_id") or "")) or req.id
+        metadata = {**metadata, "request_id": rid}
+        log.info("request id=%s model=%s path=%s transport=messenger", rid, req.model_name, path)
+
         labels = {"request_model": req.model_name, "request_type": "messenger"}
         self.active.add(1, labels=labels)
         try:
             self.model_client.scale_at_least_one_replica(req.model_obj)
             addr, done = self.lb.await_best_address(req, timeout=self.await_timeout)
             try:
-                status, resp_body = self._send_backend(addr, path, req.body_bytes())
+                status, resp_body = self._send_backend(addr, path, req.body_bytes(), rid)
             finally:
                 done()
         except TimeoutError:
@@ -138,7 +148,7 @@ class Messenger:
             self.active.add(-1, labels=labels)
         self._respond(msg, metadata, status, resp_body)
 
-    def _send_backend(self, addr: str, path: str, body: bytes):
+    def _send_backend(self, addr: str, path: str, body: bytes, rid: str = ""):
         """POST to the engine (ref: sendBackendRequest, messenger.go:285-306)."""
         host, _, port = addr.partition(":")
         conn = http.client.HTTPConnection(host, int(port or 80), timeout=self.await_timeout)
@@ -149,9 +159,10 @@ class Messenger:
             if idx < 0:
                 raise ValueError(f"unsupported inference path {path!r}")
             upstream = path[idx:]
-            conn.request(
-                "POST", upstream, body=body, headers={"Content-Type": "application/json"}
-            )
+            headers = {"Content-Type": "application/json"}
+            if rid:
+                headers["X-Request-ID"] = rid
+            conn.request("POST", upstream, body=body, headers=headers)
             resp = conn.getresponse()
             data = resp.read()
             try:

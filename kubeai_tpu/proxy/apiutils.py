@@ -46,6 +46,16 @@ class Request:
         return self.body.to_bytes() if self.body else b""
 
 
+def sanitize_request_id(rid: str) -> str:
+    """Correlation ids go into HTTP headers and log lines: restrict to a
+    safe charset (a newline would fail http.client's header validation
+    and allow log forging) and bound the length. Returns "" when nothing
+    safe remains — callers fall back to a generated id."""
+    import re
+
+    return re.sub(r"[^A-Za-z0-9._\-]", "", str(rid))[:128]
+
+
 def split_model_adapter(s: str) -> tuple[str, str]:
     """"model_adapter" -> (model, adapter); parity: model.go:23-37."""
     model, sep, adapter = s.partition("_")

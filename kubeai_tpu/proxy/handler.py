@@ -4,6 +4,13 @@ Parity: internal/modelproxy/handler.go:36-172 — parse once, bump the
 active-requests gauge (THE autoscaling signal), 0->1 scale, await an
 endpoint, proxy with body replay and retries on {500,502,503,504} or
 connection errors, re-entering endpoint selection each attempt.
+
+Tracing: every request carries an id — inbound X-Request-ID if the
+client sent one, else generated — that is logged in span-shaped lines
+here, forwarded to the engine (which logs it too), and echoed in the
+response headers, so one id greps across the whole path (the minimum
+the reference gets from its otelhttp wiring,
+ref: internal/manager/otel.go:16-80).
 """
 
 from __future__ import annotations
@@ -11,6 +18,7 @@ from __future__ import annotations
 import http.client
 import logging
 import threading
+import time
 
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
@@ -41,6 +49,15 @@ class ModelProxy:
     def handle(self, raw_body: bytes, path: str, headers: dict[str, str], cancelled: threading.Event | None = None):
         """Returns a ProxyResult; raises APIError for client errors."""
         req = parse_request(self.model_client, raw_body, path, headers)
+        # Honor an inbound correlation id; otherwise use the parsed id.
+        from kubeai_tpu.proxy.apiutils import sanitize_request_id
+
+        inbound = sanitize_request_id(
+            next((v for k, v in headers.items() if k.lower() == "x-request-id"), "")
+        )
+        if inbound:
+            req.id = inbound
+        log.info("request id=%s model=%s path=%s", req.id, req.model_name, path)
 
         labels = {"request_model": req.model_name, "request_type": "http"}
         self.active.add(1, labels=labels)
@@ -55,6 +72,11 @@ class ModelProxy:
 
     def _proxy_with_retries(self, req: Request, path: str, headers: dict[str, str], release, cancelled):
         body = req.body_bytes()
+        t0 = time.monotonic()
+        # Propagate downstream (dropping any case-variant inbound copy so
+        # the engine never sees a duplicated header).
+        headers = {k: v for k, v in headers.items() if k.lower() != "x-request-id"}
+        headers["X-Request-ID"] = req.id
         last_err: Exception | str | None = None
         attempts = self.max_retries + 1
         failed_addrs: set[str] = set()
@@ -88,9 +110,21 @@ class ModelProxy:
                     conn.close()
                     done()
                 continue
-            return ProxyResult(
-                resp.status, resp.getheaders(), self._body_iter(resp, conn, done, release)
+            log.info(
+                "request id=%s model=%s upstream=%s status=%d attempt=%d dur_ms=%.0f",
+                req.id, req.model_name, addr, resp.status, attempt + 1,
+                (time.monotonic() - t0) * 1000,
             )
+            resp_headers = [
+                (k, v) for k, v in resp.getheaders() if k.lower() != "x-request-id"
+            ] + [("X-Request-ID", req.id)]
+            return ProxyResult(
+                resp.status, resp_headers, self._body_iter(resp, conn, done, release)
+            )
+        log.info(
+            "request id=%s model=%s failed after %d attempts: %s",
+            req.id, req.model_name, attempts, last_err,
+        )
         raise APIError(502, f"upstream unavailable after {attempts} attempts: {last_err}")
 
     def _connect(self, addr: str, path: str, headers: dict[str, str], body: bytes):

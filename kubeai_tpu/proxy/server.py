@@ -11,11 +11,16 @@ from __future__ import annotations
 import json
 import logging
 import threading
+import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.metrics import default_registry
-from kubeai_tpu.proxy.apiutils import APIError, parse_label_selector
+from kubeai_tpu.proxy.apiutils import (
+    APIError,
+    parse_label_selector,
+    sanitize_request_id,
+)
 
 log = logging.getLogger("kubeai_tpu.openaiserver")
 
@@ -82,16 +87,18 @@ def _make_handler(srv: OpenAIServer):
         def log_message(self, fmt, *args):
             log.debug(fmt, *args)
 
-        def _json(self, code: int, obj):
+        def _json(self, code: int, obj, rid: str = ""):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            if rid:
+                self.send_header("X-Request-ID", rid)
             self.end_headers()
             self.wfile.write(body)
 
-        def _api_error(self, e: APIError):
-            self._json(e.code, {"error": {"message": e.message, "type": "invalid_request_error" if e.code < 500 else "internal_error"}})
+        def _api_error(self, e: APIError, rid: str = ""):
+            self._json(e.code, {"error": {"message": e.message, "type": "invalid_request_error" if e.code < 500 else "internal_error"}}, rid=rid)
 
         def do_GET(self):
             path = self.path.split("?")[0]
@@ -120,18 +127,24 @@ def _make_handler(srv: OpenAIServer):
             n = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(n)
             cancelled = threading.Event()
+            # Fix the correlation id HERE so even proxy-originated error
+            # responses (400/404/502) echo it — sanitized, since it goes
+            # into headers and log lines.
+            rid = sanitize_request_id(self.headers.get("X-Request-ID", "")) or uuid.uuid4().hex
+            headers = {
+                k: v for k, v in self.headers.items() if k.lower() != "x-request-id"
+            }
+            headers["X-Request-ID"] = rid
             try:
-                result = srv.proxy.handle(
-                    raw, path, {k: v for k, v in self.headers.items()}, cancelled
-                )
+                result = srv.proxy.handle(raw, path, headers, cancelled)
             except APIError as e:
-                return self._api_error(e)
+                return self._api_error(e, rid=rid)
             except Exception as e:  # pragma: no cover
                 log.exception("proxy failure")
-                return self._json(500, {"error": {"message": str(e)}})
+                return self._json(500, {"error": {"message": str(e)}}, rid=rid)
 
             self.send_response(result.status)
-            passthrough = {"content-type", "cache-control"}
+            passthrough = {"content-type", "cache-control", "x-request-id"}
             for k, v in result.headers:
                 if k.lower() in passthrough:
                     self.send_header(k, v)

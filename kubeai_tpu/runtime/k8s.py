@@ -285,11 +285,19 @@ class KubeStore:
     # -- watch -------------------------------------------------------------
 
     def watch(self, kind: str | None = None) -> "queue.Queue[WatchEvent]":
-        """Streamed apiserver watch fanned into a queue. Each (re)connect
-        starts with a fresh LIST emitted as synthetic ADDED events, so
-        events dropped in the list->watch gap or during a reconnect window
-        are resynced — consumers are level-triggered and tolerate
-        repeats (same contract as the in-memory store's initial replay)."""
+        """Streamed apiserver watch fanned into a queue, with the
+        standard list-then-watch protocol (parity: controller-runtime's
+        informer semantics the reference relies on):
+
+        - A fresh LIST emits synthetic ADDED events and pins the
+          collection resourceVersion; the watch starts FROM that RV, so
+          nothing falls in a list->watch gap.
+        - The last delivered RV is tracked; a dropped connection resumes
+          from it (no re-list, no event loss).
+        - 410 Gone — at connect or as an in-stream ERROR event (the
+          apiserver compacted past our RV) — triggers a full re-list;
+          consumers are level-triggered and tolerate the repeats.
+        """
         q: "queue.Queue[WatchEvent]" = queue.Queue()
         kinds = [kind] if kind else list(_KINDS)
         for k in kinds:
@@ -303,42 +311,80 @@ class KubeStore:
     def unwatch(self, q) -> None:  # watches die with the process
         pass
 
+    def _relist(self, kind: str, q: "queue.Queue[WatchEvent]") -> str:
+        """LIST the collection, emit synthetic ADDED events, return the
+        collection resourceVersion to start the watch from."""
+        _, _, _, decode = _KINDS[kind]
+        list_doc = self._request("GET", self._url(kind, self.namespace))
+        for item in list_doc.get("items", []):
+            try:
+                q.put(WatchEvent("ADDED", kind, decode(item)))
+            except Exception:
+                continue
+        return str((list_doc.get("metadata") or {}).get("resourceVersion") or "0")
+
     def _watch_loop(self, kind: str, q: "queue.Queue[WatchEvent]"):
         _, _, _, decode = _KINDS[kind]
         import time
 
+        rv: str | None = None  # None => full re-list needed
         while self._watching:
             try:
-                # Open the watch FIRST, then resync via list (synthetic
-                # ADDED events): anything created in the gap arrives on the
-                # already-open stream, and duplicates are harmless to the
-                # level-triggered consumers. Each reconnect repeats the
-                # resync, covering events lost while disconnected.
-                url = self._url(kind, self.namespace, query="watch=true")
+                if rv is None:
+                    rv = self._relist(kind, q)
+                url = self._url(
+                    kind,
+                    self.namespace,
+                    query=f"watch=true&resourceVersion={rv}&allowWatchBookmarks=true",
+                )
                 req = urllib.request.Request(url)
                 req.add_header("Accept", "application/json")
                 if self.token:
                     req.add_header("Authorization", f"Bearer {self.token}")
                 with urllib.request.urlopen(req, timeout=330, context=self._ctx) as resp:
-                    list_doc = self._request("GET", self._url(kind, self.namespace))
-                    for item in list_doc.get("items", []):
-                        try:
-                            q.put(WatchEvent("ADDED", kind, decode(item)))
-                        except Exception:
-                            continue
                     for line in resp:
                         if not self._watching:
                             return
                         try:
                             ev = json.loads(line)
-                            q.put(WatchEvent(ev["type"], kind, decode(ev["object"])))
-                        except Exception:
-                            # Undecodable event (foreign object, partial
-                            # line): skip; resync covers any gap.
+                        except json.JSONDecodeError:
+                            continue  # partial line; reconnect resumes
+                        ev_type = ev.get("type")
+                        obj = ev.get("object") or {}
+                        if ev_type == "ERROR":
+                            if obj.get("code") == 410:
+                                # Compacted past our RV: full resync.
+                                log.warning("watch %s expired (410); relisting", kind)
+                                rv = None
+                            else:
+                                # Server-side error (e.g. etcd timeout):
+                                # back off so a persistent failure can't
+                                # become a hot reconnect loop.
+                                log.warning("watch %s error event: %s", kind, obj)
+                                time.sleep(2)
+                            break
+                        # Track progress even for undecodable objects so a
+                        # reconnect never re-reads past events.
+                        new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                        if new_rv:
+                            rv = str(new_rv)
+                        if ev_type == "BOOKMARK":
                             continue
+                        try:
+                            q.put(WatchEvent(ev_type, kind, decode(obj)))
+                        except Exception:
+                            # Undecodable (foreign) object: skip.
+                            continue
+            except urllib.error.HTTPError as e:
+                if e.code == 410:
+                    log.warning("watch %s connect got 410 Gone; relisting", kind)
+                    rv = None
+                elif self._watching:
+                    log.warning("watch %s dropped (%s); resuming from rv=%s", kind, e, rv)
+                    time.sleep(2)
             except Exception as e:
                 if self._watching:
-                    log.warning("watch %s dropped (%s); resyncing", kind, e)
+                    log.warning("watch %s dropped (%s); resuming from rv=%s", kind, e, rv)
                 time.sleep(2)
 
     def close(self):

@@ -47,6 +47,7 @@ class LocalRuntime:
         self.repo_root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
         self.extra_env = extra_env or {}
         self._procs: dict[str, LocalProcess] = {}
+        self._gang_ports: dict[str, int] = {}  # slice-id -> coordinator port
         self._lock = threading.Lock()
         self._running = False
         self._threads: list[threading.Thread] = []
@@ -146,6 +147,19 @@ class LocalRuntime:
         env.update({k: v for k, v in server.env.items() if not k.startswith("__envFromSecret_")})
         env.update(self.extra_env)
         env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
+        if "TPU_WORKER_HOSTNAMES" in env:
+            # Multi-host slice gang running as local processes: the
+            # controller's subdomain DNS names don't resolve here —
+            # everyone is 127.0.0.1 and the gang shares one coordinator
+            # port keyed by slice-id (rank 0 listens on it).
+            sid = pod.meta.labels.get("slice-id", pod.meta.name)
+            n_hosts = len([h for h in env["TPU_WORKER_HOSTNAMES"].split(",") if h.strip()])
+            with self._lock:
+                gang_port = self._gang_ports.get(sid)
+                if gang_port is None:
+                    gang_port = self._gang_ports[sid] = free_port()
+            env["TPU_WORKER_HOSTNAMES"] = ",".join(["127.0.0.1"] * n_hosts)
+            env["TPU_COORDINATOR_PORT"] = str(gang_port)
         log.info("launching pod %s: %s (port %d)", pod.meta.name, " ".join(cmd[:4]), port)
         try:
             proc = subprocess.Popen(

@@ -376,24 +376,43 @@ def _deep_merge(base: dict, overlay: dict) -> dict:
     return out
 
 
-def _extract_defines(src: str, renderer: Renderer) -> str:
-    """Pull {{ define "x" }}...{{ end }} blocks out; return the rest.
-    Honors the define/end actions' whitespace-trim markers on the body
-    (a define body ending in a stray newline would corrupt every
-    inline {{ include }})."""
-    out = src
-    pattern = re.compile(
-        r"\{\{-?\s*define\s+\"([^\"]+)\"\s*(-?)\}\}(.*?)\{\{(-?)\s*end\s*-?\}\}", re.S
-    )
-    for m in pattern.finditer(src):
-        body = m.group(3)
-        if m.group(2) == "-":
-            body = body.lstrip()
-        if m.group(4) == "-":
-            body = body.rstrip()
-        nodes, _, _ = _parse(_tokenize(body))
-        renderer.defines[m.group(1)] = nodes
-        out = out.replace(m.group(0), "")
+def _extract_defines(src: str, renderer: Renderer) -> list[_Tok]:
+    """Pull {{ define "x" }}...{{ end }} blocks out of the token stream
+    (depth-aware, so define bodies may contain if/range blocks — the
+    stock Helm helper pattern) and return the remaining tokens.
+    Whitespace-trim markers were already applied by _tokenize, so bodies
+    carry no stray newlines into inline {{ include }} expansions."""
+    toks = _tokenize(src)
+    out: list[_Tok] = []
+    i = 0
+    while i < len(toks):
+        t = toks[i]
+        if t.kind == "action" and t.value.startswith("define "):
+            m = re.match(r'^define\s+"([^"]+)"$', t.value)
+            if not m:
+                raise ValueError(f"malformed define action {t.value!r}")
+            depth = 1
+            body: list[_Tok] = []
+            j = i + 1
+            while j < len(toks):
+                tj = toks[j]
+                if tj.kind == "action":
+                    if tj.value.startswith(("if ", "range ", "define ", "with ")):
+                        depth += 1
+                    elif tj.value == "end":
+                        depth -= 1
+                        if depth == 0:
+                            break
+                body.append(tj)
+                j += 1
+            if depth != 0:
+                raise ValueError(f'unterminated define "{m.group(1)}"')
+            nodes, _, _ = _parse(body)
+            renderer.defines[m.group(1)] = nodes
+            i = j + 1
+        else:
+            out.append(t)
+            i += 1
     return out
 
 
@@ -437,18 +456,18 @@ def render_chart(
     tmpl_dir = os.path.join(chart_dir, "templates")
     files = sorted(os.listdir(tmpl_dir))
     # First pass: collect defines from helpers.
-    sources: list[tuple[str, str]] = []
+    sources: list[tuple[str, list[_Tok]]] = []
     for name in files:
         if not (name.endswith(".yaml") or name.endswith(".tpl")):
             continue
         with open(os.path.join(tmpl_dir, name)) as f:
-            src = _extract_defines(f.read(), renderer)
+            toks = _extract_defines(f.read(), renderer)
         if not name.startswith("_"):
-            sources.append((name, src))
+            sources.append((name, toks))
 
     docs: list[dict] = []
-    for name, src in sources:
-        nodes, _, _ = _parse(_tokenize(src))
+    for name, toks in sources:
+        nodes, _, _ = _parse(toks)
         text = renderer.render_nodes(nodes, root, root)
         for doc in yaml.safe_load_all(text):
             if doc:

@@ -295,7 +295,8 @@ def test_messenger_pipeline_over_cloud_bus(bus, request):
         assert resp is not None, "no response on the bus"
         out = json.loads(resp.body)
         resp.ack()
-        assert out["metadata"] == {"corr": "42"}
+        assert out["metadata"]["corr"] == "42"
+        assert out["metadata"]["request_id"]  # correlation id echoed
         assert out["status_code"] == 200
         assert out["body"] == {"echo": "ping"}
     finally:

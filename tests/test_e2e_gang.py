@@ -1,0 +1,110 @@
+"""Multi-host slice gang e2e: a Model whose profile has
+hostsPerReplica=2 is served by a 2-process gang — both processes join
+one jax.distributed cluster over CPU (the rank bootstrap the controller
+stamps into gang pods), the load balancer exposes rank 0 as THE replica
+endpoint only once the whole gang is ready, and a completion
+round-trips (ref: SURVEY.md §7 hard part (a); VERDICT r1 item 9)."""
+
+import json
+import time
+import urllib.request
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.config.system import ResourceProfile, System
+from kubeai_tpu.manager import Manager
+from kubeai_tpu.runtime.store import ObjectMeta
+from tests.test_e2e_local import ckpt_dir  # noqa: F401 (fixture reuse)
+
+pytestmark = pytest.mark.e2e
+
+
+@pytest.fixture(scope="module")
+def manager():
+    system = System().default_and_validate()
+    # A CPU "slice" profile: 2 gang processes per replica, no TPU chips.
+    system.resource_profiles["cpu-gang"] = ResourceProfile(
+        requests={"cpu": "1"}, hosts_per_replica=2
+    )
+    mgr = Manager(system, local_runtime=True, host="127.0.0.1", port=0)
+    mgr.local_runtime.extra_env["JAX_PLATFORMS"] = "cpu"
+    mgr.start()
+    yield mgr
+    mgr.stop()
+
+
+def test_gang_round_trips_completion(manager, ckpt_dir):  # noqa: F811
+    mgr = manager
+    mgr.store.create(
+        mt.KIND_MODEL,
+        Model(
+            meta=ObjectMeta(name="gang"),
+            spec=ModelSpec(
+                url=f"file://{ckpt_dir}",
+                engine=mt.ENGINE_TPU,
+                resource_profile="cpu-gang:1",
+                min_replicas=1,
+                # Gang processes each compute locally in this e2e (the
+                # jax.distributed cluster still forms across both).
+                args=["--tensor-parallel-size", "1", "--max-seq-len", "256"],
+            ),
+        ),
+    )
+
+    # The controller expands one replica into a 2-pod gang with ranks.
+    deadline = time.time() + 30
+    pods = []
+    while time.time() < deadline:
+        pods = mgr.store.list(KIND_POD, selector={mt.LABEL_MODEL: "gang"})
+        if len(pods) == 2:
+            break
+        time.sleep(0.2)
+    assert len(pods) == 2, f"expected a 2-pod gang, got {len(pods)}"
+    ranks = sorted(p.meta.labels.get("slice-rank") for p in pods)
+    assert ranks == ["0", "1"]
+    sids = {p.meta.labels.get("slice-id") for p in pods}
+    assert len(sids) == 1, "gang members must share one slice id"
+    env = pods[0].spec.containers[0].env
+    assert env.get("TPU_WORKER_ID") in ("0", "1")
+    assert len(env.get("TPU_WORKER_HOSTNAMES", "").split(",")) == 2
+
+    # Both ranks must become ready (jax.distributed formed: the engine
+    # only serves /health after initialize() returns on BOTH ranks).
+    deadline = time.time() + 180
+    while time.time() < deadline:
+        pods = mgr.store.list(KIND_POD, selector={mt.LABEL_MODEL: "gang"})
+        if len(pods) == 2 and all(p.status.ready for p in pods):
+            break
+        time.sleep(0.5)
+    assert all(p.status.ready for p in pods), [
+        (p.meta.name, p.status.ready) for p in pods
+    ]
+
+    # The LB exposes exactly ONE endpoint for the gang: rank 0.
+    addrs = mgr.lb.get_all_addresses("gang")
+    assert len(addrs) == 1, f"gang must be one endpoint, got {addrs}"
+    rank0 = next(p for p in pods if p.meta.labels["slice-rank"] == "0")
+    assert addrs[0].endswith(rank0.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT])
+
+    # A completion round-trips through the gang endpoint.
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{mgr.api.port}/openai/v1/completions",
+        data=json.dumps({"model": "gang", "prompt": "hello", "max_tokens": 4}).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=120) as resp:
+        body = json.loads(resp.read())
+    assert body["choices"][0]["text"] is not None
+    assert body["usage"]["completion_tokens"] >= 1
+
+    # Deleting the model tears the whole gang down together.
+    mgr.store.delete(mt.KIND_MODEL, "gang")
+    deadline = time.time() + 30
+    while time.time() < deadline:
+        if not mgr.store.list(KIND_POD, selector={mt.LABEL_MODEL: "gang"}):
+            break
+        time.sleep(0.2)
+    assert mgr.store.list(KIND_POD, selector={mt.LABEL_MODEL: "gang"}) == []

@@ -138,6 +138,28 @@ def test_models_chart_renders_catalog_parity(tmp_path):
         assert m.spec.load_balancing.strategy == want.load_balancing.strategy
 
 
+def test_helmlite_define_with_nested_blocks(tmp_path):
+    """Stock Helm helper pattern: a define containing if/else must parse
+    (depth-aware define extraction — round-2 review regression)."""
+    chart = tmp_path / "c"
+    (chart / "templates").mkdir(parents=True)
+    (chart / "Chart.yaml").write_text("name: c\nversion: 0.1.0\n")
+    (chart / "values.yaml").write_text("fullnameOverride: custom\n")
+    (chart / "templates" / "_helpers.tpl").write_text(
+        '{{- define "c.fullname" -}}\n'
+        "{{- if .Values.fullnameOverride }}{{ .Values.fullnameOverride }}"
+        "{{- else }}{{ .Release.Name }}{{- end }}\n"
+        "{{- end }}\n"
+    )
+    (chart / "templates" / "cm.yaml").write_text(
+        'kind: ConfigMap\nmetadata:\n  name: {{ include "c.fullname" . }}\n'
+    )
+    docs = render_chart(str(chart), release_name="rel")
+    assert docs[0]["metadata"]["name"] == "custom"
+    docs = render_chart(str(chart), sets={"fullnameOverride": '""'}, release_name="rel")
+    assert docs[0]["metadata"]["name"] == "rel"
+
+
 def test_helmlite_rejects_unsupported_syntax(tmp_path):
     """Unsupported Go-template constructs fail loudly, not silently."""
     chart = tmp_path / "c"

@@ -20,6 +20,10 @@ class FakeAPIServer:
     def __init__(self):
         self.objects: dict[str, dict[str, dict]] = {}  # collection -> name -> doc
         self.rv = 0
+        # Event history for resourceVersion'd watch resume: collection ->
+        # [(rv, type, doc)]. compact() discards it (etcd compaction).
+        self.history: dict[str, list[tuple[int, str, dict]]] = {}
+        self.min_rv = 0  # watches from rv < min_rv get 410 Gone
         self.watchers: list[tuple[str, object]] = []
         self.lock = threading.Lock()
         outer = self
@@ -47,17 +51,40 @@ class FakeAPIServer:
                 sub = parts[i + 4] if len(parts) > i + 4 else None
                 return f"{ns}/{plural}", name, sub
 
+            def _chunk(self, payload: dict):
+                data = json.dumps(payload).encode() + b"\n"
+                self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
+                self.wfile.flush()
+
             def do_GET(self):
+                from urllib.parse import parse_qs, urlparse
+
                 coll, name, _sub = self._parts()
-                if "watch=true" in self.path:
+                qs = parse_qs(urlparse(self.path).query)
+                if qs.get("watch") == ["true"]:
                     self.send_response(200)
                     self.send_header("Transfer-Encoding", "chunked")
                     self.end_headers()
+                    start_rv = int((qs.get("resourceVersion") or ["0"])[0] or 0)
                     with outer.lock:
+                        if start_rv and start_rv < outer.min_rv:
+                            # Compacted past the requested RV: in-stream
+                            # 410, like a real apiserver.
+                            self._chunk({
+                                "type": "ERROR",
+                                "object": {"kind": "Status", "code": 410, "reason": "Expired"},
+                            })
+                            return
+                        replay = [
+                            (t, doc) for rv, t, doc in outer.history.get(coll, [])
+                            if rv > start_rv
+                        ]
                         outer.watchers.append((coll, self))
                     try:
+                        for t, doc in replay:
+                            self._chunk({"type": t, "object": doc})
                         while True:
-                            time.sleep(0.2)  # events pushed by notify()
+                            time.sleep(0.2)  # live events pushed by notify()
                     except Exception:
                         pass
                     return
@@ -68,18 +95,17 @@ class FakeAPIServer:
                             return self._send(404, {"message": "not found"})
                         return self._send(200, objs[name])
                     items = list(objs.values())
+                    list_rv = outer.rv
                 sel = None
-                if "labelSelector=" in self.path:
-                    from urllib.parse import parse_qs, urlparse
-
-                    raw = parse_qs(urlparse(self.path).query)["labelSelector"][0]
+                if "labelSelector" in qs:
+                    raw = qs["labelSelector"][0]
                     sel = dict(p.split("=", 1) for p in raw.split(","))
                 if sel:
                     items = [
                         d for d in items
                         if all((d["metadata"].get("labels") or {}).get(k) == v for k, v in sel.items())
                     ]
-                self._send(200, {"items": items})
+                self._send(200, {"items": items, "metadata": {"resourceVersion": str(list_rv)}})
 
             def do_POST(self):
                 coll, _, _sub = self._parts()
@@ -135,6 +161,10 @@ class FakeAPIServer:
                     if name not in objs:
                         return self._send(404, {"message": "not found"})
                     doc = objs.pop(name)
+                    outer.rv += 1  # deletions advance the collection RV
+                    doc = dict(doc)
+                    doc["metadata"] = dict(doc["metadata"])
+                    doc["metadata"]["resourceVersion"] = str(outer.rv)
                 outer.notify(coll, "DELETED", doc)
                 self._send(200, {})
 
@@ -145,6 +175,9 @@ class FakeAPIServer:
     def notify(self, coll, type_, doc):
         with self.lock:
             watchers = list(self.watchers)
+            self.history.setdefault(coll, []).append(
+                (int(doc["metadata"].get("resourceVersion", self.rv)), type_, doc)
+            )
         for wcoll, handler in watchers:
             if wcoll != coll:
                 continue
@@ -154,6 +187,27 @@ class FakeAPIServer:
                 handler.wfile.flush()
             except Exception:
                 pass
+
+    def drop_watches(self):
+        """Kill every open watch stream (network blip / apiserver roll).
+        shutdown(), not close(): the handler's rfile/wfile hold io-refs
+        on the socket, so close() alone never sends the FIN."""
+        import socket as _socket
+
+        with self.lock:
+            watchers, self.watchers = self.watchers, []
+        for _, handler in watchers:
+            try:
+                handler.connection.shutdown(_socket.SHUT_RDWR)
+            except Exception:
+                pass
+
+    def compact(self):
+        """Discard event history (etcd compaction): resumes from older
+        RVs must get 410 Gone."""
+        with self.lock:
+            self.history.clear()
+            self.min_rv = self.rv + 1
 
     def stop(self):
         self.httpd.shutdown()
@@ -297,6 +351,61 @@ def test_manager_control_plane_over_rest(kube):
     finally:
         mgr.stop()
         eng.stop()
+
+
+def _drain_until(q, pred, deadline_s=15):
+    """Collect events until pred(events) or deadline; returns events."""
+    events = []
+    deadline = time.time() + deadline_s
+    while time.time() < deadline:
+        try:
+            events.append(q.get(timeout=1))
+        except Exception:
+            continue
+        if pred(events):
+            break
+    return events
+
+
+def test_watch_reconnect_resumes_from_last_rv(kube):
+    """A dropped watch connection resumes from the last delivered
+    resourceVersion: events during the outage arrive exactly once and
+    nothing already seen is replayed (no full re-list)."""
+    api, store = kube
+    q = store.watch(mt.KIND_MODEL)
+    store.create(mt.KIND_MODEL, Model(meta=ObjectMeta(name="m1"), spec=ModelSpec(url="hf://a/b")))
+    evs = _drain_until(q, lambda e: any(x.obj.meta.name == "m1" for x in e))
+    assert any(e.obj.meta.name == "m1" for e in evs)
+
+    api.drop_watches()
+    # Created while the client is disconnected.
+    store.create(mt.KIND_MODEL, Model(meta=ObjectMeta(name="m2"), spec=ModelSpec(url="hf://c/d")))
+    evs = _drain_until(q, lambda e: any(x.obj.meta.name == "m2" for x in e))
+    names = [e.obj.meta.name for e in evs]
+    assert "m2" in names, f"missed event during outage: {names}"
+    # Resume (not re-list): m1 must NOT be replayed.
+    assert "m1" not in names, f"reconnect re-delivered old events: {names}"
+
+
+def test_watch_410_gone_triggers_full_relist(kube):
+    """When the apiserver compacts past the client's RV, the resumed
+    watch gets 410 Gone and the client must re-list: existing objects
+    come back as synthetic ADDEDs and new events flow again."""
+    api, store = kube
+    q = store.watch(mt.KIND_MODEL)
+    store.create(mt.KIND_MODEL, Model(meta=ObjectMeta(name="m1"), spec=ModelSpec(url="hf://a/b")))
+    _drain_until(q, lambda e: any(x.obj.meta.name == "m1" for x in e))
+
+    api.compact()
+    api.drop_watches()
+    store.create(mt.KIND_MODEL, Model(meta=ObjectMeta(name="m2"), spec=ModelSpec(url="hf://c/d")))
+    evs = _drain_until(
+        q,
+        lambda e: {"m1", "m2"} <= {x.obj.meta.name for x in e},
+        deadline_s=25,
+    )
+    names = {e.obj.meta.name for e in evs}
+    assert {"m1", "m2"} <= names, f"relist after 410 incomplete: {names}"
 
 
 def test_watch_stream(kube):

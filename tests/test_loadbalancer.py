@@ -222,3 +222,70 @@ class TestConcurrency:
         # Reasonable spread across 4 endpoints.
         for addr in ["a", "b", "c", "d"]:
             assert counts[addr] > 10
+
+
+class TestGangEndpoints:
+    """Multi-host slice gangs: rank 0 is THE endpoint, and only when the
+    whole gang (by the controller-stamped expected size, not the observed
+    pod count) is ready."""
+
+    @staticmethod
+    def _gang_pod(rank: int, ready: bool = True, hosts: int = 2, sid: str = "s1"):
+        from kubeai_tpu.api.core_types import Container, Pod, PodStatus
+        from kubeai_tpu.api import model_types as mt
+        from kubeai_tpu.runtime.store import ObjectMeta
+
+        pod = Pod(
+            meta=ObjectMeta(
+                name=f"model-g-{sid}-{rank}",
+                labels={mt.LABEL_MODEL: "g", "slice-id": sid, "slice-rank": str(rank)},
+                annotations={
+                    mt.ANNOTATION_MODEL_POD_IP: "127.0.0.1",
+                    mt.ANNOTATION_MODEL_POD_PORT: str(9000 + rank),
+                },
+            )
+        )
+        pod.spec.containers = [
+            Container(env={"TPU_HOSTS_PER_REPLICA": str(hosts),
+                           "TPU_WORKER_HOSTNAMES": ",".join(["h"] * hosts)})
+        ]
+        pod.status = PodStatus(phase="Running", ready=ready, pod_ip="127.0.0.1")
+        return pod
+
+    def _lb(self):
+        from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+        from kubeai_tpu.runtime.store import Store
+        from kubeai_tpu.api.core_types import KIND_POD
+
+        store = Store()
+        lb = LoadBalancer(store, allow_pod_address_override=True)
+        return store, lb
+
+    def test_whole_gang_ready_exposes_rank0_only(self):
+        from kubeai_tpu.api.core_types import KIND_POD
+
+        store, lb = self._lb()
+        store.create(KIND_POD, self._gang_pod(0))
+        store.create(KIND_POD, self._gang_pod(1))
+        lb._reconcile_model("g")
+        assert lb.get_all_addresses("g") == ["127.0.0.1:9000"]
+
+    def test_partial_gang_not_ready_serves_nothing(self):
+        from kubeai_tpu.api.core_types import KIND_POD
+
+        store, lb = self._lb()
+        store.create(KIND_POD, self._gang_pod(0))
+        store.create(KIND_POD, self._gang_pod(1, ready=False))
+        lb._reconcile_model("g")
+        assert lb.get_all_addresses("g") == []
+
+    def test_gang_missing_pod_object_serves_nothing(self):
+        """Rank 1's pod object vanished entirely (node GC): the expected
+        size comes from the stamped env, so rank 0 alone must NOT serve
+        (round-2 review regression)."""
+        from kubeai_tpu.api.core_types import KIND_POD
+
+        store, lb = self._lb()
+        store.create(KIND_POD, self._gang_pod(0))
+        lb._reconcile_model("g")
+        assert lb.get_all_addresses("g") == []

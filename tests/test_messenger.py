@@ -101,7 +101,10 @@ def test_request_response_roundtrip(backend):
         assert resp is not None
         data = json.loads(resp.body)
         assert data["status_code"] == 200
-        assert data["metadata"] == {"correlation": "abc"}
+        # Caller metadata echoes back plus the correlation request_id
+        # (generated when the caller didn't supply one).
+        assert data["metadata"]["correlation"] == "abc"
+        assert data["metadata"]["request_id"]
         assert data["body"]["echo"] == "hello"
         assert mc.scaled == ["m1"]
     finally:

@@ -28,6 +28,7 @@ class FakeEngine:
 
     def __init__(self, fail_first: int = 0):
         self.requests = []
+        self.last_headers: dict = {}
         self.fail_remaining = fail_first
         outer = self
 
@@ -41,6 +42,7 @@ class FakeEngine:
                 n = int(self.headers.get("Content-Length", 0))
                 body = json.loads(self.rfile.read(n))
                 outer.requests.append((self.path, body))
+                outer.last_headers = dict(self.headers)
                 if outer.fail_remaining > 0:
                     outer.fail_remaining -= 1
                     payload = json.dumps({"error": "boom"}).encode()
