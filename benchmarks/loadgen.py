@@ -1,22 +1,33 @@
-"""Multi-turn chat load generator with TTFT/ITL/TPOT aggregation.
+"""Multi-turn chat load generator: TTFT/ITL/TPOT, dataset replay, rate control.
 
 Parity with the reference's benchmark tooling (ref:
 benchmarks/multi-turn-chat-go/benchmark/runner.go — stateful conversation
-threads; docs/benchmarks/prefix-aware-load-balancing.md methodology):
-N concurrent threads each hold a conversation (so PrefixHash routing has
-prefixes to exploit), send streaming chat completions with the growing
-history, and record time-to-first-token, inter-token latency, and
-time-per-output-token. Works against any OpenAI-compatible endpoint —
-this framework's operator or engine, or an upstream server.
+threads; benchmarks/chat-py/benchmark_serving.py — ShareGPT replay,
+--request-rate, --max-concurrency; docs/benchmarks/
+prefix-aware-load-balancing.md methodology):
+
+- N concurrent conversations, each holding its growing history so
+  PrefixHash routing has prefixes to exploit; streaming chat completions
+  with TTFT / inter-token latency / time-per-output-token aggregation.
+- `--dataset` replays ShareGPT-format conversations (the human turns
+  become the user messages; the model produces the assistant turns).
+- `--request-rate` starts conversations at Poisson arrival times
+  (open-loop, like the reference's benchmark_serving); 0 = all at once.
+- `--max-concurrency` bounds conversations in flight.
+
+Works against any OpenAI-compatible endpoint — this framework's
+operator or engine, or an upstream server.
 
     python benchmarks/loadgen.py --url http://localhost:8000/openai \
-        --model m1 --threads 16 --turns 4 --max-tokens 64
+        --model m1 --conversations 16 --turns 4 --max-tokens 64 \
+        [--dataset sharegpt.json --request-rate 8]
 """
 
 from __future__ import annotations
 
 import argparse
 import json
+import random
 import statistics
 import threading
 import time
@@ -34,15 +45,43 @@ class ThreadStats:
         self.failures = 0
 
 
-def run_thread(base_url: str, model: str, turns: int, max_tokens: int, prompt_seed: str, stats: ThreadStats):
-    messages = []
-    for turn in range(turns):
-        messages.append({"role": "user", "content": f"{prompt_seed} turn {turn}: tell me more."})
+def load_sharegpt(path: str, max_turn_chars: int = 2000) -> list[list[str]]:
+    """ShareGPT-format dataset -> list of conversations, each a list of
+    the HUMAN turns (the model regenerates the assistant side live, as
+    the reference's multi-turn runner does). Accepts the common shapes:
+    [{"conversations": [{"from": "human", "value": ...}, ...]}, ...] and
+    [{"messages": [{"role": "user", "content": ...}, ...]}, ...]."""
+    with open(path) as f:
+        raw = json.load(f)
+    out: list[list[str]] = []
+    for item in raw:
+        msgs = item.get("conversations") or item.get("messages") or []
+        turns = [
+            str(m.get("value") or m.get("content") or "")[:max_turn_chars]
+            for m in msgs
+            if m.get("from") in ("human", "user") or m.get("role") == "user"
+        ]
+        turns = [t for t in turns if t]
+        if turns:
+            out.append(turns)
+    if not out:
+        raise ValueError(f"no usable conversations in {path}")
+    return out
+
+
+def synthetic_turns(seed: str, turns: int) -> list[str]:
+    return [f"{seed} turn {t}: tell me more." for t in range(turns)]
+
+
+def run_conversation(base_url: str, model: str, user_turns: list[str], max_tokens: int, stats: ThreadStats, temperature: float = 0.7):
+    messages: list[dict] = []
+    for content in user_turns:
+        messages.append({"role": "user", "content": content})
         body = {
             "model": model,
             "messages": messages,
             "max_tokens": max_tokens,
-            "temperature": 0.7,
+            "temperature": temperature,
             "stream": True,
         }
         req = urllib.request.Request(
@@ -97,26 +136,49 @@ def pct(values, p):
     return s[min(len(s) - 1, int(len(s) * p / 100))]
 
 
-def main():
-    parser = argparse.ArgumentParser()
-    parser.add_argument("--url", default="http://localhost:8000/openai")
-    parser.add_argument("--model", required=True)
-    parser.add_argument("--threads", type=int, default=8)
-    parser.add_argument("--turns", type=int, default=4)
-    parser.add_argument("--max-tokens", type=int, default=64)
-    args = parser.parse_args()
+def run_benchmark(
+    base_url: str,
+    model: str,
+    conversations: int = 8,
+    turns: int = 4,
+    max_tokens: int = 64,
+    dataset: list[list[str]] | None = None,
+    request_rate: float = 0.0,
+    max_concurrency: int = 0,
+    seed: int = 0,
+    temperature: float = 0.7,
+) -> dict:
+    """Run the load test; returns the summary dict. Library entry point
+    (benchmarks/routing_compare.py drives it per strategy)."""
+    rng = random.Random(seed)
+    convo_turns: list[list[str]] = []
+    for i in range(conversations):
+        if dataset:
+            convo_turns.append(dataset[i % len(dataset)][:turns])
+        else:
+            convo_turns.append(synthetic_turns(f"conversation-{i}", turns))
 
-    stats = [ThreadStats() for _ in range(args.threads)]
-    threads = [
-        threading.Thread(
-            target=run_thread,
-            args=(args.url, args.model, args.turns, args.max_tokens, f"conversation-{i}", stats[i]),
-        )
-        for i in range(args.threads)
-    ]
+    stats = [ThreadStats() for _ in range(conversations)]
+    sem = threading.Semaphore(max_concurrency) if max_concurrency > 0 else None
+
+    def run(i):
+        if sem:
+            sem.acquire()
+        try:
+            run_conversation(base_url, model, convo_turns[i], max_tokens, stats[i], temperature)
+        finally:
+            if sem:
+                sem.release()
+
+    threads = [threading.Thread(target=run, args=(i,), daemon=True) for i in range(conversations)]
     t0 = time.monotonic()
-    for t in threads:
+    for i, t in enumerate(threads):
         t.start()
+        if request_rate > 0 and i < len(threads) - 1:
+            # Open-loop Poisson arrivals (exponential inter-arrival),
+            # like the reference's benchmark_serving --request-rate. No
+            # sleep after the last start — it would inflate elapsed.
+            time.sleep(rng.expovariate(request_rate))
     for t in threads:
         t.join()
     elapsed = time.monotonic() - t0
@@ -128,7 +190,7 @@ def main():
     failures = sum(s.failures for s in stats)
     n_requests = len(lats)
 
-    summary = {
+    return {
         "requests": n_requests,
         "failures": failures,
         "elapsed_s": round(elapsed, 2),
@@ -148,6 +210,41 @@ def main():
             statistics.mean(dt / n for s in stats for dt, n in s.turn_decode) * 1000, 1
         ) if any(s.turn_decode for s in stats) else None,
     }
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--url", default="http://localhost:8000/openai")
+    parser.add_argument("--model", required=True)
+    parser.add_argument(
+        "--conversations", "--threads", type=int, default=8, dest="conversations",
+        help="number of conversations (alias: --threads)",
+    )
+    parser.add_argument("--turns", type=int, default=4)
+    parser.add_argument("--max-tokens", type=int, default=64)
+    parser.add_argument(
+        "--dataset", default=None,
+        help="ShareGPT-format JSON: replay its human turns instead of synthetic prompts",
+    )
+    parser.add_argument(
+        "--request-rate", type=float, default=0.0,
+        help="conversation arrivals per second (Poisson); 0 = all at once",
+    )
+    parser.add_argument(
+        "--max-concurrency", type=int, default=0,
+        help="max conversations in flight (0 = unbounded)",
+    )
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args()
+
+    dataset = load_sharegpt(args.dataset) if args.dataset else None
+    summary = run_benchmark(
+        args.url, args.model,
+        conversations=args.conversations, turns=args.turns,
+        max_tokens=args.max_tokens, dataset=dataset,
+        request_rate=args.request_rate, max_concurrency=args.max_concurrency,
+        seed=args.seed,
+    )
     print(json.dumps(summary, indent=1))
 
 

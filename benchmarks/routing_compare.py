@@ -1,0 +1,167 @@
+"""Routing-strategy comparison: RoundRobin vs LeastLoad vs PrefixHash.
+
+Reproduces the reference's flagship benchmark methodology
+(ref: docs/benchmarks/prefix-aware-load-balancing.md — same multi-turn
+workload against the same replicas under each routing strategy,
+reporting req/s, mean/p50 TTFT, ITL, and token throughput) against THIS
+framework's full local stack: Manager + LocalRuntime spawn N real
+engine-server replicas, and each strategy run only flips the Model's
+loadBalancing.strategy. PrefixHash's edge comes from the engine's
+cross-slot prefix cache: a conversation routed back to the same replica
+skips re-prefilling its history.
+
+    python benchmarks/routing_compare.py [--replicas 2] [--conversations 8]
+        [--turns 3] [--max-tokens 32] [--dataset sharegpt.json] [--json out.json]
+
+CPU note: without a TPU attached this runs the engines on CPU — relative
+strategy differences are meaningful, absolute numbers are not.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def make_tiny_checkpoint() -> str:
+    """HF-format tiny Llama checkpoint for the engine replicas (shared
+    with the e2e suite — one source of truth for the shapes)."""
+    from kubeai_tpu.engine.weights import save_tiny_test_checkpoint
+
+    path = tempfile.mkdtemp(prefix="routing-compare-ckpt-")
+    save_tiny_test_checkpoint(path)
+    return path
+
+
+def run_strategy(mgr, store, ckpt: str, strategy: str, args) -> dict:
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.core_types import KIND_POD
+    from kubeai_tpu.api.model_types import LoadBalancing, Model, ModelSpec, PrefixHash
+    from kubeai_tpu.runtime.store import ObjectMeta
+
+    from benchmarks.loadgen import load_sharegpt, run_benchmark
+
+    name = f"bench-{strategy.lower()}"
+    store.create(
+        mt.KIND_MODEL,
+        Model(
+            meta=ObjectMeta(name=name),
+            spec=ModelSpec(
+                url=f"file://{ckpt}",
+                engine=mt.ENGINE_TPU,
+                resource_profile="cpu:1",
+                min_replicas=args.replicas,
+                args=["--max-seq-len", "1024", "--max-slots", "4"],
+                load_balancing=LoadBalancing(strategy=strategy, prefix_hash=PrefixHash()),
+            ),
+        ),
+    )
+    deadline = time.time() + 240
+    while time.time() < deadline:
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: name})
+        if len(pods) == args.replicas and all(p.status.ready for p in pods):
+            break
+        time.sleep(0.5)
+    else:
+        raise RuntimeError(f"{name}: replicas never became ready")
+
+    from benchmarks.loadgen import synthetic_turns
+
+    dataset = load_sharegpt(args.dataset) if args.dataset else None
+    base_url = f"http://127.0.0.1:{mgr.api.port}/openai"
+    # Warmup: compile prefill/decode on every replica outside the timed
+    # window (the reference's runners discard warmup too). DISJOINT
+    # prompts — warmup must not seed the prefix cache with the timed
+    # run's conversations, or PrefixHash gets a contaminated head start.
+    run_benchmark(
+        base_url, name, conversations=args.replicas * 2, turns=1, max_tokens=4,
+        dataset=[synthetic_turns(f"warmup-{i}", 1) for i in range(args.replicas * 2)],
+    )
+    summary = run_benchmark(
+        base_url,
+        name,
+        conversations=args.conversations,
+        turns=args.turns,
+        max_tokens=args.max_tokens,
+        dataset=dataset,
+        request_rate=args.request_rate,
+        max_concurrency=args.max_concurrency,
+    )
+    summary["strategy"] = strategy
+
+    store.delete(mt.KIND_MODEL, name)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if not store.list(KIND_POD, selector={mt.LABEL_MODEL: name}):
+            break
+        time.sleep(0.2)
+    return summary
+
+
+def render_table(rows: list[dict]) -> str:
+    head = "| strategy | req/s | mean TTFT (ms) | p50 TTFT (ms) | TPOT (ms) | out tok/s |"
+    sep = "|---|---|---|---|---|---|"
+    lines = [head, sep]
+    for r in rows:
+        lines.append(
+            f"| {r['strategy']} | {r['req_per_s']} | {r['ttft_ms']['mean']} "
+            f"| {r['ttft_ms']['p50']} | {r['tpot_ms']} | {r['output_tok_per_s']} |"
+        )
+    return "\n".join(lines)
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--replicas", type=int, default=2)
+    parser.add_argument("--conversations", type=int, default=8)
+    parser.add_argument("--turns", type=int, default=3)
+    parser.add_argument("--max-tokens", type=int, default=32)
+    parser.add_argument("--dataset", default=None, help="ShareGPT-format JSON")
+    parser.add_argument("--request-rate", type=float, default=0.0)
+    parser.add_argument("--max-concurrency", type=int, default=0)
+    parser.add_argument(
+        "--strategies", default="RoundRobin,LeastLoad,PrefixHash",
+        help="comma-separated strategy list",
+    )
+    parser.add_argument("--json", default=None, help="also write results JSON here")
+    args = parser.parse_args()
+
+    from kubeai_tpu.config.system import System
+    from kubeai_tpu.manager import Manager
+
+    import shutil
+
+    ckpt = make_tiny_checkpoint()
+    xla_cache = tempfile.mkdtemp(prefix="routing-compare-xla-")
+    system = System().default_and_validate()
+    mgr = Manager(system, local_runtime=True, host="127.0.0.1", port=0)
+    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        mgr.local_runtime.extra_env["JAX_PLATFORMS"] = "cpu"
+    # Shared persistent compile cache: later strategies' replicas reuse
+    # the first's compiled kernels (identical shapes).
+    mgr.local_runtime.extra_env["KUBEAI_COMPILE_CACHE"] = xla_cache
+    mgr.start()
+    rows = []
+    try:
+        for strategy in [s.strip() for s in args.strategies.split(",") if s.strip()]:
+            print(f"# running {strategy} ...", file=sys.stderr, flush=True)
+            rows.append(run_strategy(mgr, mgr.store, ckpt, strategy, args))
+    finally:
+        mgr.stop()
+        shutil.rmtree(ckpt, ignore_errors=True)
+        shutil.rmtree(xla_cache, ignore_errors=True)
+
+    print(render_table(rows))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(rows, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

@@ -33,6 +33,9 @@ FEATURES = (FEATURE_TEXT_GENERATION, FEATURE_TEXT_EMBEDDING, FEATURE_SPEECH_TO_T
 
 LEAST_LOAD_STRATEGY = "LeastLoad"
 PREFIX_HASH_STRATEGY = "PrefixHash"
+# Benchmark-baseline strategy (the reference compares against a k8s
+# Service's round-robin; here it is first-class and selectable).
+ROUND_ROBIN_STRATEGY = "RoundRobin"
 
 URL_SCHEMES = ("hf", "pvc", "ollama", "s3", "gs", "oss", "file")
 
@@ -160,7 +163,7 @@ def validate_model(m: Model, prev: Model | None = None) -> None:
         raise ValidationError("minReplicas must be <= maxReplicas")
     if s.target_requests < 1:
         raise ValidationError("targetRequests must be >= 1")
-    if s.load_balancing.strategy not in (LEAST_LOAD_STRATEGY, PREFIX_HASH_STRATEGY):
+    if s.load_balancing.strategy not in (LEAST_LOAD_STRATEGY, PREFIX_HASH_STRATEGY, ROUND_ROBIN_STRATEGY):
         raise ValidationError(f"unknown load balancing strategy {s.load_balancing.strategy!r}")
     ph = s.load_balancing.prefix_hash
     if not (100 <= ph.mean_load_percentage):

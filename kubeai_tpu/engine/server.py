@@ -505,6 +505,16 @@ def main(argv=None):
         import jax
 
         jax.config.update("jax_platforms", want)
+    cache_dir = os.environ.get("KUBEAI_COMPILE_CACHE")
+    if cache_dir:
+        # Persistent XLA compilation cache: replicas of the same model
+        # shape skip recompilation (big cold-start cut when the cache dir
+        # is a shared mount; harmless otherwise).
+        import jax
+
+        os.makedirs(cache_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", cache_dir)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
     maybe_init_distributed()
 
     parser = argparse.ArgumentParser("kubeai-tpu-engine")

@@ -133,6 +133,30 @@ def load_engine_from_path(
     return Engine(config, params, tokenizer, ec)
 
 
+def save_tiny_test_checkpoint(path: str, seed: int = 0) -> "ModelConfig":
+    """Write the canonical tiny-Llama HF checkpoint used by e2e tests and
+    benchmarks (one source of truth: the e2e suite and
+    benchmarks/routing_compare.py must exercise the same shapes)."""
+    import torch
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    cfg = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
+        num_heads=4, num_kv_heads=2, dtype="float32",
+    )
+    torch.manual_seed(seed)
+    hf = LlamaForCausalLM(
+        LlamaConfig(
+            vocab_size=256, hidden_size=64, intermediate_size=128,
+            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            tie_word_embeddings=False,
+        )
+    )
+    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
+    save_hf_checkpoint(path, cfg, sd)
+    return cfg
+
+
 def save_hf_checkpoint(path: str, config: ModelConfig, state_dict: dict[str, np.ndarray], tokenizer_src: str | None = None):
     """Write a minimal HF-format checkpoint dir (config.json + one
     safetensors file). Used by tests and the model-loader."""

@@ -21,6 +21,10 @@ from kubeai_tpu.metrics import default_registry
 
 LEAST_LOAD = "LeastLoad"
 PREFIX_HASH = "PrefixHash"
+# Baseline strategy for benchmark comparisons (the reference benchmarks
+# against a k8s Service's round-robin; here it's selectable in-process:
+# docs/benchmarks/prefix-aware-load-balancing.md methodology).
+ROUND_ROBIN = "RoundRobin"
 
 # CHWBL lookup telemetry (parity: the reference's
 # kubeai_inference_requests_hash_lookup_* instruments,
@@ -74,6 +78,7 @@ class EndpointGroup:
         self._endpoints: dict[str, Endpoint] = {}
         self._total_in_flight = 0
         self._generation = 0
+        self._rr_counter = 0
         self._ring = HashRing(replication=chwbl_replication)
 
     # -- balancing ---------------------------------------------------------
@@ -171,6 +176,16 @@ class EndpointGroup:
             )
             _record_chwbl_stats(stats)
             return name
+        if strategy == ROUND_ROBIN:
+            names = sorted(
+                n for n, ep in self._endpoints.items()
+                if (not adapter or adapter in ep.adapters)
+                and (allowed is None or allowed(n))
+            )
+            if not names:
+                return None
+            self._rr_counter += 1
+            return names[self._rr_counter % len(names)]
         if strategy == LEAST_LOAD:
             # Ties broken randomly: retries after an upstream failure must
             # be able to land on a different endpoint (the reference gets

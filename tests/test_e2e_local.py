@@ -27,26 +27,10 @@ from kubeai_tpu.runtime.store import ObjectMeta
 
 @pytest.fixture(scope="module")
 def ckpt_dir(tmp_path_factory):
-    from transformers import LlamaConfig, LlamaForCausalLM
-
-    from kubeai_tpu.engine.weights import save_hf_checkpoint
-    from kubeai_tpu.models.base import ModelConfig
+    from kubeai_tpu.engine.weights import save_tiny_test_checkpoint
 
     path = tmp_path_factory.mktemp("ckpt")
-    cfg = ModelConfig(
-        vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
-        num_heads=4, num_kv_heads=2, dtype="float32",
-    )
-    torch.manual_seed(0)
-    hf = LlamaForCausalLM(
-        LlamaConfig(
-            vocab_size=256, hidden_size=64, intermediate_size=128,
-            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
-            tie_word_embeddings=False,
-        )
-    )
-    sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
-    save_hf_checkpoint(str(path), cfg, sd)
+    save_tiny_test_checkpoint(str(path))
     return str(path)
 
 

@@ -1,0 +1,172 @@
+"""Load generator: ShareGPT replay, rate control, and the RoundRobin
+strategy the routing comparison depends on."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from benchmarks.loadgen import load_sharegpt, run_benchmark, synthetic_turns
+
+
+def test_load_sharegpt_formats(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps([
+        {"conversations": [
+            {"from": "human", "value": "q1"},
+            {"from": "gpt", "value": "a1"},
+            {"from": "human", "value": "q2"},
+        ]},
+        {"messages": [
+            {"role": "user", "content": "m1"},
+            {"role": "assistant", "content": "r1"},
+        ]},
+        {"conversations": []},  # skipped
+    ]))
+    convos = load_sharegpt(str(p))
+    assert convos == [["q1", "q2"], ["m1"]]
+
+
+def test_load_sharegpt_truncates_and_rejects_empty(tmp_path):
+    p = tmp_path / "ds.json"
+    p.write_text(json.dumps([{"conversations": [{"from": "human", "value": "x" * 5000}]}]))
+    convos = load_sharegpt(str(p))
+    assert len(convos[0][0]) == 2000
+    p.write_text("[]")
+    with pytest.raises(ValueError):
+        load_sharegpt(str(p))
+
+
+class _CountingServer:
+    """OpenAI-ish streaming endpoint recording arrival times."""
+
+    def __init__(self):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        outer = self
+        self.arrivals: list[float] = []
+        self.max_concurrent = 0
+        self._active = 0
+        self._lock = threading.Lock()
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                with outer._lock:
+                    outer.arrivals.append(time.monotonic())
+                    outer._active += 1
+                    outer.max_concurrent = max(outer.max_concurrent, outer._active)
+                time.sleep(0.05)
+                chunks = [
+                    b'data: {"choices": [{"delta": {"content": "tok"}}]}\n\n',
+                    b"data: [DONE]\n\n",
+                ]
+                body = b"".join(chunks)
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                with outer._lock:
+                    outer._active -= 1
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.url = f"http://127.0.0.1:{self.httpd.server_port}"
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def test_run_benchmark_summary_and_dataset():
+    srv = _CountingServer()
+    try:
+        summary = run_benchmark(
+            srv.url, "m", conversations=3, turns=2, max_tokens=4,
+            dataset=[["q1", "q2"], ["z1", "z2"]],
+        )
+        assert summary["requests"] == 6
+        assert summary["failures"] == 0
+        assert summary["ttft_ms"]["mean"] is not None
+    finally:
+        srv.stop()
+
+
+def test_request_rate_staggers_arrivals():
+    srv = _CountingServer()
+    try:
+        run_benchmark(
+            srv.url, "m", conversations=6, turns=1, max_tokens=4,
+            request_rate=20.0, seed=42,
+        )
+        # Poisson at 20/s: 6 conversations should span a measurable
+        # window instead of landing simultaneously.
+        spread = max(srv.arrivals) - min(srv.arrivals)
+        assert spread > 0.05, f"arrivals not staggered: {spread}"
+    finally:
+        srv.stop()
+
+
+def test_max_concurrency_bounds_inflight():
+    srv = _CountingServer()
+    try:
+        run_benchmark(
+            srv.url, "m", conversations=8, turns=1, max_tokens=4, max_concurrency=2
+        )
+        assert srv.max_concurrent <= 2
+    finally:
+        srv.stop()
+
+
+def test_round_robin_strategy_cycles():
+    from kubeai_tpu.loadbalancer.group import ROUND_ROBIN, EndpointGroup, Endpoint
+
+    g = EndpointGroup()
+    g.reconcile_endpoints({n: Endpoint(address=n) for n in ("a", "b", "c")})
+    seen = []
+    for _ in range(6):
+        addr, done = g.get_best_addr(ROUND_ROBIN, timeout=1)
+        seen.append(addr)
+        done()
+    # Perfect rotation over sorted endpoints.
+    assert seen == ["b", "c", "a", "b", "c", "a"]
+
+
+def test_round_robin_respects_adapter_and_exclude():
+    from kubeai_tpu.loadbalancer.group import ROUND_ROBIN, EndpointGroup, Endpoint
+
+    g = EndpointGroup()
+    g.reconcile_endpoints({
+        "a": Endpoint(address="a", adapters={"x"}),
+        "b": Endpoint(address="b"),
+    })
+    for _ in range(4):
+        addr, done = g.get_best_addr(ROUND_ROBIN, adapter="x", timeout=1)
+        assert addr == "a"
+        done()
+    addr, done = g.get_best_addr(ROUND_ROBIN, exclude={"a"}, timeout=1)
+    assert addr == "b"
+    done()
+
+
+def test_round_robin_model_validates():
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.model_types import LoadBalancing, Model, ModelSpec, validate_model, default_model
+    from kubeai_tpu.runtime.store import ObjectMeta
+
+    m = Model(
+        meta=ObjectMeta(name="rr"),
+        spec=ModelSpec(
+            url="hf://a/b",
+            load_balancing=LoadBalancing(strategy=mt.ROUND_ROBIN_STRATEGY),
+        ),
+    )
+    default_model(m)
+    validate_model(m)  # must not raise
