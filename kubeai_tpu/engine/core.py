@@ -88,6 +88,15 @@ class EngineConfig:
     # the engine — the reference relies on vLLM's prefix cache for the
     # same effect. 0 disables.
     prefix_cache_min: int = 16
+    # Speculative decoding via device-side n-gram prompt lookup: each
+    # decode step verifies up to this many draft tokens (drafted from a
+    # device-resident token history — chat replies echo their context, so
+    # 2-gram continuation lookup hits often) in ONE forward, amortizing
+    # the weight reads that bound TPU decode. Drafts are accepted only
+    # where the model's greedy choice matches, so greedy output is
+    # byte-identical to non-speculative; sampled (temperature>0) slots
+    # never accept drafts and behave exactly as before. 0 disables.
+    speculate_tokens: int = 0
 
 
 @dataclass
@@ -184,6 +193,12 @@ class Engine:
         self.m_pages_total = default_registry.gauge(
             "kubeai_engine_kv_pages_total", "allocatable KV pool pages"
         )
+        self.m_spec_drafted = default_registry.counter(
+            "kubeai_engine_speculative_drafted_total", "draft tokens proposed"
+        )
+        self.m_spec_accepted = default_registry.counter(
+            "kubeai_engine_speculative_accepted_total", "draft tokens accepted"
+        )
 
         self._init_device_state()
         self._build_step_fns(apply_fns)
@@ -199,6 +214,12 @@ class Engine:
         P = self.cfg.num_pages or (B * self._max_pages + 1)
         self._pool = PagePool(P, ps)
         self._cache = llama.init_paged_cache(self.model_config, P, ps)
+        # Device-resident token history for speculative n-gram drafting
+        # (written positions only; padded past max_seq_len so in-chunk
+        # speculation overshoot after a finish never scatter-collides).
+        G = self.cfg.speculate_tokens
+        hist_width = self.cfg.max_seq_len + (self.cfg.decode_chunk + 1) * (G + 1)
+        self._tok_hist = jnp.zeros((B, hist_width), jnp.int32)
         # Host-authoritative block tables, uploaded per dispatch (tiny).
         self._page_table = np.zeros((B, self._max_pages), np.int32)
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
@@ -296,26 +317,86 @@ class Engine:
             return tok, cache
 
         K = self.cfg.decode_chunk
+        G = self.cfg.speculate_tokens
 
-        def decode_fn(params, cache, tables, lengths, last_tokens, keys, active, temp, top_p, top_k, lora=None, lora_rows=None):
-            """K fused decode+sample steps; returns token ids [K, B]."""
+        def ngram_drafts(hist, lengths, last):
+            """Per-slot 2-gram continuation lookup over the device token
+            history: find the latest previous occurrence of the bigram
+            (hist[L-1], last) and propose the G tokens that followed it.
+            No match (or tail too short) proposes zeros, which simply
+            fail verification. All shapes static; runs inside the scan."""
+            Sh = hist.shape[1]
+            idx = jnp.arange(Sh)
+
+            def one(h, L, a):
+                prev = h[jnp.maximum(L - 1, 0)]
+                nxt = jnp.roll(h, -1)  # nxt[j] = h[j+1]
+                ok = (h == prev) & (nxt == a) & (idx < L - 1) & (L > 0)
+                found = ok.any()
+                j = jnp.argmax(jnp.where(ok, idx, -1))
+                didx = j + 2 + jnp.arange(G)
+                valid = found & (didx < L)
+                return jnp.where(valid, h[jnp.clip(didx, 0, Sh - 1)], 0)
+
+            return jax.vmap(one)(hist, lengths, last)
+
+        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, lora=None, lora_rows=None):
+            """K fused decode steps, each verifying up to G drafts.
+            Returns (drafts [K, B, G], corr [K, B], accepted [K, B]) —
+            the host emits drafts[:a] + [corr] per slot per step, where
+            corr is THE device-chosen next token (greedy: the model's
+            argmax after the accepted drafts; sampled: the sampled
+            token — never substitute argmax, the device decodes from
+            corr so emission must match it). G=0 reduces exactly to
+            one-token-per-step decoding."""
+            B = lengths.shape[0]
 
             def body(carry, _):
-                cache, lengths, last, keys = carry
-                logits, cache = llama.decode_step_paged(
-                    params, mc, last[:, None], cache, tables, lengths,
+                cache, hist, lengths, last, keys = carry
+                if G > 0:
+                    drafts = ngram_drafts(hist, lengths, last)
+                else:
+                    drafts = jnp.zeros((B, 0), jnp.int32)
+                inputs = jnp.concatenate([last[:, None], drafts], axis=1)
+                logits, cache = llama.decode_speculative_paged(
+                    params, mc, inputs, cache, tables, lengths,
                     lora=lora, lora_rows=lora_rows,
                 )
+                logits = mask_pad(logits)  # [B, G+1, V]
+                yhat = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                # Greedy slots accept the longest draft prefix the model
+                # agrees with (exactness by causality); sampled slots
+                # accept nothing and sample position 0 as before.
+                greedy = temp <= 0.0
+                if G > 0:
+                    matches = (yhat[:, :G] == drafts).astype(jnp.int32)
+                    acc = jnp.cumprod(matches, axis=1).sum(axis=1)
+                    acc = jnp.where(greedy & active, acc, 0)
+                else:
+                    acc = jnp.zeros((B,), jnp.int32)
                 step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-                toks = sample(mask_pad(logits[:, -1]), step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk)
-                toks = jnp.where(active, toks, last)
-                lengths = jnp.where(active, lengths + 1, lengths)
-                return (cache, lengths, toks, step_keys[:, 1]), toks
+                sampled0 = sample(
+                    logits[:, 0], step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk
+                )
+                corr = jnp.where(
+                    greedy,
+                    jnp.take_along_axis(yhat, acc[:, None], axis=1)[:, 0],
+                    sampled0,
+                )
+                corr = jnp.where(active, corr, last)
+                # Record the inputs just written into KV at positions
+                # lengths..lengths+G (history width covers overshoot).
+                pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
+                hist = hist.at[jnp.arange(B)[:, None], pos].set(
+                    jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
+                )
+                lengths = jnp.where(active, lengths + acc + 1, lengths)
+                return (cache, hist, lengths, corr, step_keys[:, 1]), (drafts, corr, acc)
 
-            (cache, lengths, last, keys), toks_seq = jax.lax.scan(
-                body, (cache, lengths, last_tokens, keys), None, length=K
+            (cache, hist, lengths, last, keys), (d_seq, c_seq, a_seq) = jax.lax.scan(
+                body, (cache, hist, lengths, last_tokens, keys), None, length=K
             )
-            return toks_seq, cache, lengths, last, keys
+            return d_seq, c_seq, a_seq, cache, hist, lengths, last, keys
 
         if apply_fns is not None:  # test seam
             self._prefill_jit, self._decode_jit = apply_fns(prefill_fn, decode_fn)
@@ -336,8 +417,8 @@ class Engine:
             self._prefill_chunk_jit = jax.jit(prefill_chunk_fn, donate_argnums=(9,))
             self._prefill_batch_jit = jax.jit(prefill_batch_fn, donate_argnums=(8,))
             # tables (arg 2) are host-authoritative and re-uploaded per
-            # dispatch — not donated.
-            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 3, 4, 5))
+            # dispatch — not donated. cache/hist/lengths/last/keys are.
+            self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 3, 4, 5, 6))
 
     # -- public API --------------------------------------------------------
 
@@ -839,6 +920,10 @@ class Engine:
 
         # Register slot in device state: position of the first generated
         # token is prompt_len; decode will write it there.
+        if self.cfg.speculate_tokens > 0:
+            row = np.zeros((self._tok_hist.shape[1],), np.int32)
+            row[: len(ids)] = ids
+            self._tok_hist = self._tok_hist.at[slot_idx].set(jnp.asarray(row))
         self._lengths = self._lengths.at[slot_idx].set(len(ids))
         self._last_tokens = self._last_tokens.at[slot_idx].set(tok)
         self._active = self._active.at[slot_idx].set(True)
@@ -908,10 +993,14 @@ class Engine:
         lora_args = {}
         if self._adapters is not None:
             lora_args = {"lora": self._adapters.bank, "lora_rows": self._lora_rows}
-        toks_seq, self._cache, self._lengths, self._last_tokens, self._keys = self._decode_jit(
+        (
+            d_seq, y_seq, a_seq,
+            self._cache, self._tok_hist, self._lengths, self._last_tokens, self._keys,
+        ) = self._decode_jit(
             self.params,
             self._cache,
             jnp.asarray(self._page_table),
+            self._tok_hist,
             self._lengths,
             self._last_tokens,
             self._keys,
@@ -924,24 +1013,40 @@ class Engine:
         snapshot = [
             (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        return toks_seq, snapshot
+        return (d_seq, y_seq, a_seq), snapshot
 
-    def _process_chunk(self, toks_seq, snapshot):
-        tok_host = np.asarray(jax.device_get(toks_seq))  # [K, B]
-        for k in range(tok_host.shape[0]):
+    def _process_chunk(self, payload, snapshot):
+        d_seq, c_seq, a_seq = payload
+        drafts = np.asarray(jax.device_get(d_seq))  # [K, B, G]
+        corr = np.asarray(jax.device_get(c_seq))  # [K, B]
+        acc = np.asarray(jax.device_get(a_seq))  # [K, B]
+        G = drafts.shape[2]
+        for k in range(acc.shape[0]):
             for i, slot_obj, epoch in snapshot:
-                # Record KV residency for prefix reuse: the step WROTE the
-                # pending (input) token; its sampled output becomes the
-                # next step's write. Skip if a new occupant reset the slot.
-                if self._slot_epoch[i] == epoch:
-                    if self._kv_pending[i] is not None:
-                        self._kv_history[i].append(self._kv_pending[i])
-                    self._kv_pending[i] = int(tok_host[k, i])
-                # Emit only while the slot still belongs to the request it
-                # held at dispatch time (it may finish mid-chunk, or have
-                # been freed and re-admitted since dispatch).
-                if self._slots[i] is slot_obj:
-                    self._emit_token(i, int(tok_host[k, i]))
+                a = int(acc[k, i])
+                # Accepted drafts then the device-chosen next token (the
+                # model's continuation input — greedy argmax OR sampled).
+                emitted = [int(drafts[k, i, j]) for j in range(a)]
+                emitted.append(int(corr[k, i]))
+                if G and self._slots[i] is slot_obj and self._slots[i] is not None \
+                        and self._slots[i].req.params.temperature <= 0.0:
+                    self.m_spec_drafted.inc(G)
+                    self.m_spec_accepted.inc(a)
+                for tok in emitted:
+                    # Record KV residency for prefix reuse: each step
+                    # WROTE its pending (input) token; each emitted token
+                    # becomes the next write. Skip if a new occupant
+                    # reset the slot.
+                    if self._slot_epoch[i] == epoch:
+                        if self._kv_pending[i] is not None:
+                            self._kv_history[i].append(self._kv_pending[i])
+                        self._kv_pending[i] = tok
+                    # Emit only while the slot still belongs to the
+                    # request it held at dispatch time (it may finish
+                    # mid-chunk, or have been freed and re-admitted
+                    # since dispatch).
+                    if self._slots[i] is slot_obj:
+                        self._emit_token(i, tok)
 
     def _emit_token(self, slot_idx: int, token_id: int):
         """Deliver one generated token to the request; apply stop logic."""

@@ -453,6 +453,7 @@ def build_engine_from_args(args) -> tuple[Engine, str]:
         page_size=getattr(args, "page_size", 64),
         num_pages=getattr(args, "kv_pages", 0),
         prefix_cache_min=getattr(args, "prefix_cache_min", 16),
+        speculate_tokens=getattr(args, "speculate_tokens", 0),
     )
     if args.model.startswith("test:"):
         eng = build_test_engine(engine_config=ec)
@@ -536,6 +537,11 @@ def main(argv=None):
     parser.add_argument(
         "--prefix-cache-min", type=int, default=16,
         help="min shared-prefix tokens to reuse across slots (0 disables)",
+    )
+    parser.add_argument(
+        "--speculate-tokens", type=int, default=0,
+        help="draft tokens verified per decode step via n-gram prompt "
+             "lookup (greedy-exact; 0 disables)",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)

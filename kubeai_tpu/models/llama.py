@@ -626,3 +626,18 @@ def decode_step_paged(params, config, tokens, pool, page_table, lengths, lora=No
         params, config, tokens, lengths[:, None].astype(jnp.int32), pool,
         lora=lora, lora_rows=lora_rows, page_table=page_table,
     )
+
+
+def decode_speculative_paged(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None):
+    """Speculative paged decode: [B, S] candidate tokens (real next token
+    + S-1 drafts) at positions lengths..lengths+S-1. Returns logits for
+    ALL S positions ([B, S, V], for draft verification) and the pool.
+    Causality makes verification exact: logits at position j depend only
+    on inputs 0..j, so a draft mismatch at j invalidates positions > j
+    without contaminating <= j."""
+    S = tokens.shape[1]
+    pos = lengths[:, None].astype(jnp.int32) + jnp.arange(S, dtype=jnp.int32)[None, :]
+    return apply(
+        params, config, tokens, pos, pool,
+        lora=lora, lora_rows=lora_rows, page_table=page_table,
+    )
