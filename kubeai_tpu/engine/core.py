@@ -728,7 +728,7 @@ class Engine:
                 # (poisoned). Requests drained from the queue but not yet
                 # prefilled would otherwise be silently dropped (their
                 # callers would hang): error them out before raising.
-                kbuf = self._cache["k"]
+                kbuf = self._cache["kv"]
                 if poisoned or getattr(kbuf, "is_deleted", lambda: False)():
                     for later_items, _ in work[w + 1 :]:
                         for slot_idx, req in later_items:
@@ -1028,8 +1028,8 @@ class Engine:
                 # model's continuation input — greedy argmax OR sampled).
                 emitted = [int(drafts[k, i, j]) for j in range(a)]
                 emitted.append(int(corr[k, i]))
-                if G and self._slots[i] is slot_obj and self._slots[i] is not None \
-                        and self._slots[i].req.params.temperature <= 0.0:
+                if G and self._slots[i] is slot_obj \
+                        and slot_obj.req.params.temperature <= 0.0:
                     self.m_spec_drafted.inc(G)
                     self.m_spec_accepted.inc(a)
                 for tok in emitted:

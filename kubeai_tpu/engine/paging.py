@@ -1,7 +1,8 @@
 """Host-side paged-KV allocator: block tables, refcounts, prefix cache.
 
-The engine's KV cache is a pool of fixed-size pages ([L, Kv, P, page,
-h] on device, `models/llama.py::init_paged_cache`); this module owns
+The engine's KV cache is a pool of fixed-size pages (one combined
+{"kv": [L, P, page, 2*Kv, h]} array with K/V interleaved on the head
+axis, `models/llama.py::init_paged_cache`); this module owns
 the *host* bookkeeping: which pages are free, which are referenced by
 live slots, and which hold content-addressed full pages reusable as
 shared prefixes across slots (the cross-slot upgrade over round 1's

@@ -47,9 +47,11 @@ class ModelConfig:
     # Use the Pallas flash-attention kernel for prefill (set by the engine
     # on TPU; only valid without softcap/sliding-window).
     use_flash_prefill: bool = False
-    # Use the Pallas paged-attention kernel for decode over the paged KV
-    # pool (set by the engine on TPU; only valid without sliding-window —
-    # softcap is supported). The portable path gathers pages via XLA.
+    # Use the ragged paged-attention kernel over the paged KV pool for
+    # decode AND speculative verification (set by the engine on TPU;
+    # only valid without sliding-window — softcap is supported). The
+    # portable path gathers pages via XLA; on CPU the kernel path runs
+    # a jit-safe semantics twin.
     use_paged_kernel: bool = False
     dtype: str = "bfloat16"
 

@@ -178,16 +178,18 @@ def init_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> Par
 
 
 def init_paged_cache(config: ModelConfig, num_pages: int, page_size: int, dtype=None) -> Params:
-    """Paged KV pool: [L, Kv, P, page_size, head_dim]. Sequences map onto
-    pages through a per-slot block table ([B, max_pages] int32 of pool
-    indices); page 0 is the engine's trash page (see engine/paging.py).
-    The [Kv, P, page, h] per-layer layout matches the TPU paged-attention
-    kernel's expected [num_kv_heads, total_pages, page_size, head_dim]."""
+    """Paged KV pool: one combined array [L, P, page, 2*Kv, head_dim]
+    with K/V interleaved on the head axis (K at even indices, V at odd
+    — the TPU ragged-paged-attention kernel's native layout, so prefill,
+    decode, and speculative verification all read pages in place with
+    zero re-layout). Sequences map onto pages through a per-slot block
+    table ([B, max_pages] int32 of pool indices); page 0 is the engine's
+    trash page (see engine/paging.py)."""
     dtype = dtype or jnp.dtype(config.dtype)
     shape = (
-        config.num_layers, config.num_kv_heads, num_pages, page_size, config.head_dim_,
+        config.num_layers, num_pages, page_size, 2 * config.num_kv_heads, config.head_dim_,
     )
-    return {"k": jnp.zeros(shape, dtype), "v": jnp.zeros(shape, dtype)}
+    return {"kv": jnp.zeros(shape, dtype)}
 
 
 # ---------------------------------------------------------------------------
@@ -334,18 +336,20 @@ def apply(
         and config.attn_softcap == 0.0
         and config.sliding_window == 0
     )
-    # Paged decode kernel: single-token queries over the block-table
-    # pool, TPU only (no interpret path), no sliding window.
+    # Paged attention kernel (ragged: handles 1..S queries per slot, so
+    # plain decode AND speculative verification read pages in place);
+    # per-layer sliding-window interleaves can't use one static kernel
+    # window, so Gemma2-style configs fall back to the gather path.
     use_paged_kernel = (
         config.use_paged_kernel
         and page_table is not None
-        and S == 1
         and config.sliding_window == 0
+        and not use_flash
     )
 
     paged = page_table is not None
     if paged:
-        page = cache["k"].shape[3]
+        page = cache["kv"].shape[2]
         max_pages = page_table.shape[1]
         skv = max_pages * page
         key_positions = jnp.arange(skv)[None, None, :]  # [1, 1, Skv]
@@ -380,7 +384,7 @@ def apply(
     batch_idx = jnp.arange(B)[:, None]
     rows = batch_idx if cache_rows is None else cache_rows[:, None]
 
-    def layer(x, w, k_cache_l, v_cache_l, lora_l=None, sliding=None):
+    def layer(x, w, k_cache_l, v_cache_l, kv_pool_l=None, lora_l=None, sliding=None):
         def proj(inp, name):
             out = qdot(inp, w[name])
             # KeyError at trace time if a qkv_bias config meets a tree
@@ -402,15 +406,16 @@ def apply(
         v = proj(attn_in, "wv").reshape(B, S, Kv, h)
         q, k = apply_rope(q, k, positions, inv_freq)
 
-        if k_cache_l is not None and paged:
-            # k_cache_l: [Kv, P, page, h]; scatter new K/V through the
-            # block table. Decode on TPU reads pages in place via the
-            # Pallas paged-attention kernel; the portable path gathers
-            # each row's pages into a contiguous [B, Skv, Kv, h] view.
-            k_full = k_cache_l.at[:, w_pages, w_offs].set(k.transpose(2, 0, 1, 3))
-            v_full = v_cache_l.at[:, w_pages, w_offs].set(v.transpose(2, 0, 1, 3))
+        if kv_pool_l is not None:
+            # kv_pool_l: [P, page, 2Kv, h], K/V interleaved on the head
+            # axis (kernel-native). One scatter writes both through the
+            # block table; the kernel (or CPU reference) reads pages in
+            # place, and the portable fallback gathers a contiguous view.
+            interleaved = jnp.stack([k, v], axis=3).reshape(B, S, 2 * Kv, h)
+            kv_full = kv_pool_l.at[w_pages, w_offs].set(interleaved)
+            k_full = v_full = None
             if use_paged_kernel or use_flash:
-                # Neither path reads the gathered view: the decode kernel
+                # Neither path reads the gathered view: the ragged kernel
                 # walks pages in place, and flash prefill (left-aligned,
                 # positions arange(S)) attends exactly the just-computed
                 # k/v — gathering the full table width only to slice S
@@ -418,8 +423,9 @@ def apply(
                 # KV bytes per layer.
                 k_att = v_att = None
             else:
-                k_att = k_full[:, page_table].transpose(1, 2, 3, 0, 4).reshape(B, skv, Kv, h)
-                v_att = v_full[:, page_table].transpose(1, 2, 3, 0, 4).reshape(B, skv, Kv, h)
+                gathered = kv_full[page_table]  # [B, mp, page, 2Kv, h]
+                k_att = gathered[..., 0::2, :].reshape(B, skv, Kv, h)
+                v_att = gathered[..., 1::2, :].reshape(B, skv, Kv, h)
         elif k_cache_l is not None:
             k_full = k_cache_l.at[rows, positions].set(k)
             v_full = v_cache_l.at[rows, positions].set(v)
@@ -432,11 +438,11 @@ def apply(
             k_att, v_att = k, v
 
         if use_paged_kernel:
-            from kubeai_tpu.ops.paged_attention import paged_decode_attention
+            from kubeai_tpu.ops.paged_attention import paged_attention_ragged
 
-            attn_out = paged_decode_attention(
-                q, k_full, v_full, page_table,
-                kv_lengths=positions[:, 0] + 1,  # keys 0..pos inclusive
+            attn_out = paged_attention_ragged(
+                q, kv_full, page_table,
+                kv_lengths=positions[:, -1] + 1,  # keys 0..last pos inclusive
                 scale=config.query_scale,
                 softcap=config.attn_softcap,
             )
@@ -475,18 +481,29 @@ def apply(
         if config.post_norms:
             m = norm(m, "ln2b")
         x = x + m
-        return x, (k_full, v_full)
+        cache_out = kv_full if kv_pool_l is not None else (k_full, v_full)
+        return x, cache_out
 
     # Per-layer lora slices ride the scan xs (leading dim L).
     lora_xs = None
     if lora is not None:
         lora_xs = {k: v for k, v in lora.items() if k != "scale"}
 
-    if cache is not None:
+    if cache is not None and paged:
+
+        def step_paged(x, xs):
+            w, kvp, lora_l, sliding = xs
+            return layer(x, w, None, None, kvp, lora_l, sliding)
+
+        x, new_kv = jax.lax.scan(
+            step_paged, x, (params["layers"], cache["kv"], lora_xs, sliding_flags)
+        )
+        new_cache = {"kv": new_kv}
+    elif cache is not None:
 
         def step(x, xs):
             w, kc, vc, lora_l, sliding = xs
-            return layer(x, w, kc, vc, lora_l, sliding)
+            return layer(x, w, kc, vc, None, lora_l, sliding)
 
         x, (new_k, new_v) = jax.lax.scan(
             step, x, (params["layers"], cache["k"], cache["v"], lora_xs, sliding_flags)
@@ -496,7 +513,7 @@ def apply(
 
         def step_nocache(x, xs):
             w, lora_l, sliding = xs
-            x, _ = layer(x, w, None, None, lora_l, sliding)
+            x, _ = layer(x, w, None, None, None, lora_l, sliding)
             return x, None
 
         x, _ = jax.lax.scan(step_nocache, x, (params["layers"], lora_xs, sliding_flags))

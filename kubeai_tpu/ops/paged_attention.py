@@ -1,59 +1,91 @@
-"""Paged decode attention for TPU.
+"""Paged attention for TPU: in-place page reads for prefill, decode,
+and speculative verification.
 
-Decode attention over the paged KV pool without materializing a
-gathered per-slot view: the Pallas kernel walks each sequence's block
-table and streams pages HBM->VMEM with double-buffered async copies, so
-KV bytes are read exactly once (the portable XLA path in
-models/llama.py gathers pages into a contiguous view first, costing a
-second pass over the KV bytes — acceptable on CPU tests, wasteful on a
-bandwidth-bound TPU decode step).
+Wraps JAX's ragged-paged-attention Pallas kernel (the vLLM-TPU
+workhorse): KV lives as [P, page, 2*Kv, h] pages with K/V interleaved
+on the head axis, a block table maps each slot's positions onto pages,
+and queries of ANY length per slot (1 for plain decode, G+1 for
+speculative verification, a whole bucket for prefill) attend causally
+with pages streamed HBM->VMEM — no gathered contiguous copy of the KV
+span (the portable XLA path in models/llama.py gathers; acceptable on
+CPU tests, wasteful on a bandwidth-bound TPU).
 
-Backed by JAX's library kernel
-(jax.experimental.pallas.ops.tpu.paged_attention); this wrapper adapts
-the engine's conventions: q scaling (the kernel computes raw qk),
-[B, 1, H, h] query shape, and a compute-block size that divides the
-table width. TPU-only — callers gate on backend (the kernel has no
-interpret path) and fall back to the gather view elsewhere.
+On non-TPU backends this dispatches to the library's pure-JAX reference
+implementation (identical semantics), so the engine's kernel path is
+CPU-testable end-to-end.
 """
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 
-def _compute_block(pages_per_sequence: int, want: int = 8) -> int:
-    """Largest divisor of pages_per_sequence that is <= want (the kernel
-    requires pages_per_sequence % pages_per_compute_block == 0)."""
-    for cand in range(min(want, pages_per_sequence), 0, -1):
-        if pages_per_sequence % cand == 0:
-            return cand
-    return 1
-
-
-def paged_decode_attention(
-    q: jnp.ndarray,  # [B, 1, H, h] single-token queries
-    k_pages: jnp.ndarray,  # [Kv, P, page, h]
-    v_pages: jnp.ndarray,  # [Kv, P, page, h]
+def paged_attention_ragged(
+    q: jnp.ndarray,  # [B, S, H, h] queries (the slots' newest S tokens)
+    kv_pages: jnp.ndarray,  # [P, page, 2*Kv, h] (K even, V odd)
     page_table: jnp.ndarray,  # [B, max_pages] int32
-    kv_lengths: jnp.ndarray,  # [B] int32 — number of VALID kv tokens
+    kv_lengths: jnp.ndarray,  # [B] int32 — valid keys INCLUDING the S new tokens
     scale: float | None = None,
     softcap: float = 0.0,
 ) -> jnp.ndarray:
-    """Returns [B, 1, H, h] attention output."""
-    from jax.experimental.pallas.ops.tpu.paged_attention import paged_attention
-
+    """Returns [B, S, H, h] attention output."""
     B, S, H, h = q.shape
-    assert S == 1, "paged kernel is decode-only (S=1)"
+    max_pages = page_table.shape[1]
+    page = kv_pages.shape[1]
     if scale is None:
         scale = h**-0.5
-    qk = (q[:, 0] * scale).astype(q.dtype)  # kernel computes raw q.k
-    out = paged_attention(
-        qk,
-        k_pages,
-        v_pages,
-        kv_lengths.astype(jnp.int32),
-        page_table.astype(jnp.int32),
-        pages_per_compute_block=_compute_block(page_table.shape[1]),
-        attn_logits_soft_cap=softcap if softcap > 0.0 else None,
+
+    q_flat = q.reshape(B * S, H, h)
+    cu_q_lens = (jnp.arange(B + 1, dtype=jnp.int32) * S)
+    # Overrun guard: a finished slot's positions may run past the table
+    # span (writes went to the trash page); clamp so the kernel never
+    # walks past the table width.
+    kv_lens = jnp.minimum(kv_lengths, max_pages * page).astype(jnp.int32)
+    num_seqs = jnp.asarray([B], jnp.int32)
+
+    if jax.default_backend() == "cpu":
+        fn = _cpu_twin
+    else:
+        from jax.experimental.pallas.ops.tpu.ragged_paged_attention import (
+            ragged_paged_attention,
+        )
+
+        fn = ragged_paged_attention
+    # One argument construction for BOTH arms (the twin is signature-
+    # identical to the kernel), so CPU tests exercise the exact call the
+    # TPU makes.
+    out = fn(
+        q_flat, kv_pages, kv_lens, page_table.astype(jnp.int32),
+        cu_q_lens, num_seqs,
+        sm_scale=float(scale),
+        soft_cap=softcap if softcap > 0.0 else None,
     )
-    return out[:, None].astype(q.dtype)
+    return out.reshape(B, S, H, h).astype(q.dtype)
+
+
+def _cpu_twin(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None):
+    """Jit-safe semantics twin of ragged_paged_attention, with the SAME
+    signature (the library's pure-JAX reference uses Python loops over
+    traced bounds, so it only runs eagerly; tests compare this twin
+    against it with concrete values). Assumes the wrapper's uniform
+    query split (cu_q_lens = arange * S)."""
+    from kubeai_tpu.ops.attention import attention
+
+    del num_seqs  # every table row is a live slot in the engine's usage
+    B = int(page_indices.shape[0])
+    S = q_flat.shape[0] // B
+    H, h = q_flat.shape[1], q_flat.shape[2]
+    max_pages = page_indices.shape[1]
+    page = kv_pages.shape[1]
+    Kv = kv_pages.shape[2] // 2
+    q = q_flat.reshape(B, S, H, h)
+    gathered = kv_pages[page_indices]  # [B, mp, page, 2Kv, h]
+    skv = max_pages * page
+    k_att = gathered[..., 0::2, :].reshape(B, skv, Kv, h)
+    v_att = gathered[..., 1::2, :].reshape(B, skv, Kv, h)
+    pos_q = kv_lens[:, None] - S + jnp.arange(S, dtype=jnp.int32)[None, :]
+    mask = jnp.arange(skv)[None, None, :] <= pos_q[:, :, None]
+    return attention(
+        q, k_att, v_att, mask, scale=sm_scale, softcap=soft_cap or 0.0
+    ).reshape(B * S, H, h)

@@ -1,67 +1,125 @@
-"""Paged decode-attention kernel vs the portable gather path.
-
-Runs the TPU Pallas kernel under pltpu.force_tpu_interpret_mode() on
-CPU. The kernel computes with KV in bf16 (a no-op for the engine's real
-bf16 pools; see paged_attention_kernel's _maybe_dequantize), so the
-reference casts KV through bf16 too."""
+"""Paged attention (ragged, interleaved-KV layout) vs the library's
+pure-JAX reference implementation — the authoritative oracle for the
+TPU kernel's semantics, run eagerly with concrete values."""
 
 import numpy as np
 import pytest
 
 import jax
 import jax.numpy as jnp
-from jax.experimental.pallas import tpu as pltpu
 
-from kubeai_tpu.ops.attention import attention
-from kubeai_tpu.ops.paged_attention import _compute_block, paged_decode_attention
+from kubeai_tpu.ops.paged_attention import paged_attention_ragged
 
 
-def test_compute_block_divides():
-    for mp in (1, 2, 3, 4, 6, 8, 16, 20):
-        cb = _compute_block(mp)
-        assert mp % cb == 0 and 1 <= cb <= 8
+def _ref(q_flat, kv_pages, kv_lens, table, cu, n, scale, softcap):
+    from jax.experimental.pallas.ops.tpu.ragged_paged_attention.kernel import (
+        ref_ragged_paged_attention,
+    )
+
+    return ref_ragged_paged_attention(
+        q_flat, kv_pages, kv_lens, table, cu, n,
+        sm_scale=scale, soft_cap=softcap,
+    )
 
 
 @pytest.mark.parametrize(
-    "B,H,Kv,lens",
+    "B,S,H,Kv,lens,softcap",
     [
-        (1, 8, 2, [64]),          # full table, grouped heads
-        (2, 8, 2, [37, 52]),      # partial lengths, batch
-        (1, 16, 2, [41]),         # groups == 8 (non-reshape kernel path)
-        (2, 4, 4, [1, 64]),       # MHA-ish, extreme lengths
+        (2, 1, 8, 2, [17, 42], None),      # plain decode
+        (2, 4, 8, 2, [19, 45], None),      # speculative (G=3)
+        (1, 16, 4, 4, [16], None),         # prefill-sized query block
+        (2, 2, 4, 2, [30, 61], 30.0),      # softcap
+        (3, 1, 16, 2, [1, 33, 64], None),  # extreme lengths
     ],
 )
-def test_paged_kernel_matches_gather_path(B, H, Kv, lens):
-    h, P, ps, mp = 128, 1 + 8 * 4, 16, 4
+def test_wrapper_matches_library_reference(B, S, H, Kv, lens, softcap):
+    h, P, ps, mp = 128, 1 + 3 * 4, 16, 4
     rng = np.random.default_rng(0)
-    q = jnp.asarray(rng.standard_normal((B, 1, H, h)), jnp.float32)
-    kp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
-    vp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
+    q = jnp.asarray(rng.standard_normal((B, S, H, h)), jnp.float32)
+    kv_pages = jnp.asarray(rng.standard_normal((P, ps, 2 * Kv, h)), jnp.float32)
     table = jnp.asarray(
         rng.choice(np.arange(1, P), size=(B, mp), replace=False).astype(np.int32)
     )
-    kv_len = jnp.asarray(lens, jnp.int32)
+    kv_lens = jnp.asarray(lens, jnp.int32)
+    scale = h**-0.5
 
-    # Reference: gather + masked dense attention, KV rounded through
-    # bf16 to match the kernel's internal compute dtype.
-    kb = kp.astype(jnp.bfloat16).astype(jnp.float32)
-    vb = vp.astype(jnp.bfloat16).astype(jnp.float32)
-    k_att = kb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
-    v_att = vb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
-    mask = jnp.arange(mp * ps)[None, None, :] < kv_len[:, None, None]
-    want = attention(q, k_att, v_att, mask)
-
-    with pltpu.force_tpu_interpret_mode():
-        got = paged_decode_attention(q, kp, vp, table, kv_len)
-    np.testing.assert_allclose(
-        np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-3
+    got = paged_attention_ragged(
+        q, kv_pages, table, kv_lens, softcap=softcap or 0.0
     )
+    want = _ref(
+        q.reshape(B * S, H, h), kv_pages, kv_lens, table,
+        jnp.arange(B + 1, dtype=jnp.int32) * S, jnp.asarray([B], jnp.int32),
+        scale, softcap,
+    ).reshape(B, S, H, h)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5)
+
+
+def test_tpu_dispatch_arm_builds_identical_call(monkeypatch):
+    """The TPU arm must invoke the library kernel with EXACTLY the
+    arguments the (tested) CPU twin receives: stub the kernel import and
+    a non-cpu backend, record the call, and replay it through the twin."""
+    import kubeai_tpu.ops.paged_attention as pa
+
+    recorded = {}
+
+    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None):
+        recorded.update(
+            q=q_flat, pages=kv_pages, lens=kv_lens, table=page_indices,
+            cu=cu_q_lens, n=num_seqs, scale=sm_scale, cap=soft_cap,
+        )
+        return pa._cpu_twin(
+            q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,
+            sm_scale=sm_scale, soft_cap=soft_cap,
+        )
+
+    import jax.experimental.pallas.ops.tpu.ragged_paged_attention as lib
+
+    monkeypatch.setattr(lib, "ragged_paged_attention", fake_kernel)
+    monkeypatch.setattr(pa.jax, "default_backend", lambda: "tpu")
+
+    B, S, H, Kv, h, P, ps, mp = 2, 3, 4, 2, 128, 9, 16, 4
+    rng = np.random.default_rng(3)
+    q = jnp.asarray(rng.standard_normal((B, S, H, h)), jnp.float32)
+    kv_pages = jnp.asarray(rng.standard_normal((P, ps, 2 * Kv, h)), jnp.float32)
+    table = jnp.asarray(np.arange(1, 1 + B * mp, dtype=np.int32).reshape(B, mp))
+    kv_lens = jnp.asarray([10, 30], jnp.int32)
+
+    got = pa.paged_attention_ragged(q, kv_pages, table, kv_lens, softcap=25.0)
+
+    assert recorded["q"].shape == (B * S, H, h)
+    np.testing.assert_array_equal(np.asarray(recorded["cu"]), np.arange(B + 1) * S)
+    np.testing.assert_array_equal(np.asarray(recorded["lens"]), [10, 30])
+    np.testing.assert_array_equal(np.asarray(recorded["n"]), [B])
+    assert recorded["scale"] == pytest.approx(h**-0.5)
+    assert recorded["cap"] == 25.0
+
+    # And the backend-dispatched result equals the plain CPU-arm result.
+    monkeypatch.setattr(pa.jax, "default_backend", lambda: "cpu")
+    want = pa.paged_attention_ragged(q, kv_pages, table, kv_lens, softcap=25.0)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
+
+
+def test_wrapper_clamps_overrun_lengths():
+    """kv_lengths past the table span (post-finish decode overrun) must
+    clamp instead of reading out of bounds."""
+    B, S, H, Kv, h, P, ps, mp = 1, 1, 4, 2, 128, 9, 16, 4
+    rng = np.random.default_rng(1)
+    q = jnp.asarray(rng.standard_normal((B, S, H, h)), jnp.float32)
+    kv_pages = jnp.asarray(rng.standard_normal((P, ps, 2 * Kv, h)), jnp.float32)
+    table = jnp.asarray(np.arange(1, 5).reshape(1, mp).astype(np.int32))
+    got = paged_attention_ragged(
+        q, kv_pages, table, jnp.asarray([mp * ps + 7], jnp.int32)
+    )
+    want = paged_attention_ragged(
+        q, kv_pages, table, jnp.asarray([mp * ps], jnp.int32)
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want), rtol=1e-6)
 
 
 def test_decode_step_paged_kernel_wiring():
-    """llama.decode_step_paged with use_paged_kernel=True must match the
-    gather path (validates the kv_lengths=pos+1 and scale plumbing in
-    apply(), not just the op)."""
+    """llama decode with use_paged_kernel=True must match the gather path
+    for single AND multi-token (speculative) queries — validates the
+    kv_lengths=last_pos+1 and scale plumbing in apply()."""
     from kubeai_tpu.models import llama
     from kubeai_tpu.models.base import ModelConfig
 
@@ -74,48 +132,20 @@ def test_decode_step_paged_kernel_wiring():
     rng = np.random.default_rng(2)
     B, ps, mp = 2, 16, 4
     pool = llama.init_paged_cache(cfg, num_pages=1 + B * mp, page_size=ps)
-    table = jnp.asarray(
-        np.arange(1, 1 + B * mp, dtype=np.int32).reshape(B, mp)
-    )
+    table = jnp.asarray(np.arange(1, 1 + B * mp, dtype=np.int32).reshape(B, mp))
     lengths = jnp.asarray([3, 7], jnp.int32)
-    # Prefill some context first so decode attends over real KV.
     toks = jnp.asarray(rng.integers(1, 200, (B, 16)), jnp.int32)
     _, pool = llama.prefill_paged_cold(params, cfg, toks, pool, table, lengths)
 
-    step_tok = jnp.asarray(rng.integers(1, 200, (B, 1)), jnp.int32)
-    logits_ref, _ = llama.decode_step_paged(
-        params, cfg, step_tok, {k: v.copy() for k, v in pool.items()}, table, lengths
-    )
     cfg_k = cfg.replace(use_paged_kernel=True)
-    with pltpu.force_tpu_interpret_mode():
-        logits_kern, _ = llama.decode_step_paged(
-            params, cfg_k, step_tok, pool, table, lengths
+    for S in (1, 3):
+        step_tok = jnp.asarray(rng.integers(1, 200, (B, S)), jnp.int32)
+        ref_logits, _ = llama.decode_speculative_paged(
+            params, cfg, step_tok, {k: v.copy() for k, v in pool.items()}, table, lengths
         )
-    np.testing.assert_allclose(
-        np.asarray(logits_kern), np.asarray(logits_ref), rtol=5e-2, atol=5e-2
-    )
-
-
-def test_paged_kernel_applies_scale_and_softcap():
-    B, H, Kv, h, P, ps, mp = 1, 4, 2, 128, 9, 16, 4
-    rng = np.random.default_rng(1)
-    q = jnp.asarray(rng.standard_normal((B, 1, H, h)), jnp.float32)
-    kp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
-    vp = jnp.asarray(rng.standard_normal((Kv, P, ps, h)), jnp.float32)
-    table = jnp.asarray(np.arange(1, 5).reshape(B, mp).astype(np.int32))
-    kv_len = jnp.asarray([50], jnp.int32)
-
-    kb = kp.astype(jnp.bfloat16).astype(jnp.float32)
-    vb = vp.astype(jnp.bfloat16).astype(jnp.float32)
-    k_att = kb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
-    v_att = vb[:, table].transpose(1, 2, 3, 0, 4).reshape(B, mp * ps, Kv, h)
-    mask = jnp.arange(mp * ps)[None, None, :] < kv_len[:, None, None]
-    want = attention(q, k_att, v_att, mask, scale=0.25, softcap=30.0)
-
-    with pltpu.force_tpu_interpret_mode():
-        got = paged_decode_attention(
-            q, kp, vp, table, kv_len, scale=0.25, softcap=30.0
+        kern_logits, _ = llama.decode_speculative_paged(
+            params, cfg_k, step_tok, {k: v.copy() for k, v in pool.items()}, table, lengths
         )
-    np.testing.assert_allclose(
-        np.asarray(got), np.asarray(want), rtol=2e-2, atol=2e-3
-    )
+        np.testing.assert_allclose(
+            np.asarray(kern_logits), np.asarray(ref_logits), rtol=2e-4, atol=2e-4
+        )
