@@ -112,8 +112,9 @@ class Request:
     params: SamplingParams
     adapter: str | None = None
     out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
-    # events on `out`: ("token", id, text_delta) | ("done", FinishInfo) |
-    # ("error", message)
+    # events on `out`: ("token", id, text_delta, logprob) |
+    # ("done", FinishInfo) | ("error", message). id -1 = text-only flush
+    # (held-back chars; logprob None).
     cancelled: threading.Event = field(default_factory=threading.Event)
     arrival: float = field(default_factory=time.monotonic)
 
@@ -275,21 +276,19 @@ class Engine:
         mtk = self.cfg.max_top_k
 
         def prefill_fn(params, tokens, length, table, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
-            """Cold single-prompt prefill through block table [1, max_pages]."""
+            """Cold single-prompt prefill through block table [1, max_pages].
+            Returns (token, its logprob, cache)."""
             logits, cache = llama.prefill_paged_cold(
                 params, mc, tokens, cache, table, length[None],
                 lora=lora,
                 lora_rows=None if lora_row is None else lora_row[None],
             )
+            masked = mask_pad(logits[:, -1])
             tok = sample(
-                mask_pad(logits[:, -1]),
-                key[None],
-                temp[None],
-                top_p[None],
-                top_k[None],
-                max_top_k=mtk,
+                masked, key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk
             )[0]
-            return tok, cache
+            lp = jax.nn.log_softmax(masked, axis=-1)[0, tok]
+            return tok, lp, cache
 
         def prefill_batch_fn(params, tokens, lengths, tables, keys, temp, top_p, top_k, cache, lora=None, lora_rows=None):
             """Admit several same-bucket cold requests in ONE prefill:
@@ -300,8 +299,12 @@ class Engine:
                 params, mc, tokens, cache, tables, lengths,
                 lora=lora, lora_rows=lora_rows,
             )
-            toks = sample(mask_pad(logits[:, -1]), keys, temp, top_p, top_k, max_top_k=mtk)
-            return toks, cache
+            masked = mask_pad(logits[:, -1])
+            toks = sample(masked, keys, temp, top_p, top_k, max_top_k=mtk)
+            lps = jnp.take_along_axis(
+                jax.nn.log_softmax(masked, axis=-1), toks[:, None], axis=1
+            )[:, 0]
+            return toks, lps, cache
 
         def prefill_chunk_fn(params, tokens, start, last_idx, table, key, temp, top_p, top_k, cache, lora=None, lora_row=None):
             """One chunk of a long or prefix-resuming prompt."""
@@ -310,11 +313,12 @@ class Engine:
                 lora=lora,
                 lora_rows=None if lora_row is None else lora_row[None],
             )
+            masked = mask_pad(logits[:, -1])
             tok = sample(
-                mask_pad(logits[:, -1]), key[None], temp[None], top_p[None], top_k[None],
-                max_top_k=mtk,
+                masked, key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk
             )[0]
-            return tok, cache
+            lp = jax.nn.log_softmax(masked, axis=-1)[0, tok]
+            return tok, lp, cache
 
         K = self.cfg.decode_chunk
         G = self.cfg.speculate_tokens
@@ -363,6 +367,10 @@ class Engine:
                     lora=lora, lora_rows=lora_rows,
                 )
                 logits = mask_pad(logits)  # [B, G+1, V]
+                # Chosen-token logprob = raw logit - logsumexp: avoids
+                # materializing a normalized [B, G+1, V] tensor in the
+                # hottest loop just to gather G+1 entries.
+                lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, G+1]
                 yhat = jnp.argmax(logits, axis=-1).astype(jnp.int32)
                 # Greedy slots accept the longest draft prefix the model
                 # agrees with (exactness by causality); sampled slots
@@ -384,6 +392,20 @@ class Engine:
                     sampled0,
                 )
                 corr = jnp.where(active, corr, last)
+                if G > 0:
+                    lp_d = (
+                        jnp.take_along_axis(
+                            logits[:, :G], drafts[:, :, None], axis=2
+                        )[:, :, 0]
+                        - lse[:, :G]
+                    )
+                else:
+                    lp_d = jnp.zeros((B, 0), jnp.float32)
+                logits_at_a = jnp.take_along_axis(logits, acc[:, None, None], axis=1)[:, 0]
+                lp_corr = (
+                    jnp.take_along_axis(logits_at_a, corr[:, None], axis=1)[:, 0]
+                    - jnp.take_along_axis(lse, acc[:, None], axis=1)[:, 0]
+                )
                 # Record the inputs just written into KV at positions
                 # lengths..lengths+G (history width covers overshoot).
                 pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
@@ -391,12 +413,12 @@ class Engine:
                     jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
                 )
                 lengths = jnp.where(active, lengths + acc + 1, lengths)
-                return (cache, hist, lengths, corr, step_keys[:, 1]), (drafts, corr, acc)
+                return (cache, hist, lengths, corr, step_keys[:, 1]), (drafts, corr, acc, lp_d, lp_corr)
 
-            (cache, hist, lengths, last, keys), (d_seq, c_seq, a_seq) = jax.lax.scan(
+            (cache, hist, lengths, last, keys), (d_seq, c_seq, a_seq, lpd_seq, lpc_seq) = jax.lax.scan(
                 body, (cache, hist, lengths, last_tokens, keys), None, length=K
             )
-            return d_seq, c_seq, a_seq, cache, hist, lengths, last, keys
+            return d_seq, c_seq, a_seq, lpd_seq, lpc_seq, cache, hist, lengths, last, keys
 
         if apply_fns is not None:  # test seam
             self._prefill_jit, self._decode_jit = apply_fns(prefill_fn, decode_fn)
@@ -626,7 +648,7 @@ class Engine:
         self._init_device_state()
 
     def _admit_waiting(self) -> bool:
-        admitted: list[tuple[int, Any]] = []  # (slot_idx, epoch, first_token_ref)
+        admitted: list[tuple[int, Any]] = []  # (slot_idx, epoch, tok_ref, lp_ref)
         singles: list[tuple[int, int, "Request", int]] = []  # (seq, slot, req, reuse)
         groups: dict[int, list[tuple[int, "Request"]]] = {}  # bucket -> items
         taken: set[int] = set()
@@ -689,14 +711,14 @@ class Engine:
         # order follows dispatch order).
         for bucket, items in groups.items():
             def batch(items=items, bucket=bucket):
-                for slot_idx, epoch, tok_ref in self._prefill_group(items, bucket):
-                    admitted.append((slot_idx, epoch, tok_ref))
+                for slot_idx, epoch, tok_ref, lp_ref in self._prefill_group(items, bucket):
+                    admitted.append((slot_idx, epoch, tok_ref, lp_ref))
 
             work.append((items, batch))
         for _, slot_idx, req, reuse in sorted(singles, key=lambda t: t[0]):
             def one(slot_idx=slot_idx, req=req, reuse=reuse):
-                tok_ref = self._prefill(slot_idx, req, reuse)
-                admitted.append((slot_idx, self._slot_epoch[slot_idx], tok_ref))
+                tok_ref, lp_ref = self._prefill(slot_idx, req, reuse)
+                admitted.append((slot_idx, self._slot_epoch[slot_idx], tok_ref, lp_ref))
 
             work.append(([(slot_idx, req)], one))
 
@@ -737,13 +759,15 @@ class Engine:
                     raise
         if admitted:
             # One host sync for all first tokens of this admission batch.
-            toks = jax.device_get([t for _, _, t in admitted])
-            for (slot_idx, epoch, _), tok in zip(admitted, toks):
+            toks, lps = jax.device_get(
+                ([t for _, _, t, _ in admitted], [l for _, _, _, l in admitted])
+            )
+            for (slot_idx, epoch, _, _), tok, lp in zip(admitted, toks, lps):
                 if self._slot_epoch[slot_idx] == epoch:
                     # This token is what the next decode step writes.
                     self._kv_pending[slot_idx] = int(tok)
                 if self._slots[slot_idx] is not None:
-                    self._emit_token(slot_idx, int(tok))
+                    self._emit_token(slot_idx, int(tok), float(lp))
         return bool(admitted)
 
     def _lora_sig(self, adapter: str | None) -> tuple[int, int]:
@@ -850,7 +874,7 @@ class Engine:
         if reuse == 0 and len(ids) <= max_bucket:
             padded = np.zeros((1, self._bucket(len(ids))), np.int32)
             padded[0, : len(ids)] = ids
-            tok, self._cache = self._prefill_jit(
+            tok, lp, self._cache = self._prefill_jit(
                 self.params,
                 jnp.asarray(padded),
                 jnp.int32(len(ids)),
@@ -865,14 +889,14 @@ class Engine:
         else:
             # Chunked prefill from the reuse offset: full-bucket chunks at
             # increasing offsets; only the final chunk's sample is kept.
-            tok = None
+            tok = lp = None
             for start in range(reuse, len(ids), max_bucket):
                 chunk = ids[start : start + max_bucket]
                 is_last = start + max_bucket >= len(ids)
                 bucket = max_bucket if not is_last else self._bucket(len(chunk))
                 chunk_padded = np.zeros((1, bucket), np.int32)
                 chunk_padded[0, : len(chunk)] = chunk
-                tok, self._cache = self._prefill_chunk_jit(
+                tok, lp, self._cache = self._prefill_chunk_jit(
                     self.params,
                     jnp.asarray(chunk_padded),
                     jnp.int32(start),
@@ -887,7 +911,7 @@ class Engine:
                 )
 
         self._register(slot_idx, req, key, lora_row, tok, reuse)
-        return tok
+        return tok, lp
 
     def _register(self, slot_idx: int, req: Request, key, lora_row: int, tok, reuse: int):
         """Host + device bookkeeping for a freshly prefilled slot. *tok*
@@ -969,7 +993,7 @@ class Engine:
         lora_args = {}
         if self._adapters is not None:
             lora_args = {"lora": self._adapters.bank, "lora_rows": jnp.asarray(lora_rows_arr)}
-        toks, self._cache = self._prefill_batch_jit(
+        toks, lps, self._cache = self._prefill_batch_jit(
             self.params,
             jnp.asarray(tokens),
             jnp.asarray(lengths),
@@ -984,7 +1008,7 @@ class Engine:
         out = []
         for j, (slot_idx, req) in enumerate(items):
             self._register(slot_idx, req, keys[j], int(lora_rows_arr[j]), toks[j], reuse=0)
-            out.append((slot_idx, self._slot_epoch[slot_idx], toks[j]))
+            out.append((slot_idx, self._slot_epoch[slot_idx], toks[j], lps[j]))
         return out
 
     def _dispatch_chunk(self):
@@ -994,7 +1018,7 @@ class Engine:
         if self._adapters is not None:
             lora_args = {"lora": self._adapters.bank, "lora_rows": self._lora_rows}
         (
-            d_seq, y_seq, a_seq,
+            d_seq, c_seq, a_seq, lpd_seq, lpc_seq,
             self._cache, self._tok_hist, self._lengths, self._last_tokens, self._keys,
         ) = self._decode_jit(
             self.params,
@@ -1013,26 +1037,31 @@ class Engine:
         snapshot = [
             (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        return (d_seq, y_seq, a_seq), snapshot
+        return (d_seq, c_seq, a_seq, lpd_seq, lpc_seq), snapshot
 
     def _process_chunk(self, payload, snapshot):
-        d_seq, c_seq, a_seq = payload
-        drafts = np.asarray(jax.device_get(d_seq))  # [K, B, G]
-        corr = np.asarray(jax.device_get(c_seq))  # [K, B]
-        acc = np.asarray(jax.device_get(a_seq))  # [K, B]
+        drafts, corr, acc, lp_d, lp_c = jax.device_get(payload)
+        drafts = np.asarray(drafts)  # [K, B, G]
+        corr = np.asarray(corr)  # [K, B]
+        acc = np.asarray(acc)  # [K, B]
+        lp_d = np.asarray(lp_d)  # [K, B, G]
+        lp_c = np.asarray(lp_c)  # [K, B]
         G = drafts.shape[2]
         for k in range(acc.shape[0]):
             for i, slot_obj, epoch in snapshot:
                 a = int(acc[k, i])
                 # Accepted drafts then the device-chosen next token (the
-                # model's continuation input — greedy argmax OR sampled).
-                emitted = [int(drafts[k, i, j]) for j in range(a)]
-                emitted.append(int(corr[k, i]))
+                # model's continuation input — greedy argmax OR sampled),
+                # each with its logprob under the model.
+                emitted = [
+                    (int(drafts[k, i, j]), float(lp_d[k, i, j])) for j in range(a)
+                ]
+                emitted.append((int(corr[k, i]), float(lp_c[k, i])))
                 if G and self._slots[i] is slot_obj \
                         and slot_obj.req.params.temperature <= 0.0:
                     self.m_spec_drafted.inc(G)
                     self.m_spec_accepted.inc(a)
-                for tok in emitted:
+                for tok, lp in emitted:
                     # Record KV residency for prefix reuse: each step
                     # WROTE its pending (input) token; each emitted token
                     # becomes the next write. Skip if a new occupant
@@ -1046,10 +1075,12 @@ class Engine:
                     # mid-chunk, or have been freed and re-admitted
                     # since dispatch).
                     if self._slots[i] is slot_obj:
-                        self._emit_token(i, tok)
+                        self._emit_token(i, tok, lp)
 
-    def _emit_token(self, slot_idx: int, token_id: int):
-        """Deliver one generated token to the request; apply stop logic."""
+    def _emit_token(self, slot_idx: int, token_id: int, logprob: float | None = None):
+        """Deliver one generated token to the request; apply stop logic.
+        Events are ("token", id, text_delta, logprob) — the logprob is
+        the model's log p(token | prefix) (None for text-only flushes)."""
         slot = self._slots[slot_idx]
         req = slot.req
         if req.cancelled.is_set():
@@ -1078,14 +1109,14 @@ class Engine:
             if pos != -1:
                 tail = text[slot.delivered_chars : pos]
                 slot.delivered_chars = pos
-                req.out.put(("token", token_id, tail))
+                req.out.put(("token", token_id, tail, logprob))
                 self._free(slot_idx, "stop", flush=False)
                 return
 
         emit_upto = max(len(text) - slot.holdback, slot.delivered_chars)
         delta = text[slot.delivered_chars : emit_upto]
         slot.delivered_chars = emit_upto
-        req.out.put(("token", token_id, delta))
+        req.out.put(("token", token_id, delta, logprob))
 
         if slot.generated >= slot.budget:
             self._free(slot_idx, "length")
@@ -1113,7 +1144,7 @@ class Engine:
                         reason = "stop"
                 tail = text[slot.delivered_chars : end]
                 if tail:
-                    slot.req.out.put(("token", -1, tail))
+                    slot.req.out.put(("token", -1, tail, None))
             slot.req.out.put(
                 ("done", FinishInfo(reason, slot.prompt_len, slot.generated))
             )

@@ -309,13 +309,29 @@ def _make_handler(srv: EngineServer):
 
             rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
             created = int(time.time())
+            # OpenAI logprobs: completions spells it `logprobs: <int>`
+            # (0 is a VALID request: chosen-token logprobs with zero
+            # alternatives), chat spells it `logprobs: true`. Chosen-token
+            # logprobs are returned; top-N alternatives are not
+            # (documented).
+            lp_field = body.get("logprobs")
+            want_logprobs = lp_field is not None and lp_field is not False
             if body.get("stream"):
-                self._stream_response(req, rid, created, chat)
+                self._stream_response(req, rid, created, chat, want_logprobs)
             else:
-                self._full_response(req, rid, created, chat)
+                self._full_response(req, rid, created, chat, want_logprobs)
 
-        def _full_response(self, req, rid, created, chat):
-            chunks, n_tokens, fin = [], 0, None
+        def _token_text(self, token_id: int) -> str:
+            """The token's OWN text (OpenAI logprobs semantics) — NOT the
+            stream delta, which can be empty or combined when the
+            detokenizer holds back partial UTF-8 / stop-string windows."""
+            try:
+                return srv.engine.tokenizer.decode([token_id])
+            except Exception:
+                return ""
+
+        def _full_response(self, req, rid, created, chat, want_logprobs=False):
+            chunks, pieces, fin = [], [], None
             while True:
                 try:
                     ev = req.out.get(timeout=600)
@@ -324,6 +340,8 @@ def _make_handler(srv: EngineServer):
                     return self._error(504, "generation timed out", "timeout_error")
                 if ev[0] == "token":
                     chunks.append(ev[2])
+                    if ev[1] >= 0:  # -1 marks a text-only flush
+                        pieces.append((ev[1], ev[3] if len(ev) > 3 else None))
                 elif ev[0] == "done":
                     fin = ev[1]
                     break
@@ -341,16 +359,32 @@ def _make_handler(srv: EngineServer):
                     "message": {"role": "assistant", "content": text},
                     "finish_reason": fin.reason,
                 }
+                if want_logprobs:
+                    choice["logprobs"] = {
+                        "content": [
+                            {"token": self._token_text(tid), "logprob": lp}
+                            for tid, lp in pieces
+                            if lp is not None
+                        ]
+                    }
                 obj = "chat.completion"
             else:
                 choice = {"index": 0, "text": text, "finish_reason": fin.reason}
+                if want_logprobs:
+                    choice["logprobs"] = {
+                        "tokens": [self._token_text(tid) for tid, lp in pieces if lp is not None],
+                        "token_logprobs": [lp for _, lp in pieces if lp is not None],
+                        # Top-N alternatives are not computed (chosen-token
+                        # logprobs only).
+                        "top_logprobs": None,
+                    }
                 obj = "text_completion"
             self._json(200, {
                 "id": rid, "object": obj, "created": created,
                 "model": srv.model_name, "choices": [choice], "usage": usage,
             })
 
-        def _stream_response(self, req, rid, created, chat):
+        def _stream_response(self, req, rid, created, chat, want_logprobs=False):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -371,12 +405,29 @@ def _make_handler(srv: EngineServer):
                 while True:
                     ev = req.out.get(timeout=600)
                     if ev[0] == "token":
-                        if not ev[2]:
+                        has_lp = (
+                            want_logprobs and ev[1] >= 0 and len(ev) > 3
+                            and ev[3] is not None
+                        )
+                        if not ev[2] and not has_lp:
                             continue
                         if chat:
                             choice = {"index": 0, "delta": {"content": ev[2]}, "finish_reason": None}
+                            if has_lp:
+                                choice["logprobs"] = {
+                                    "content": [{
+                                        "token": self._token_text(ev[1]),
+                                        "logprob": ev[3],
+                                    }]
+                                }
                         else:
                             choice = {"index": 0, "text": ev[2], "finish_reason": None}
+                            if has_lp:
+                                choice["logprobs"] = {
+                                    "tokens": [self._token_text(ev[1])],
+                                    "token_logprobs": [ev[3]],
+                                    "top_logprobs": None,
+                                }
                         send_chunk(json.dumps({
                             "id": rid, "object": obj, "created": created,
                             "model": srv.model_name, "choices": [choice],

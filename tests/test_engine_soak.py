@@ -74,8 +74,11 @@ def test_mixed_concurrent_soak(tmp_path):
                 vecs = eng.embed([base_prompt[:16], long_prompt[:16]])
                 assert np.isfinite(vecs).all()
             else:  # submit-then-cancel
+                # Per-thread Generator: numpy Generators are NOT
+                # thread-safe, and sharing `rng` across workers was a
+                # rare source of corrupted draws under heavy load.
                 req = eng.submit(
-                    rng.integers(1, 200, 24).tolist(),
+                    np.random.default_rng(1000 + i).integers(1, 200, 24).tolist(),
                     SamplingParams(temperature=0.9, max_tokens=40, seed=i),
                 )
                 req.cancelled.set()
