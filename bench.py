@@ -96,6 +96,51 @@ def emit(value: float, extras: dict | None = None) -> None:
 # Worker
 
 
+def synth_int8_params(mc):
+    """Host-synthesized int8 weight tree with the exact structure
+    quantize_model_params produces for this config: int8 values from a
+    fixed RNG, constant per-channel scales matching a normal(0, 1/sqrt(in))
+    init's absmax so logits stay in a sane range."""
+    import numpy as np
+
+    from kubeai_tpu.ops.quant import QKEY, SKEY
+
+    rng = np.random.default_rng(0)
+    D, F, L = mc.hidden_size, mc.intermediate_size, mc.num_layers
+    H, Kv, h = mc.num_heads, mc.num_kv_heads, mc.head_dim_
+    V = mc.vocab_size
+    dt = np.dtype("bfloat16") if mc.dtype == "bfloat16" else np.dtype(mc.dtype)
+
+    def q(*shape, row_scales=False, scale=None):
+        fan_in = shape[-1 if row_scales else -2]
+        s = (scale or 4.0 / np.sqrt(fan_in)) / 127.0
+        sshape = (
+            shape[:-1] + (1,) if row_scales else shape[:-2] + (1, shape[-1])
+        )
+        return {
+            QKEY: rng.integers(-127, 128, shape, dtype=np.int8),
+            SKEY: np.full(sshape, s, np.float32),
+        }
+
+    layers = {
+        "ln1": np.ones((L, D), dt),
+        "ln2": np.ones((L, D), dt),
+        "wq": q(L, D, H * h),
+        "wk": q(L, D, Kv * h),
+        "wv": q(L, D, Kv * h),
+        "wo": q(L, H * h, D),
+        "wg": q(L, D, F),
+        "wu": q(L, D, F),
+        "wd": q(L, F, D),
+    }
+    return {
+        "embed": q(V, D, row_scales=True, scale=0.08),
+        "final_norm": np.ones((D,), dt),
+        "layers": layers,
+        "lm_head": q(D, V, scale=0.08),
+    }
+
+
 def build_engine(preset: str):
     import jax
 
@@ -113,11 +158,12 @@ def build_engine(preset: str):
         params = llama.init_params(mc, jax.random.key(0))
     elif preset == "8b-int8":
         # The BASELINE.json headline config: Llama-3-8B shape on ONE v5e
-        # chip via int8 weights. Built with the SAME init as the serving
-        # path, on the CPU backend, and quantized there — the accelerator
-        # only ever receives the int8 tree.
-        from kubeai_tpu.engine.weights import quantize_model_params
-
+        # chip via int8 weights. The int8 tree is synthesized directly on
+        # host (tokens/s does not depend on weight values; shapes, dtypes
+        # and the jitted graph are identical to load_engine_from_path's
+        # int8 serving config) — randomly initializing 8B bf16 params and
+        # quantizing them burned ~15 host-CPU-minutes per worker attempt
+        # in round 2 and is pure setup, not the thing being measured.
         mc = ModelConfig(
             vocab_size=128256, hidden_size=4096, intermediate_size=14336,
             num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
@@ -130,10 +176,13 @@ def build_engine(preset: str):
             max_slots=16, max_seq_len=1024, prefill_buckets=(128, 256, 512),
             decode_chunk=16,
         )
-        with jax.default_device(jax.devices("cpu")[0]):
-            params = llama.init_params(mc, jax.random.key(0))
-            params = quantize_model_params(params, mc)
+        t0 = time.monotonic()
+        params = synth_int8_params(mc)
+        log(f"phase=build int8 tree synthesized on host ({time.monotonic()-t0:.1f}s)")
+        t0 = time.monotonic()
         params = jax.device_put(params)
+        jax.block_until_ready(params)
+        log(f"phase=build weights transferred to device ({time.monotonic()-t0:.1f}s)")
     else:
         # 1.3B-class Llama in bf16.
         mc = ModelConfig(
