@@ -38,6 +38,7 @@ Usage:
 import argparse
 import json
 import os
+import queue
 import subprocess
 import sys
 import time
@@ -170,8 +171,11 @@ def build_engine(preset: str):
             dtype="bfloat16",
         )
         if jax.default_backend() == "tpu":
-            # Match load_engine_from_path's real int8 serving config.
-            mc = mc.replace(use_flash_prefill=True)
+            # Match load_engine_from_path's real int8 serving config
+            # (engine/weights.py:106-110): flash prefill AND the ragged
+            # paged-attention decode kernel. Round 2 measured the portable
+            # gather path instead (VERDICT r2 weak #2).
+            mc = mc.replace(use_flash_prefill=True, use_paged_kernel=True)
         ec = EngineConfig(
             max_slots=16, max_seq_len=1024, prefill_buckets=(128, 256, 512),
             decode_chunk=16,
@@ -189,6 +193,8 @@ def build_engine(preset: str):
             vocab_size=32000, hidden_size=2048, intermediate_size=5632,
             num_layers=16, num_heads=16, num_kv_heads=8, dtype="bfloat16",
         )
+        if jax.default_backend() == "tpu":
+            mc = mc.replace(use_flash_prefill=True, use_paged_kernel=True)
         ec = EngineConfig(
             max_slots=32, max_seq_len=1024, prefill_buckets=(128, 256, 512),
             decode_chunk=16,
@@ -238,7 +244,9 @@ def run_worker(args) -> None:
 
     preset = args.preset
     tiny = preset == "tiny"
-    n_requests = args.requests or (8 if tiny else 64)
+    # Sized for a >=10s steady-state window at the observed rates (a
+    # 2-3s window mostly measures ramp-up/drain edges).
+    n_requests = args.requests or (8 if tiny else 256)
     max_tokens = args.max_tokens or (8 if tiny else 128)
     prompt_len = 16 if tiny else 128
 
@@ -252,10 +260,48 @@ def run_worker(args) -> None:
     prompts = [rng.integers(1, 200, prompt_len).tolist() for _ in range(n_requests)]
     sp = SamplingParams(temperature=0.7, top_p=0.95, max_tokens=max_tokens, seed=1)
 
-    # Warmup: trigger prefill+decode compilation outside the timed window.
+    # Warmup: compile EVERY shape the measure phase hits — the single
+    # (pad-1) prefill, the grouped (pad-prefill_group_cap) prefill, and
+    # the decode chunk — outside the timed window (round 2 compiled the
+    # burst shape mid-measurement, poisoning both tok/s and TTFT). The timeout must
+    # cover a cold multi-minute 8B compile — generate()'s default 300s
+    # killed the r2 worker mid-compile; the watchdog/orchestrator remains
+    # the real deadline.
     t0 = time.monotonic()
-    log("phase=warmup compiling prefill+decode")
-    eng.generate(prompts[0], SamplingParams(temperature=0.0, max_tokens=4))
+    log("phase=warmup compiling prefill (single + burst) + decode")
+    warmup_timeout = args.watchdog if args.watchdog else PRESET_DEADLINE[preset]
+    wp = SamplingParams(temperature=0.0, max_tokens=4)
+    # Warmup prompts draw from a DISJOINT token range so the measure
+    # phase runs cold — reusing measure prompts would leave their prefix
+    # pages registered and hand the first 2*max_slots measured requests
+    # a warm cache.
+    wprompts = [
+        rng.integers(201, 400, prompt_len).tolist()
+        for _ in range(2 * eng.cfg.max_slots)
+    ]
+    eng.generate(wprompts[0], wp, timeout=warmup_timeout)
+    # A full-slot burst guarantees a grouped admission round even if the
+    # scheduler races ahead and admits the first request solo. Skips
+    # wprompts[0]: the single warmup just content-registered its pages,
+    # and resubmitting it would take the chunked prefix-reuse path —
+    # cold-compiling a graph the measure phase never runs (multi-minute
+    # on the 8B preset).
+    burst = [eng.submit(p, wp) for p in wprompts[1:]]
+    deadline = time.monotonic() + warmup_timeout
+    for r in burst:
+        while True:
+            if time.monotonic() > deadline:
+                raise TimeoutError(f"warmup burst exceeded {warmup_timeout}s")
+            try:
+                ev = r.out.get(timeout=max(1.0, deadline - time.monotonic()))
+            except queue.Empty:
+                raise TimeoutError(
+                    f"warmup burst produced no event within {warmup_timeout}s"
+                ) from None
+            if ev[0] == "done":
+                break
+            if ev[0] == "error":
+                raise RuntimeError(ev[1])
     log(f"phase=warmup done ({time.monotonic()-t0:.1f}s)")
 
     results = [None] * n_requests

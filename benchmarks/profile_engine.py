@@ -1,0 +1,217 @@
+"""Per-phase engine profiler: where does a decode chunk's time go?
+
+Times, on the current backend (designed for the real TPU):
+  - dispatch RTT: a trivial jitted call, round-tripped (remote-device tax)
+  - raw decode chunk device time for the 1.3b preset, gather vs paged
+    kernel paths
+  - prefill bucket device time (flash vs portable)
+  - sampling cost in isolation
+  - host-side _process_chunk cost on synthetic payloads
+
+Prints a table plus roofline context (weights bytes / HBM bandwidth),
+so the top cost is attributable before touching engine code
+(VERDICT r2 "next" #2: close the throughput gap with a profile, not
+guesses).
+
+Usage: python benchmarks/profile_engine.py [--preset 1.3b|8b-int8] [--paths gather,paged]
+"""
+
+import argparse
+import os
+import sys
+import time
+from functools import partial
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HBM_GBPS = {"v5 lite": 819, "v5e": 819, "v5p": 2765, "v6e": 1640, "v4": 1228}
+
+
+def log(msg):
+    print(f"# [{time.strftime('%H:%M:%S')}] {msg}", file=sys.stderr, flush=True)
+
+
+def timeit(fn, n=10, warmup=2):
+    import jax
+
+    for _ in range(warmup):
+        jax.block_until_ready(fn())
+    t0 = time.monotonic()
+    for _ in range(n):
+        out = fn()
+    jax.block_until_ready(out)
+    return (time.monotonic() - t0) / n
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--preset", default="1.3b", choices=["1.3b", "8b-int8"])
+    p.add_argument("--paths", default="gather,paged")
+    p.add_argument("--chunk", type=int, default=16)
+    p.add_argument("--slots", type=int, default=32)
+    args = p.parse_args()
+
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", os.path.join(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_compile_cache"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+
+    import jax.numpy as jnp
+    import numpy as np
+
+    devs = jax.devices()
+    kind = getattr(devs[0], "device_kind", "unknown")
+    log(f"backend={jax.default_backend()} device={kind}")
+
+    # --- dispatch RTT -------------------------------------------------------
+    tinyf = jax.jit(lambda x: x + 1)
+    x = jnp.zeros((8,), jnp.float32)
+    rtt = timeit(lambda: tinyf(x), n=50, warmup=5)
+    print(f"dispatch_rtt_ms {rtt*1e3:.2f}")
+
+    # --- model/config -------------------------------------------------------
+    from kubeai_tpu.models import llama
+    from kubeai_tpu.models.base import ModelConfig
+    from kubeai_tpu.engine.sampling import sample
+
+    if args.preset == "1.3b":
+        mc = ModelConfig(
+            vocab_size=32000, hidden_size=2048, intermediate_size=5632,
+            num_layers=16, num_heads=16, num_kv_heads=8, dtype="bfloat16",
+        )
+        params = llama.init_params(mc, jax.random.key(0))
+        wbytes = sum(np.prod(v.shape) * v.dtype.itemsize for v in jax.tree_util.tree_leaves(params))
+    else:
+        sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+        from bench import synth_int8_params
+        mc = ModelConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+            dtype="bfloat16",
+        )
+        params = jax.device_put(synth_int8_params(mc))
+        jax.block_until_ready(params)
+        wbytes = sum(np.prod(v.shape) * v.dtype.itemsize for v in jax.tree_util.tree_leaves(params))
+    log(f"weights on device: {wbytes/1e9:.2f} GB")
+
+    B, K = args.slots, args.chunk
+    S = 1024
+    page = 64
+    max_pages = S // page
+    P = B * max_pages + 1
+    bw = next((v for k, v in HBM_GBPS.items() if k in str(kind).lower()), None)
+    if bw:
+        floor_ms = wbytes / (bw * 1e9) * 1e3
+        print(f"roofline_step_ms {floor_ms:.2f}  (weights {wbytes/1e9:.2f} GB / {bw} GB/s)")
+        print(f"roofline_toks_per_sec {B / (floor_ms/1e3):.0f}  (batch {B})")
+
+    # --- raw decode step: one forward, no scan, no sampling -----------------
+    lengths0 = np.full((B,), 512, np.int32)
+    table = np.zeros((B, max_pages), np.int32)
+    for b in range(B):
+        table[b] = np.arange(1 + b * max_pages, 1 + (b + 1) * max_pages)
+    tok0 = np.ones((B, 1), np.int32)
+
+    for path in args.paths.split(","):
+        mcp = mc.replace(use_paged_kernel=(path == "paged"))
+        cache = llama.init_paged_cache(mcp, P, page)
+
+        # Donate the pool as the engine does — without donation every
+        # call pays a full-pool copy the real serving path never pays.
+        @partial(jax.jit, donate_argnums=(1,))
+        def fwd(params, cache, tokens, tbl, lengths):
+            logits, cache = llama.decode_step_paged(params, mcp, tokens, cache, tbl, lengths)
+            return logits, cache
+
+        t0 = time.monotonic()
+        logits, cache = fwd(params, cache, jnp.asarray(tok0), jnp.asarray(table), jnp.asarray(lengths0))
+        jax.block_until_ready(logits)
+        log(f"{path}: first decode call (compile) {time.monotonic()-t0:.1f}s")
+
+        def run():
+            nonlocal_cache = run.cache
+            logits, run.cache = fwd(params, nonlocal_cache, jnp.asarray(tok0), jnp.asarray(table), jnp.asarray(lengths0))
+            return logits
+
+        run.cache = cache
+        dt = timeit(run, n=20)
+        cache = run.cache
+        print(f"decode_step_ms[{path}] {dt*1e3:.2f}  -> {B/dt:.0f} tok/s at batch {B}")
+
+        # --- fused chunk of K steps with sampling (the engine's real call) --
+        mtk = 128
+
+        def chunk_fn(params, cache, tbl, lengths, last, keys, temp, top_p, top_k):
+            def body(carry, _):
+                cache, lengths, last, keys = carry
+                logits, cache = llama.decode_step_paged(
+                    params, mcp, last[:, None], cache, tbl, lengths)
+                step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+                tok = sample(logits[:, 0], step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk)
+                return (cache, lengths + 1, tok, step_keys[:, 1]), tok
+            (cache, lengths, last, keys), toks = jax.lax.scan(
+                body, (cache, lengths, last, keys), None, length=K)
+            return toks, cache, lengths, last, keys
+
+        cjit = jax.jit(chunk_fn, donate_argnums=(1,))
+        cache2 = llama.init_paged_cache(mcp, P, page)
+        keys = jax.random.split(jax.random.key(0), B)
+        temp = jnp.full((B,), 0.7, jnp.float32)
+        top_p = jnp.full((B,), 0.95, jnp.float32)
+        top_k = jnp.zeros((B,), jnp.int32)
+        lengths = jnp.asarray(lengths0)
+        last = jnp.asarray(tok0[:, 0])
+        tbl = jnp.asarray(table)
+
+        t0 = time.monotonic()
+        toks, cache2, lengths, last, keys = cjit(params, cache2, tbl, lengths, last, keys, temp, top_p, top_k)
+        jax.block_until_ready(toks)
+        log(f"{path}: chunk compile {time.monotonic()-t0:.1f}s")
+
+        n = 10
+        t0 = time.monotonic()
+        for _ in range(n):
+            toks, cache2, lengths, last, keys = cjit(params, cache2, tbl, lengths, last, keys, temp, top_p, top_k)
+        jax.block_until_ready(toks)
+        dt = (time.monotonic() - t0) / n
+        print(f"decode_chunk_ms[{path}] {dt*1e3:.2f}  ({K} steps) -> {B*K/dt:.0f} tok/s at batch {B}")
+        del cache, cache2
+
+    # --- sampling in isolation ---------------------------------------------
+    logits_s = jax.random.normal(jax.random.key(1), (B, mc.vocab_size), jnp.float32)
+    keys = jax.random.split(jax.random.key(0), B)
+    temp = jnp.full((B,), 0.7, jnp.float32)
+    top_p = jnp.full((B,), 0.95, jnp.float32)
+    top_k = jnp.zeros((B,), jnp.int32)
+    sfn = jax.jit(lambda lg, k: sample(lg, k, temp, top_p, top_k, max_top_k=128))
+    dt = timeit(lambda: sfn(logits_s, keys), n=20)
+    print(f"sample_ms {dt*1e3:.2f}")
+
+    # --- prefill ------------------------------------------------------------
+    for flash in (False, True):
+        mcp = mc.replace(use_flash_prefill=flash, use_paged_kernel=False)
+        cache = llama.init_paged_cache(mcp, P, page)
+        ptoks = np.ones((1, 512), np.int32)
+
+        @partial(jax.jit, donate_argnums=(1,))
+        def pf(params, cache, tokens, tbl, lengths):
+            return llama.prefill_paged_cold(params, mcp, tokens, cache, tbl, lengths)
+
+        t0 = time.monotonic()
+        logits, cache = pf(params, cache, jnp.asarray(ptoks), jnp.asarray(table[:1]), jnp.asarray([512]))
+        jax.block_until_ready(logits)
+        log(f"prefill flash={flash}: compile {time.monotonic()-t0:.1f}s")
+
+        def runp():
+            logits, runp.cache = pf(params, runp.cache, jnp.asarray(ptoks), jnp.asarray(table[:1]), jnp.asarray([512]))
+            return logits
+
+        runp.cache = cache
+        dt = timeit(runp, n=10)
+        print(f"prefill_512_ms[flash={flash}] {dt*1e3:.2f}")
+        del cache
+
+
+if __name__ == "__main__":
+    main()

@@ -1,11 +1,13 @@
 """Host-side paged-KV allocator: block tables, refcounts, prefix cache.
 
-The engine's KV cache is a pool of fixed-size pages (one combined
-{"kv": [L, P, page, 2*Kv, h]} array with K/V interleaved on the head
-axis, `models/llama.py::init_paged_cache`); this module owns
-the *host* bookkeeping: which pages are free, which are referenced by
-live slots, and which hold content-addressed full pages reusable as
-shared prefixes across slots (the cross-slot upgrade over round 1's
+The engine's KV cache is a pool of fixed-size pages (one combined FLAT
+{"kv": [L*P, page, 2*Kv, h]} array with K/V interleaved on the head
+axis; layer l owns rows [l*P, (l+1)*P) and the forward adds the l*P
+offset in-graph — `models/llama.py::init_paged_cache`). This module
+owns the *host* bookkeeping in LOGICAL pages 0..P-1 (layer-agnostic):
+which pages are free, which are referenced by live slots, and which
+hold content-addressed full pages reusable as shared prefixes across
+slots (the cross-slot upgrade over round 1's
 slot-local prefix cache — ref VERDICT.md item 2; the reference gets
 this from vLLM's paged attention + prefix caching, which its operator
 orchestrates but never implements: charts/kubeai/values.yaml:39-56).

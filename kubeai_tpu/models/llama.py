@@ -178,16 +178,27 @@ def init_cache(config: ModelConfig, batch: int, max_len: int, dtype=None) -> Par
 
 
 def init_paged_cache(config: ModelConfig, num_pages: int, page_size: int, dtype=None) -> Params:
-    """Paged KV pool: one combined array [L, P, page, 2*Kv, head_dim]
-    with K/V interleaved on the head axis (K at even indices, V at odd
-    — the TPU ragged-paged-attention kernel's native layout, so prefill,
-    decode, and speculative verification all read pages in place with
-    zero re-layout). Sequences map onto pages through a per-slot block
-    table ([B, max_pages] int32 of pool indices); page 0 is the engine's
-    trash page (see engine/paging.py)."""
+    """Paged KV pool: one FLAT array [L*P, page, 2*Kv, head_dim] with K/V
+    interleaved on the head axis (K at even indices, V at odd — the TPU
+    ragged-paged-attention kernel's native layout, so prefill, decode,
+    and speculative verification all read pages in place with zero
+    re-layout). Layer l owns pool rows [l*P, (l+1)*P); the engine's
+    block tables stay layer-agnostic (logical pages 0..P-1) and the
+    forward adds the l*P offset in-graph.
+
+    Why flat instead of a stacked [L, P, ...] leading layer axis: the
+    layer scan would then have to slice layer l's 100MB+ pool plane out
+    of the stacked array (and scatter it back) every layer of every
+    decode step — measured ~10ms/step of pure copy traffic on v5e for a
+    1.3B config, 4x the whole rest of the step. With the flat layout
+    every layer reads/writes the SAME un-sliced carry array and XLA
+    keeps the donated buffer in place end-to-end; the only per-layer
+    work is the B-token scatter and the kernel's page reads. Logical
+    page 0 of every layer (pool row l*P) is that layer's trash page
+    (see engine/paging.py)."""
     dtype = dtype or jnp.dtype(config.dtype)
     shape = (
-        config.num_layers, num_pages, page_size, 2 * config.num_kv_heads, config.head_dim_,
+        config.num_layers * num_pages, page_size, 2 * config.num_kv_heads, config.head_dim_,
     )
     return {"kv": jnp.zeros(shape, dtype)}
 
@@ -349,14 +360,17 @@ def apply(
 
     paged = page_table is not None
     if paged:
-        page = cache["kv"].shape[2]
+        page = cache["kv"].shape[1]
+        pool_P = cache["kv"].shape[0] // config.num_layers  # logical pages per layer
         max_pages = page_table.shape[1]
         skv = max_pages * page
         key_positions = jnp.arange(skv)[None, None, :]  # [1, 1, Skv]
-        # Write indices: pool page + in-page offset per (b, s) token.
-        # Out-of-span positions (bucket padding past the table, decode
-        # overrun after a sequence finished) go to trash page 0 so they
-        # can never corrupt a live page.
+        # Write indices: LOGICAL pool page + in-page offset per (b, s)
+        # token; layer l adds l*pool_P in-graph (flat pool — see
+        # init_paged_cache). Out-of-span positions (bucket padding past
+        # the table, decode overrun after a sequence finished) go to the
+        # layer's trash page (logical 0) so they can never corrupt a
+        # live page.
         w_idx = jnp.clip(positions // page, 0, max_pages - 1)
         w_pages = jnp.take_along_axis(page_table, w_idx, axis=1)
         w_pages = jnp.where(positions < skv, w_pages, 0)
@@ -384,7 +398,7 @@ def apply(
     batch_idx = jnp.arange(B)[:, None]
     rows = batch_idx if cache_rows is None else cache_rows[:, None]
 
-    def layer(x, w, k_cache_l, v_cache_l, kv_pool_l=None, lora_l=None, sliding=None):
+    def layer(x, w, k_cache_l, v_cache_l, kv_pool=None, lora_l=None, sliding=None, layer_idx=None):
         def proj(inp, name):
             out = qdot(inp, w[name])
             # KeyError at trace time if a qkv_bias config meets a tree
@@ -406,13 +420,18 @@ def apply(
         v = proj(attn_in, "wv").reshape(B, S, Kv, h)
         q, k = apply_rope(q, k, positions, inv_freq)
 
-        if kv_pool_l is not None:
-            # kv_pool_l: [P, page, 2Kv, h], K/V interleaved on the head
-            # axis (kernel-native). One scatter writes both through the
-            # block table; the kernel (or CPU reference) reads pages in
-            # place, and the portable fallback gathers a contiguous view.
+        if kv_pool is not None:
+            # kv_pool: the FULL flat [L*P, page, 2Kv, h] pool, K/V
+            # interleaved on the head axis (kernel-native); this layer
+            # owns rows layer_idx*P..(layer_idx+1)*P. One scatter writes
+            # both through the offset block table; the kernel (or CPU
+            # reference) reads pages in place, and the portable fallback
+            # gathers a contiguous view. The pool rides the scan CARRY
+            # un-sliced — slicing a per-layer plane out of a stacked
+            # array cost ~10ms/step in copies (see init_paged_cache).
             interleaved = jnp.stack([k, v], axis=3).reshape(B, S, 2 * Kv, h)
-            kv_full = kv_pool_l.at[w_pages, w_offs].set(interleaved)
+            table_l = page_table + layer_idx * pool_P
+            kv_full = kv_pool.at[w_pages + layer_idx * pool_P, w_offs].set(interleaved)
             k_full = v_full = None
             if use_paged_kernel or use_flash:
                 # Neither path reads the gathered view: the ragged kernel
@@ -423,7 +442,7 @@ def apply(
                 # KV bytes per layer.
                 k_att = v_att = None
             else:
-                gathered = kv_full[page_table]  # [B, mp, page, 2Kv, h]
+                gathered = kv_full[table_l]  # [B, mp, page, 2Kv, h]
                 k_att = gathered[..., 0::2, :].reshape(B, skv, Kv, h)
                 v_att = gathered[..., 1::2, :].reshape(B, skv, Kv, h)
         elif k_cache_l is not None:
@@ -441,7 +460,7 @@ def apply(
             from kubeai_tpu.ops.paged_attention import paged_attention_ragged
 
             attn_out = paged_attention_ragged(
-                q, kv_full, page_table,
+                q, kv_full, table_l,
                 kv_lengths=positions[:, -1] + 1,  # keys 0..last pos inclusive
                 scale=config.query_scale,
                 softcap=config.attn_softcap,
@@ -481,7 +500,7 @@ def apply(
         if config.post_norms:
             m = norm(m, "ln2b")
         x = x + m
-        cache_out = kv_full if kv_pool_l is not None else (k_full, v_full)
+        cache_out = kv_full if kv_pool is not None else (k_full, v_full)
         return x, cache_out
 
     # Per-layer lora slices ride the scan xs (leading dim L).
@@ -490,13 +509,19 @@ def apply(
         lora_xs = {k: v for k, v in lora.items() if k != "scale"}
 
     if cache is not None and paged:
+        # The flat pool rides the scan CARRY (never sliced, scattered in
+        # place on the donated buffer); per-layer weights/flags ride xs.
 
-        def step_paged(x, xs):
-            w, kvp, lora_l, sliding = xs
-            return layer(x, w, None, None, kvp, lora_l, sliding)
+        def step_paged(carry, xs):
+            x, pool = carry
+            w, lora_l, sliding, l = xs
+            x, pool = layer(x, w, None, None, pool, lora_l, sliding, layer_idx=l)
+            return (x, pool), None
 
-        x, new_kv = jax.lax.scan(
-            step_paged, x, (params["layers"], cache["kv"], lora_xs, sliding_flags)
+        (x, new_kv), _ = jax.lax.scan(
+            step_paged,
+            (x, cache["kv"]),
+            (params["layers"], lora_xs, sliding_flags, jnp.arange(L, dtype=jnp.int32)),
         )
         new_cache = {"kv": new_kv}
     elif cache is not None:

@@ -66,13 +66,13 @@ def cache_specs():
 
 
 def paged_cache_specs():
-    """Paged KV pool [L, P, page, 2*Kv, h] (K/V interleaved): combined
-    KV heads over tp (tp must divide Kv, so each rank holds whole K/V
-    pairs). Pages are NOT sharded — every tp rank holds its head-shard
-    of every page, so block tables stay replicated host-state and page
-    indices are rank-agnostic (the same indirection the dense cache's
-    batch dim had for free)."""
-    return {"kv": P(None, None, None, "tp", None)}
+    """Paged KV pool [L*P, page, 2*Kv, h] (flat layer-major pages, K/V
+    interleaved): combined KV heads over tp (tp must divide Kv, so each
+    rank holds whole K/V pairs). Pages are NOT sharded — every tp rank
+    holds its head-shard of every page, so block tables stay replicated
+    host-state and page indices are rank-agnostic (the same indirection
+    the dense cache's batch dim had for free)."""
+    return {"kv": P(None, None, "tp", None)}
 
 
 def activation_spec():

@@ -1,0 +1,42 @@
+"""Guard: bench.py's host-synthesized int8 tree must stay structurally
+identical to the real quantizing loader's output (ADVICE r2: a future
+llama tree change would otherwise silently make the bench build a
+different jitted graph than serving)."""
+
+import os
+import sys
+
+import jax
+import numpy as np
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from bench import synth_int8_params
+from kubeai_tpu.engine.weights import quantize_model_params
+from kubeai_tpu.models import llama
+from kubeai_tpu.models.base import ModelConfig
+
+
+def test_synth_tree_matches_quantized_loader():
+    # Tiny config with the 8b-int8 preset's *structure* (bf16 dense llama,
+    # untied lm_head, GQA) so the comparison is cheap but exercises every
+    # key the synth builds.
+    mc = ModelConfig(
+        vocab_size=256, hidden_size=64, intermediate_size=128,
+        num_layers=2, num_heads=4, num_kv_heads=2, dtype="bfloat16",
+    )
+    real = quantize_model_params(
+        jax.tree.map(np.asarray, llama.init_params(mc, jax.random.key(0))), mc
+    )
+    synth = synth_int8_params(mc)
+
+    real_s = jax.tree_util.tree_structure(real)
+    synth_s = jax.tree_util.tree_structure(synth)
+    assert real_s == synth_s, f"tree structure diverged:\n{real_s}\nvs\n{synth_s}"
+
+    real_leaves = jax.tree_util.tree_leaves_with_path(real)
+    synth_leaves = jax.tree_util.tree_leaves_with_path(synth)
+    for (pr, lr), (ps, ls) in zip(real_leaves, synth_leaves):
+        assert pr == ps
+        assert lr.shape == ls.shape, f"{pr}: {lr.shape} != {ls.shape}"
+        assert lr.dtype == ls.dtype, f"{pr}: {lr.dtype} != {ls.dtype}"

@@ -221,12 +221,12 @@ def test_failed_prefill_unregisters_planned_pages():
         prompt = np.random.default_rng(11).integers(1, 200, 48).tolist()
         p = SamplingParams(temperature=0.0, max_tokens=4)
 
-        real = eng._prefill_jit
+        real = eng._prefill_batch_jit
 
         def boom(*a, **k):
             raise RuntimeError("injected prefill failure")
 
-        eng._prefill_jit = boom
+        eng._prefill_batch_jit = boom
         r = eng.submit(list(prompt), p)
         ev = r.out.get(timeout=60)
         assert ev[0] == "error" and "prefill failed" in ev[1]
@@ -236,7 +236,7 @@ def test_failed_prefill_unregisters_planned_pages():
         assert eng._pool.used() == 0
 
         # Restore and confirm the same prompt now runs cold + correctly.
-        eng._prefill_jit = real
+        eng._prefill_batch_jit = real
         ref = mk_engine(prefix_cache_min=0, seed=11)
         try:
             assert eng.generate(prompt, p)[0] == ref.generate(prompt, p)[0]
