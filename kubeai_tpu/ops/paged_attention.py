@@ -44,6 +44,7 @@ def paged_attention_ragged(
     kv_lens = jnp.minimum(kv_lengths, max_pages * page).astype(jnp.int32)
     num_seqs = jnp.asarray([B], jnp.int32)
 
+    tuning = {}
     if jax.default_backend() == "cpu":
         fn = _cpu_twin
     else:
@@ -52,14 +53,21 @@ def paged_attention_ragged(
         )
 
         fn = ragged_paged_attention
+        # The kernel's default scoped-VMEM budget (16MB) under-provisions
+        # large-head configs: an 8B-class (H=32, Kv=8, h=128) prefill
+        # needs ~16.4MB of kernel stack and dies in compile ("Ran out of
+        # memory in memory space vmem"). v5e/v5p have 128MB VMEM; 64MB
+        # leaves XLA plenty for the surrounding fusion.
+        tuning["vmem_limit_bytes"] = 64 * 1024 * 1024
     # One argument construction for BOTH arms (the twin is signature-
     # identical to the kernel), so CPU tests exercise the exact call the
-    # TPU makes.
+    # TPU makes; TPU-only tuning kwargs ride separately.
     out = fn(
         q_flat, kv_pages, kv_lens, page_table.astype(jnp.int32),
         cu_q_lens, num_seqs,
         sm_scale=float(scale),
         soft_cap=softcap if softcap > 0.0 else None,
+        **tuning,
     )
     return out.reshape(B, S, H, h).astype(q.dtype)
 

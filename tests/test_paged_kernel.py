@@ -62,10 +62,11 @@ def test_tpu_dispatch_arm_builds_identical_call(monkeypatch):
 
     recorded = {}
 
-    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None):
+    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None, vmem_limit_bytes=None):
         recorded.update(
             q=q_flat, pages=kv_pages, lens=kv_lens, table=page_indices,
             cu=cu_q_lens, n=num_seqs, scale=sm_scale, cap=soft_cap,
+            vmem=vmem_limit_bytes,
         )
         return pa._cpu_twin(
             q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,
@@ -90,6 +91,9 @@ def test_tpu_dispatch_arm_builds_identical_call(monkeypatch):
     np.testing.assert_array_equal(np.asarray(recorded["cu"]), np.arange(B + 1) * S)
     np.testing.assert_array_equal(np.asarray(recorded["lens"]), [10, 30])
     np.testing.assert_array_equal(np.asarray(recorded["n"]), [B])
+    # The raised scoped-VMEM budget must reach the kernel (8B-class heads
+    # exceed the 16MB default during prefill).
+    assert recorded["vmem"] == 64 * 1024 * 1024
     assert recorded["scale"] == pytest.approx(h**-0.5)
     assert recorded["cap"] == 25.0
 
