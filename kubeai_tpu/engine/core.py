@@ -154,12 +154,27 @@ class Engine:
         params,
         tokenizer,
         engine_config: EngineConfig | None = None,
+        mesh=None,
+        publisher=None,
     ):
         self.cfg = engine_config or EngineConfig()
         self.model_config = model_config
         self.params = params
         self.tokenizer = tokenizer
+        # Multi-host lockstep (engine/gang.py): when this process is one
+        # rank of a multi-process gang, device state must be GLOBAL mesh
+        # arrays (every rank holds its shard) and — on rank 0 — every
+        # jitted dispatch is broadcast to follower ranks first, which
+        # replay it (same op order, same numpy args, own device carries).
+        self._mesh = mesh
+        self._publisher = publisher
+        self._multiproc = mesh is not None and jax.process_count() > 1
         self._queue: "queue.Queue[Request]" = queue.Queue(maxsize=self.cfg.max_queue)
+        # Auxiliary device work (embeddings) routed through the scheduler
+        # thread so ALL device dispatch is serialized on one thread —
+        # jitted calls from handler threads would contend with decode
+        # chunks (and break the lockstep ordering gang followers mirror).
+        self._aux: "queue.Queue[tuple]" = queue.Queue()
         self._slots: list[_Slot | None] = [None] * self.cfg.max_slots
         self._n_active = 0
         self._running = False
@@ -207,6 +222,25 @@ class Engine:
         self.m_spec_accepted = default_registry.counter(
             "kubeai_engine_speculative_accepted_total", "draft tokens accepted"
         )
+        # Weight residency evidence: on a tp gang each rank's local bytes
+        # are ~global/ranks (the multi-host e2e asserts this — the model
+        # provably spans the gang rather than being replicated).
+        self.m_param_global = default_registry.gauge(
+            "kubeai_engine_param_bytes_global", "total model parameter bytes"
+        )
+        self.m_param_local = default_registry.gauge(
+            "kubeai_engine_param_bytes_local", "parameter bytes resident on this rank"
+        )
+        g_bytes = l_bytes = 0
+        for leaf in jax.tree_util.tree_leaves(self.params):
+            g_bytes += leaf.nbytes
+            shards = getattr(leaf, "addressable_shards", None)
+            if shards is not None:
+                l_bytes += sum(s.data.nbytes for s in shards)
+            else:
+                l_bytes += leaf.nbytes
+        self.m_param_global.set(g_bytes)
+        self.m_param_local.set(l_bytes)
 
         self._init_device_state()
         self._build_step_fns()
@@ -221,13 +255,50 @@ class Engine:
         self._max_pages = -(-self.cfg.max_seq_len // ps)
         P = self.cfg.num_pages or (B * self._max_pages + 1)
         self._pool = PagePool(P, ps)
-        self._cache = llama.init_paged_cache(self.model_config, P, ps)
         # Device-resident token history for speculative n-gram drafting
         # (written positions only; padded past max_seq_len so in-chunk
         # speculation overshoot after a finish never scatter-collides).
         G = self.cfg.speculate_tokens
         hist_width = self.cfg.max_seq_len + (self.cfg.decode_chunk + 1) * (G + 1)
-        self._tok_hist = jnp.zeros((B, hist_width), jnp.int32)
+
+        def mk_device_arrays():
+            cache = llama.init_paged_cache(self.model_config, P, ps)
+            tok_hist = jnp.zeros((B, hist_width), jnp.int32)
+            adm_toks = jnp.zeros((B,), jnp.int32)
+            lengths = jnp.zeros((B,), jnp.int32)
+            last_tokens = jnp.zeros((B,), jnp.int32)
+            # PRNG state rides as RAW uint32 key data (wrapped in-graph
+            # by decode_fn): typed key arrays can't take NamedShardings
+            # uniformly across versions, and raw data crosses the
+            # jit boundary identically on every rank.
+            keys = jax.random.key_data(jax.random.split(jax.random.key(0), B))
+            return cache, tok_hist, adm_toks, lengths, last_tokens, keys
+
+        if self._multiproc:
+            # Every rank runs the SAME jitted init with explicit global
+            # out_shardings: the KV pool is tp-sharded over heads, the
+            # small per-slot state fully replicated. Eager jnp.zeros
+            # would pin process-local arrays that a global-mesh jit
+            # rejects.
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from kubeai_tpu.parallel.sharding import paged_cache_specs
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            cache_sh = {
+                k: NamedSharding(self._mesh, s)
+                for k, s in paged_cache_specs().items()
+            }
+            out = jax.jit(
+                mk_device_arrays,
+                out_shardings=(cache_sh, repl, repl, repl, repl, repl),
+            )()
+        else:
+            out = mk_device_arrays()
+        (
+            self._cache, self._tok_hist, self._adm_toks,
+            self._lengths, self._last_tokens, self._keys,
+        ) = out
         # Host-authoritative block tables, uploaded per dispatch (tiny).
         self._page_table = np.zeros((B, self._max_pages), np.int32)
         # Per-slot request state is HOST-authoritative numpy, uploaded
@@ -254,7 +325,6 @@ class Engine:
         self._adm_len = np.zeros((B,), np.int32)
         self._adm_seed = np.zeros((B,), np.uint32)
         self._adm_hist = np.zeros((B, hist_width), np.int32) if G > 0 else None
-        self._adm_toks = jnp.zeros((B,), jnp.int32)
         self._slot_pages: list[list[int]] = [[] for _ in range(B)]
         # Pages content-registered at plan time whose prefill has NOT yet
         # succeeded (cleared by _register): a failed prefill must
@@ -267,11 +337,6 @@ class Engine:
         self.m_pages_total.set(P - 1)
         self.m_pages_used.set(0)
         self.m_pages_cached.set(0)
-        # Device carries: state that evolves on-device between host
-        # syncs, donated through every decode dispatch.
-        self._lengths = jnp.zeros((B,), jnp.int32)
-        self._last_tokens = jnp.zeros((B,), jnp.int32)
-        self._keys = jax.random.split(jax.random.key(0), B)
         # Prefix bookkeeping: per slot, the token ids whose KV has been
         # written to the slot's pages (generated-token pages are content-
         # registered from this at free time), and an epoch guarding
@@ -386,11 +451,13 @@ class Engine:
             adm_keys = jax.vmap(
                 lambda s: jax.random.fold_in(jax.random.key(s), 1)
             )(adm_seed)
+            # *keys* arrives as raw uint32 key data (see mk_device_arrays)
+            # and is wrapped here; returned as raw data again below.
             keys = jax.random.wrap_key_data(
                 jnp.where(
                     adm_mask[:, None],
                     jax.random.key_data(adm_keys),
-                    jax.random.key_data(keys),
+                    keys,
                 )
             )
             lengths = jnp.where(adm_mask, adm_len, lengths)
@@ -461,18 +528,45 @@ class Engine:
             (cache, hist, lengths, last, keys), (d_seq, c_seq, a_seq, lpd_seq, lpc_seq) = jax.lax.scan(
                 body, (cache, hist, lengths, last_tokens, keys), None, length=K
             )
-            return d_seq, c_seq, a_seq, lpd_seq, lpc_seq, cache, hist, lengths, last, keys
+            return (
+                d_seq, c_seq, a_seq, lpd_seq, lpc_seq,
+                cache, hist, lengths, last, jax.random.key_data(keys),
+            )
 
         # adm_toks (prefill arg 9 / chunk arg 10) and the cache are
         # donated through prefill calls; decode reads adm_toks without
         # donating it (it survives until the next prefill overwrites it).
-        self._prefill_chunk_jit = jax.jit(prefill_chunk_fn, donate_argnums=(10, 11))
-        self._prefill_batch_jit = jax.jit(prefill_batch_fn, donate_argnums=(9, 10))
+        # Multi-process gangs pin out_shardings explicitly: the KV pool
+        # keeps its tp sharding, everything the host reads back must be
+        # fully replicated (device_get on a cross-process-sharded array
+        # has no local copy to fetch) — single-host leaves GSPMD free.
+        shard_kw = {}
+        chunk_kw = {}
+        if self._multiproc:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            from kubeai_tpu.parallel.sharding import paged_cache_specs
+
+            repl = NamedSharding(self._mesh, PartitionSpec())
+            cache_sh = {
+                k: NamedSharding(self._mesh, s)
+                for k, s in paged_cache_specs().items()
+            }
+            shard_kw = {
+                "out_shardings": (repl, repl, repl, repl, repl, cache_sh, repl, repl, repl, repl)
+            }
+            chunk_kw = {"out_shardings": (repl, repl, cache_sh, repl)}
+        self._prefill_chunk_jit = jax.jit(
+            prefill_chunk_fn, donate_argnums=(10, 11), **chunk_kw
+        )
+        self._prefill_batch_jit = jax.jit(
+            prefill_batch_fn, donate_argnums=(9, 10), **chunk_kw
+        )
         # tables + per-slot request state (active/temp/top_p/top_k and
         # the adm_* merge arrays) are host-authoritative numpy uploaded
         # per dispatch — not donated. cache/hist/lengths/last/keys are
         # the device carries.
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 3, 4, 5, 6))
+        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 3, 4, 5, 6), **shard_kw)
 
     # -- public API --------------------------------------------------------
 
@@ -491,7 +585,14 @@ class Engine:
                 # mutating slot state here would race it. Callers time out;
                 # the process is going down anyway.
                 log.warning("engine loop did not exit; skipping in-flight cleanup")
+                if self._publisher is not None:
+                    self._publisher.close()
                 return
+        # Close AFTER the scheduler thread is done: closing first would
+        # race its in-flight _bcast onto a dead socket, spuriously
+        # triggering the fatal-gang path during a clean shutdown.
+        if self._publisher is not None:
+            self._publisher.close()  # sends the followers "stop"
         # Fail anything still in flight so callers never hang on shutdown.
         self._fail_inflight("engine shutting down")
 
@@ -516,6 +617,12 @@ class Engine:
             except queue.Empty:
                 break
             req.out.put(("error", message))
+        while True:
+            try:
+                *_, rq = self._aux.get_nowait()
+            except queue.Empty:
+                break
+            rq.put(("error", message))
         self.m_queue.set(0)
 
     def submit(self, prompt_ids: list[int], params: SamplingParams, adapter: str | None = None) -> Request:
@@ -575,24 +682,16 @@ class Engine:
     def embed(self, prompts: list[list[int]]) -> np.ndarray:
         """Mean-pooled, L2-normalized final hidden states (the
         TextEmbedding feature; the reference delegates this to Infinity
-        containers). Runs outside the decode loop — a one-shot cache-free
-        forward whose dispatch interleaves with decode chunks."""
-        if not hasattr(self, "_embed_jit"):
-            mc = self.model_config
-
-            def embed_fn(params, tokens, lengths):
-                B, S = tokens.shape
-                pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
-                hidden, _ = llama.apply(params, mc, tokens, pos, return_hidden=True)
-                valid = (pos < lengths[:, None]).astype(jnp.float32)[..., None]
-                pooled = (hidden * valid).sum(1) / jnp.maximum(valid.sum(1), 1.0)
-                return pooled / jnp.maximum(
-                    jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
-                )
-
-            self._embed_jit = jax.jit(embed_fn)
-
-        out = []
+        containers). Each group is dispatched by the SCHEDULER thread
+        (queued via _aux) so embeds interleave between decode chunks
+        instead of contending with them; only the result fetch happens
+        here. Falls back to direct dispatch when the loop isn't running
+        (tests, one-shot tools)."""
+        self._ensure_embed_jit()
+        # Build every group first, then dispatch them all down ONE path —
+        # checking _running per group could interleave direct and queued
+        # results out of order if the engine stops mid-call.
+        groups: list[tuple[int, np.ndarray, np.ndarray]] = []
         max_prompt = max(self.cfg.prefill_buckets)
         B = self.cfg.max_slots
         for start in range(0, len(prompts), B):
@@ -609,15 +708,100 @@ class Engine:
             for i, p in enumerate(group):
                 tokens[i, : len(p)] = p
                 lengths[i] = len(p)
-            vecs = self._embed_jit(self.params, jnp.asarray(tokens), jnp.asarray(lengths))
-            out.append(np.asarray(jax.device_get(vecs))[: len(group)])
+            groups.append((len(group), tokens, lengths))
+
+        out = []
+        if self._running:
+            pending = []
+            for n, tokens, lengths in groups:
+                rq: "queue.Queue" = queue.Queue()
+                self._aux.put((tokens, lengths, rq))
+                self._wake.set()
+                pending.append((n, rq))
+            for n, rq in pending:
+                deadline = time.monotonic() + 600
+                while True:
+                    try:
+                        kind, val = rq.get(timeout=1.0)
+                        break
+                    except queue.Empty:
+                        # An enqueue that raced stop()'s _aux drain would
+                        # otherwise wait the full timeout for a reply
+                        # that can never come.
+                        if not self._running:
+                            raise RuntimeError("engine shutting down") from None
+                        if time.monotonic() > deadline:
+                            raise TimeoutError(
+                                "embedding produced no result within 600s "
+                                "(engine scheduler stalled?)"
+                            ) from None
+                if kind != "ok":
+                    raise RuntimeError(f"embedding failed: {val}")
+                out.append(np.asarray(jax.device_get(val))[:n])
+        else:
+            if self._multiproc:
+                # Followers only mirror dispatches published by the
+                # scheduler; a direct collective here would hang the gang.
+                raise RuntimeError("engine is not running")
+            for n, tokens, lengths in groups:
+                vecs = self._embed_jit(self.params, tokens, lengths)
+                out.append(np.asarray(jax.device_get(vecs))[:n])
         return np.concatenate(out, axis=0)
+
+    def _ensure_embed_jit(self) -> None:
+        if hasattr(self, "_embed_jit"):
+            return
+        mc = self.model_config
+
+        def embed_fn(params, tokens, lengths):
+            B, S = tokens.shape
+            pos = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
+            hidden, _ = llama.apply(params, mc, tokens, pos, return_hidden=True)
+            valid = (pos < lengths[:, None]).astype(jnp.float32)[..., None]
+            pooled = (hidden * valid).sum(1) / jnp.maximum(valid.sum(1), 1.0)
+            return pooled / jnp.maximum(
+                jnp.linalg.norm(pooled, axis=-1, keepdims=True), 1e-12
+            )
+
+        kw = {}
+        if self._multiproc:
+            from jax.sharding import NamedSharding, PartitionSpec
+
+            kw = {"out_shardings": NamedSharding(self._mesh, PartitionSpec())}
+        self._embed_jit = jax.jit(embed_fn, **kw)
+
+    def _run_aux(self) -> None:
+        """Execute one queued auxiliary dispatch (scheduler thread only).
+        One item per loop iteration so a large embed batch interleaves
+        with decode chunks instead of stalling them. Replies carry the
+        (async) device array; the caller's thread does the device_get."""
+        try:
+            tokens, lengths, rq = self._aux.get_nowait()
+        except queue.Empty:
+            return
+        try:
+            self._bcast("embed", arrays={"tokens": tokens, "lengths": lengths})
+        except OSError:
+            # Lost follower: this must reach _loop's recovery (which
+            # terminates the rank — the gang cannot realign), not be
+            # swallowed as a per-request error.
+            rq.put(("error", "gang follower lost"))
+            raise
+        try:
+            rq.put(("ok", self._embed_jit(self.params, tokens, lengths)))
+        except Exception as e:  # no donation: decode state is unharmed
+            log.exception("embed dispatch failed")
+            rq.put(("error", str(e)))
 
     # -- LoRA adapters -----------------------------------------------------
 
     def load_adapter(self, name: str, path: str) -> None:
         """Install a PEFT adapter into the bank (first load allocates it
         and costs one step-function recompile)."""
+        if self._multiproc:
+            # The adapter bank would need global-mesh allocation + a
+            # broadcast load op on every rank; not wired up yet.
+            raise ValueError("LoRA adapters are not yet supported on multi-host gangs")
         from kubeai_tpu.engine.lora import AdapterRuntime
 
         if self._adapters is None:
@@ -660,6 +844,75 @@ class Engine:
     def active_slots(self) -> int:
         return self._n_active
 
+    # -- gang follower (ranks > 0 of a multi-host slice) -------------------
+
+    def run_follower(self, follower) -> None:
+        """Execute rank 0's dispatch stream in lockstep (blocks until the
+        publisher sends "stop" or the connection drops). The follower
+        holds its own device carries (global-mesh shards); every op's
+        numpy arguments arrive on the wire, so the jitted computations
+        here are bit-identical to rank 0's and XLA's collectives line up.
+        No scheduler, no HTTP inference surface — the LB only routes to
+        rank 0 (loadbalancer gang awareness)."""
+        self._ensure_embed_jit()
+        while True:
+            try:
+                op, sc, ar = follower.recv()
+            except ConnectionError:
+                log.warning("gang publisher connection closed; follower exiting")
+                return
+            if op == "stop":
+                return
+            if op == "reset":
+                self._init_device_state()
+                continue
+            if op == "decode":
+                lora_args = {}
+                if self._adapters is not None:
+                    lora_args = {"lora": self._adapters.bank, "lora_rows": ar["lora_rows"]}
+                adm_hist = (
+                    {"adm_hist": ar["adm_hist"]} if self.cfg.speculate_tokens > 0 else {}
+                )
+                (
+                    _, _, _, _, _,
+                    self._cache, self._tok_hist, self._lengths,
+                    self._last_tokens, self._keys,
+                ) = self._decode_jit(
+                    self.params, self._cache, ar["tables"], self._tok_hist,
+                    self._lengths, self._last_tokens, self._keys,
+                    ar["active"], ar["temp"], ar["top_p"], ar["top_k"],
+                    ar["adm_mask"], ar["adm_len"], ar["adm_seed"],
+                    self._adm_toks, **adm_hist, **lora_args,
+                )
+            elif op == "prefill_batch":
+                lora_args = {}
+                if self._adapters is not None:
+                    lora_args = {"lora": self._adapters.bank, "lora_rows": ar["lora_rows"]}
+                _, _, self._cache, self._adm_toks = self._prefill_batch_jit(
+                    self.params, ar["tokens"], ar["lengths"], ar["tables"],
+                    ar["slots"], ar["seeds"], ar["temps"], ar["top_ps"],
+                    ar["top_ks"], self._adm_toks, self._cache, **lora_args,
+                )
+            elif op == "prefill_chunk":
+                lora_args = {}
+                if self._adapters is not None:
+                    lora_args = {
+                        "lora": self._adapters.bank,
+                        "lora_row": np.int32(sc["lora_row"]),
+                    }
+                _, _, self._cache, self._adm_toks = self._prefill_chunk_jit(
+                    self.params, ar["tokens"], np.int32(sc["start"]),
+                    np.int32(sc["last_idx"]), ar["table"], np.int32(sc["slot"]),
+                    np.uint32(sc["seed"]), np.float32(sc["temperature"]),
+                    np.float32(sc["top_p"]), np.int32(sc["top_k"]),
+                    self._adm_toks, self._cache, **lora_args,
+                )
+            elif op == "embed":
+                self._embed_jit(self.params, ar["tokens"], ar["lengths"])
+            else:
+                log.error("unknown gang op %r; stopping follower", op)
+                return
+
     # -- scheduler loop ----------------------------------------------------
 
     def _loop(self):
@@ -678,10 +931,14 @@ class Engine:
                 # its first tokens from the device staging vector, so
                 # this host round-trip overlaps device compute.
                 self._emit_admitted(admitted)
+                self._run_aux()
                 if pending is not None:
                     self._process_chunk(*pending)
                 pending = dispatched
-                if pending is None and not admitted and self._n_active == 0:
+                if (
+                    pending is None and not admitted and self._n_active == 0
+                    and self._aux.empty()
+                ):
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except Exception:
@@ -692,7 +949,30 @@ class Engine:
                 self._recover()
                 pending = None
 
+    def _bcast(self, op: str, scalars: dict | None = None, arrays: dict | None = None) -> None:
+        """Rank 0 of a gang: fan the upcoming dispatch out to followers
+        BEFORE executing it locally (order on the wire = dispatch order =
+        the lockstep contract). No-op single-host."""
+        if self._publisher is not None:
+            self._publisher.publish(op, scalars, arrays)
+
     def _recover(self):
+        try:
+            self._bcast("reset")
+        except OSError:
+            if self._running:
+                # A follower is gone: the gang's collectives can never
+                # line up again, so serving from this process is over.
+                # Error everything in flight, then exit for the
+                # controller to recreate the whole slice gang (same
+                # blast radius as losing a Ray/NCCL rank in the
+                # reference's delegated engines). Exiting without
+                # cleanup would leave clients hanging until timeout.
+                log.critical("gang follower connection lost; terminating rank 0")
+                self._fail_inflight("gang follower lost; slice restarting")
+                import os as _os
+
+                _os._exit(13)
         self._fail_inflight("engine reset after device error")
         self._init_device_state()
 
@@ -934,6 +1214,16 @@ class Engine:
             bucket = max_bucket if not is_last else self._bucket(len(chunk))
             chunk_padded = np.zeros((1, bucket), np.int32)
             chunk_padded[0, : len(chunk)] = chunk
+            self._bcast(
+                "prefill_chunk",
+                scalars={
+                    "start": start, "last_idx": len(chunk) - 1,
+                    "slot": slot_idx, "seed": int(seed),
+                    "temperature": float(sp.temperature), "top_p": float(sp.top_p),
+                    "top_k": int(sp.top_k), "lora_row": lora_row,
+                },
+                arrays={"tokens": chunk_padded, "table": table},
+            )
             tok, lp, self._cache, self._adm_toks = self._prefill_chunk_jit(
                 self.params,
                 chunk_padded,
@@ -1053,6 +1343,14 @@ class Engine:
         lora_args = {}
         if self._adapters is not None:
             lora_args = {"lora": self._adapters.bank, "lora_rows": lora_rows_arr}
+        self._bcast(
+            "prefill_batch",
+            arrays={
+                "tokens": tokens, "lengths": lengths, "tables": tables,
+                "slots": slots_arr, "seeds": seeds, "temps": temps,
+                "top_ps": top_ps, "top_ks": top_ks, "lora_rows": lora_rows_arr,
+            },
+        )
         toks, lps, self._cache, self._adm_toks = self._prefill_batch_jit(
             self.params,
             tokens,
@@ -1087,6 +1385,17 @@ class Engine:
             {"adm_hist": self._adm_hist.copy()}
             if self.cfg.speculate_tokens > 0
             else {}
+        )
+        self._bcast(
+            "decode",
+            arrays={
+                "tables": self._page_table, "active": self._h_active,
+                "temp": self._h_temp, "top_p": self._h_top_p,
+                "top_k": self._h_top_k, "adm_mask": self._adm_mask,
+                "adm_len": self._adm_len, "adm_seed": self._adm_seed,
+                **({"adm_hist": self._adm_hist} if self.cfg.speculate_tokens > 0 else {}),
+                **({"lora_rows": self._h_lora_rows} if self._adapters is not None else {}),
+            },
         )
         (
             d_seq, c_seq, a_seq, lpd_seq, lpc_seq,

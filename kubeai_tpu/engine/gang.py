@@ -1,0 +1,174 @@
+"""Lockstep dispatch fan-out for multi-host tensor-parallel serving.
+
+The reference serves multi-chip models by delegating to vLLM+Ray
+(ref: manifests/models/llama-3.1-8b-instruct-tpu.yaml:12-14
+`--tensor-parallel-size=4 --distributed-executor-backend=ray`); here the
+gang IS the engine: every rank of a multi-host slice holds its
+tp-shard of the weights and KV pool (jax.sharding over the global mesh)
+and executes the SAME jitted steps in the same order — XLA's collectives
+over ICI/DCN do the cross-chip math. JAX's multi-controller model makes
+this a pure control-plane problem: each process must simply issue
+identical computations with identical host arguments. Rank 0 owns the
+scheduler (request queues, paging, admission — tiny host state); before
+every jitted dispatch it broadcasts the op name plus its numpy/scalar
+arguments to the followers, which replay the call against their own
+device carries. Per-dispatch payloads are a few KB (token ids, block
+tables, per-slot flags) — negligible next to a decode chunk's compute.
+
+Wire format (one TCP stream per follower, order = dispatch order):
+    4-byte big-endian header length | header JSON | raw array bytes
+    header = {"op": str, "scalars": {...},
+              "arrays": [[name, dtype, shape], ...]}
+Arrays ride as raw C-order bytes in header order. No pickle — the
+channel crosses pod boundaries, and a codec this small is cheaper to
+audit than to sandbox.
+
+A broken follower connection is fatal for the gang (the next collective
+would hang anyway): the publisher raises, the engine's recovery errors
+in-flight requests, and the pod exits for the controller to recreate
+the slice gang — the same blast radius as losing a NCCL rank in the
+reference's Ray workers.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import socket
+import struct
+import threading
+import time
+
+import numpy as np
+
+log = logging.getLogger("kubeai_tpu.engine.gang")
+
+DEFAULT_GANG_PORT = 8477
+
+
+def _encode(op: str, scalars: dict | None, arrays: dict[str, np.ndarray] | None) -> bytes:
+    names, meta, blobs = [], [], []
+    for name, arr in (arrays or {}).items():
+        a = np.ascontiguousarray(arr)
+        names.append(name)
+        meta.append([name, a.dtype.str, list(a.shape)])
+        blobs.append(a.tobytes())
+    header = json.dumps(
+        {"op": op, "scalars": scalars or {}, "arrays": meta}
+    ).encode()
+    return b"".join([struct.pack(">I", len(header)), header] + blobs)
+
+
+def _read_exact(f, n: int) -> bytes:
+    buf = b""
+    while len(buf) < n:
+        chunk = f.read(n - len(buf))
+        if not chunk:
+            raise ConnectionError("gang stream closed")
+        buf += chunk
+    return buf
+
+
+def _decode(f) -> tuple[str, dict, dict[str, np.ndarray]]:
+    (hlen,) = struct.unpack(">I", _read_exact(f, 4))
+    header = json.loads(_read_exact(f, hlen))
+    arrays: dict[str, np.ndarray] = {}
+    for name, dtype, shape in header["arrays"]:
+        dt = np.dtype(dtype)
+        n = int(np.prod(shape, dtype=np.int64)) * dt.itemsize
+        arrays[name] = np.frombuffer(_read_exact(f, n), dt).reshape(shape).copy()
+    return header["op"], header["scalars"], arrays
+
+
+class GangPublisher:
+    """Rank 0's side: accept one connection per follower, then fan every
+    dispatch out in order. publish() is called from the engine scheduler
+    thread (and, rarely, adapter RPC threads) — serialized by a lock."""
+
+    def __init__(self, n_followers: int, port: int = DEFAULT_GANG_PORT, host: str = "0.0.0.0"):
+        self.n_followers = n_followers
+        self._lock = threading.Lock()
+        self._conns: list[socket.socket] = []
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind((host, port))
+        self._srv.listen(n_followers)
+        self.port = self._srv.getsockname()[1]
+
+    def accept_all(self, timeout: float = 300.0) -> None:
+        """Block until every follower has connected (gang assembly)."""
+        self._srv.settimeout(timeout)
+        deadline = time.monotonic() + timeout
+        while len(self._conns) < self.n_followers:
+            self._srv.settimeout(max(1.0, deadline - time.monotonic()))
+            conn, addr = self._srv.accept()
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            self._conns.append(conn)
+            log.info("gang follower %d/%d connected from %s", len(self._conns), self.n_followers, addr)
+
+    def publish(self, op: str, scalars: dict | None = None, arrays: dict[str, np.ndarray] | None = None) -> None:
+        payload = _encode(op, scalars, arrays)
+        with self._lock:
+            for conn in self._conns:
+                conn.sendall(payload)
+
+    def close(self) -> None:
+        # Best-effort "stop": if the scheduler thread is wedged inside
+        # publish() (follower stopped reading, TCP window full) it holds
+        # _lock — blocking here would deadlock shutdown. Skip the
+        # farewell; closing the sockets below unblocks the wedged sendall
+        # and the followers see EOF.
+        if self._lock.acquire(timeout=2.0):
+            try:
+                payload = _encode("stop", None, None)
+                for conn in self._conns:
+                    try:
+                        conn.sendall(payload)
+                    except OSError:
+                        pass
+            finally:
+                self._lock.release()
+        for c in self._conns:
+            try:
+                c.close()
+            except OSError:
+                pass
+        self._srv.close()
+
+
+class GangFollower:
+    """Rank >0's side: connect to rank 0 and yield ops in order."""
+
+    def __init__(self, host: str, port: int = DEFAULT_GANG_PORT, timeout: float = 300.0):
+        deadline = time.monotonic() + timeout
+        last_err: Exception | None = None
+        while True:
+            try:
+                self._sock = socket.create_connection((host, port), timeout=10)
+                break
+            except OSError as e:  # rank 0 not listening yet
+                last_err = e
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"could not reach gang publisher {host}:{port}: {last_err}"
+                    ) from last_err
+                time.sleep(0.5)
+        self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+        # Blocking reads: the dispatch stream is idle whenever rank 0 has
+        # no requests (the connect timeout must not apply to recv).
+        self._sock.settimeout(None)
+        self._file = self._sock.makefile("rb")
+        log.info("connected to gang publisher %s:%d", host, port)
+
+    def recv(self) -> tuple[str, dict, dict[str, np.ndarray]]:
+        return _decode(self._file)
+
+    def close(self) -> None:
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        try:
+            self._sock.close()
+        except OSError:
+            pass

@@ -495,7 +495,7 @@ def _resolve_model_path(model: str) -> str:
     )
 
 
-def build_engine_from_args(args) -> tuple[Engine, str]:
+def build_engine_from_args(args, publisher=None) -> tuple[Engine, str]:
     from kubeai_tpu.engine.core import EngineConfig, build_test_engine
 
     ec = EngineConfig(
@@ -518,23 +518,25 @@ def build_engine_from_args(args) -> tuple[Engine, str]:
         ec,
         tp=args.tensor_parallel_size,
         quantization=args.quantization,
+        publisher=publisher,
     )
     return eng, args.served_model_name or args.model
 
 
-def maybe_init_distributed() -> None:
+def maybe_init_distributed() -> list[str] | None:
     """Multi-host slice bootstrap: the controller stamps gang pods with
     TPU_WORKER_ID + TPU_WORKER_HOSTNAMES (controller/engines/tpu.py);
     rank 0's host serves as the jax.distributed coordinator so the gang
-    forms one device mesh across hosts. No-op for single-host pods."""
+    forms one device mesh across hosts. Returns the gang host list (or
+    None for single-host pods)."""
     import os
 
     hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
     if not hostnames:
-        return
+        return None
     hosts = [h.strip() for h in hostnames.split(",") if h.strip()]
     if len(hosts) < 2:
-        return
+        return None
     import jax
 
     process_id = int(os.environ.get("TPU_WORKER_ID", "0"))
@@ -545,6 +547,74 @@ def maybe_init_distributed() -> None:
         num_processes=len(hosts),
         process_id=process_id,
     )
+    return hosts
+
+
+def _gang_port() -> int:
+    from kubeai_tpu.engine.gang import DEFAULT_GANG_PORT
+
+    return int(os.environ.get("KUBEAI_GANG_PORT", str(DEFAULT_GANG_PORT)))
+
+
+def run_follower(args, hosts: list[str]) -> None:
+    """Serve as a gang follower (rank > 0): build the same engine over
+    the global mesh, connect to rank 0's dispatch stream, expose ONLY
+    health/metrics over HTTP (the LB routes inference to rank 0), and
+    replay dispatches until rank 0 stops or the stream drops — then exit
+    so the controller recreates the slice gang."""
+    from kubeai_tpu.engine.gang import GangFollower
+
+    follower = GangFollower(hosts[0], _gang_port())
+    engine, name = build_engine_from_args(args)
+
+    class FollowerHandler(BaseHTTPRequestHandler):
+        protocol_version = "HTTP/1.1"
+
+        def log_message(self, fmt, *a):
+            log.debug("%s " + fmt, self.address_string(), *a)
+
+        def do_GET(self):
+            path = self.path.split("?")[0]
+            if path in ("/health", "/healthz", "/readyz"):
+                body = json.dumps(
+                    {"status": "ok", "model": name, "role": "follower"}
+                ).encode()
+                ctype = "application/json"
+            elif path == "/metrics":
+                try:
+                    engine.refresh_memory_stats()
+                except Exception:
+                    pass
+                body = default_registry.render().encode()
+                ctype = "text/plain; version=0.0.4"
+            else:
+                body = json.dumps(
+                    {"error": {"message": "follower rank serves no inference"}}
+                ).encode()
+                ctype = "application/json"
+                self.send_response(404)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+                return
+            self.send_response(200)
+            self.send_header("Content-Type", ctype)
+            self.send_header("Content-Length", str(len(body)))
+            self.end_headers()
+            self.wfile.write(body)
+
+        do_POST = do_GET
+
+    httpd = ThreadingHTTPServer((args.host, args.port), FollowerHandler)
+    threading.Thread(target=httpd.serve_forever, daemon=True).start()
+    log.info("gang follower rank %d serving health on :%d", int(os.environ.get("TPU_WORKER_ID", "0")), httpd.server_port)
+    try:
+        engine.run_follower(follower)
+    finally:
+        httpd.shutdown()
+        follower.close()
+    log.info("gang follower exiting")
 
 
 def main(argv=None):
@@ -567,7 +637,7 @@ def main(argv=None):
         os.makedirs(cache_dir, exist_ok=True)
         jax.config.update("jax_compilation_cache_dir", cache_dir)
         jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    maybe_init_distributed()
+    gang_hosts = maybe_init_distributed()
 
     parser = argparse.ArgumentParser("kubeai-tpu-engine")
     parser.add_argument("--model", required=True, help="checkpoint dir or test:tiny")
@@ -597,7 +667,29 @@ def main(argv=None):
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
-    engine, name = build_engine_from_args(args)
+    publisher = None
+    if gang_hosts and args.model.startswith("test:"):
+        # build_test_engine has no mesh/publisher plumbing: rank 0 would
+        # serve unsharded and never publish, stranding the followers.
+        parser.error("test: models cannot serve on a multi-host gang")
+    if gang_hosts:
+        import jax
+
+        rank = jax.process_index()
+        if rank > 0:
+            run_follower(args, gang_hosts)
+            return
+        # Rank 0: every dispatch fans out to the followers (lockstep
+        # tensor-parallel serving over the slice; engine/gang.py).
+        from kubeai_tpu.engine.gang import GangPublisher
+
+        publisher = GangPublisher(len(gang_hosts) - 1, port=_gang_port())
+
+    engine, name = build_engine_from_args(args, publisher=publisher)
+    if publisher is not None:
+        # Gang assembly: block until every follower is wired up before
+        # serving (a dispatch before that would strand the followers).
+        publisher.accept_all()
     srv = EngineServer(engine, name, host=args.host, port=args.port)
     srv.start()
     log.info("serving %s", name)

@@ -95,8 +95,16 @@ def load_engine_from_path(
     tp: int = 1,
     dtype: str = "bfloat16",
     quantization: str = "",
+    publisher=None,
 ) -> Engine:
-    """Build an Engine from an HF-format checkpoint directory."""
+    """Build an Engine from an HF-format checkpoint directory.
+
+    When the process is one rank of a multi-host gang
+    (jax.process_count() > 1), the tp mesh spans the GLOBAL device set:
+    every rank loads the checkpoint, contributes its addressable weight
+    shards (shard_tree), and the Engine allocates global device state.
+    Rank 0 additionally passes *publisher* (engine/gang.py) so its
+    dispatches fan out to the follower ranks."""
     if quantization:
         if quantization != "int8":
             raise ValueError(f"unsupported quantization {quantization!r} (supported: int8)")
@@ -111,10 +119,14 @@ def load_engine_from_path(
     sd = load_state_dict(path)
     if "lm_head.weight" not in sd and not config.tie_word_embeddings:
         config = config.replace(tie_word_embeddings=True)
+    multiproc = jax.process_count() > 1
     # int8: build + quantize on host so full-precision weights never touch
     # HBM, then device_put the int8 tree ONCE (leaving it numpy would
-    # re-upload the model on every jitted step).
-    params = llama.params_from_hf(sd, config, to_device=quantization != "int8")
+    # re-upload the model on every jitted step). Multi-process: stay on
+    # host until shard_tree assembles the global arrays.
+    params = llama.params_from_hf(
+        sd, config, to_device=quantization != "int8" and not multiproc
+    )
     params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
     if quantization == "int8":
         params = quantize_model_params(params, config)
@@ -123,13 +135,38 @@ def load_engine_from_path(
     ec = engine_config or EngineConfig()
     tokenizer = load_tokenizer(path)
 
-    if tp > 1:
-        mesh = make_mesh(tp=tp)
+    if tp > 1 or multiproc:
+        if multiproc:
+            # The gang mesh must take tp/num_processes devices from EACH
+            # process — jax.devices() is process-major, so a naive
+            # devices[:tp] prefix would land entirely on rank 0 and
+            # followers could not address their shards.
+            n_proc = jax.process_count()
+            if tp <= 1:
+                tp = jax.device_count()  # bare gang pods: span the slice
+            if tp % n_proc != 0:
+                raise ValueError(
+                    f"--tensor-parallel-size must be a multiple of the gang "
+                    f"size (tp={tp}, processes={n_proc})"
+                )
+            per = tp // n_proc
+            devs = []
+            for p in range(n_proc):
+                mine = [d for d in jax.devices() if d.process_index == p][:per]
+                if len(mine) < per:
+                    raise ValueError(
+                        f"process {p} has {len(mine)} devices; tp={tp} needs "
+                        f"{per} per process"
+                    )
+                devs += mine
+            mesh = make_mesh(tp=tp, devices=devs)
+        else:
+            mesh = make_mesh(tp=tp)
         params = shard_tree(params, llama_param_specs(config), mesh)
         # Cache + step functions inherit shardings via XLA propagation from
         # the params; the engine jits inside this mesh context.
         with mesh:
-            return Engine(config, params, tokenizer, ec)
+            return Engine(config, params, tokenizer, ec, mesh=mesh, publisher=publisher)
     return Engine(config, params, tokenizer, ec)
 
 

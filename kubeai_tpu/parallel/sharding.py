@@ -81,10 +81,27 @@ def activation_spec():
 
 
 def shard_tree(tree, specs, mesh: Mesh):
-    """Device-put a pytree according to a matching PartitionSpec tree."""
-    return jax.tree_util.tree_map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), tree, specs
-    )
+    """Device-put a pytree according to a matching PartitionSpec tree.
+
+    Multi-process meshes (multi-host slice gangs): every rank calls this
+    with the SAME host data (each loads the checkpoint itself) and
+    contributes only its addressable shards — jax.device_put can't
+    target non-addressable devices, so the global array is assembled
+    via make_array_from_callback."""
+    import numpy as np
+
+    multiproc = jax.process_count() > 1
+
+    def put(x, s):
+        sharding = NamedSharding(mesh, s)
+        if multiproc:
+            xa = np.asarray(x)
+            return jax.make_array_from_callback(
+                xa.shape, sharding, lambda idx: xa[idx]
+            )
+        return jax.device_put(x, sharding)
+
+    return jax.tree_util.tree_map(put, tree, specs)
 
 
 def named(specs, mesh: Mesh):

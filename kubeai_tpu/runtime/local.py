@@ -158,14 +158,30 @@ class LocalRuntime:
                 gang_port = self._gang_ports.get(sid)
                 if gang_port is None:
                     gang_port = self._gang_ports[sid] = free_port()
+                # Second per-slice port: rank 0's lockstep dispatch
+                # stream (engine/gang.py); distinct pods share one IP
+                # here, unlike in-cluster where the default port works.
+                data_port = self._gang_ports.get(sid + "/dispatch")
+                if data_port is None:
+                    data_port = self._gang_ports[sid + "/dispatch"] = free_port()
             env["TPU_WORKER_HOSTNAMES"] = ",".join(["127.0.0.1"] * n_hosts)
             env["TPU_COORDINATOR_PORT"] = str(gang_port)
+            env["KUBEAI_GANG_PORT"] = str(data_port)
         log.info("launching pod %s: %s (port %d)", pod.meta.name, " ".join(cmd[:4]), port)
+        # KUBEAI_POD_LOGS=<dir> tees pod output to per-pod files (the
+        # LocalRuntime analogue of `kubectl logs`; indispensable when a
+        # gang rank dies during bring-up).
+        logdir = os.environ.get("KUBEAI_POD_LOGS", "")
+        if logdir:
+            os.makedirs(logdir, exist_ok=True)
+            stdout = open(os.path.join(logdir, f"{pod.meta.name}.log"), "ab")
+        else:
+            stdout = subprocess.DEVNULL
         try:
             proc = subprocess.Popen(
                 cmd,
                 env=env,
-                stdout=subprocess.DEVNULL,
+                stdout=stdout,
                 stderr=subprocess.STDOUT,
                 start_new_session=True,
             )
@@ -173,6 +189,9 @@ class LocalRuntime:
             log.error("failed to launch pod %s: %s", pod.meta.name, e)
             self._set_status(pod.meta.name, phase="Failed")
             return
+        finally:
+            if stdout is not subprocess.DEVNULL:
+                stdout.close()  # the child holds its own dup of the fd
         with self._lock:
             self._procs[pod.meta.name] = LocalProcess(pod.meta.name, proc, port)
         self._set_status(pod.meta.name, phase="Running", scheduled=True, pod_ip="127.0.0.1", port=port)

@@ -10,6 +10,12 @@ import os
 # Force CPU even when a real TPU is attached (JAX_PLATFORMS may be pre-set
 # to the TPU platform in the environment): CI must not depend on hardware.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# The axon sitecustomize (gated on this var) force-registers the remote
+# TPU backend via jax.config, which OVERRIDES JAX_PLATFORMS — and e2e
+# subprocess pods inherit this environment, so scrub it here or gang
+# pods silently attach the real TPU instead of the CPU mesh (cf. the
+# identical scrub in bench.py::probe_device).
+os.environ.pop("PALLAS_AXON_POOL_IPS", None)
 flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in flags:
     os.environ["XLA_FLAGS"] = (
