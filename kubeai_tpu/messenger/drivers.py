@@ -14,8 +14,13 @@ scheme (keeping them out of the core's import path):
 
     gcppubsub://projects/P/{topics/T,subscriptions/S}   (gcp_pubsub.py)
     kafka://TOPIC  /  kafka://GROUP?topic=TOPIC          (kafka_driver.py)
+    awssqs://sqs.REGION.amazonaws.com/ACCT/QUEUE         (sqs_driver.py)
+    nats://SUBJECT  /  nats://SUBJECT?queue=GROUP        (nats_driver.py)
+    rabbit://QUEUE                                       (amqp_driver.py)
+    azuresb://QUEUE                                      (azuresb_driver.py)
 
-Additional schemes register via `register_driver`.
+— the reference's full six-bus matrix. Additional schemes register via
+`register_driver`.
 """
 
 from __future__ import annotations
@@ -191,6 +196,25 @@ def _load_cloud_driver(scheme: str) -> None:
         from kubeai_tpu.messenger.kafka_driver import KafkaSubscription, KafkaTopic
 
         register_driver("kafka", KafkaTopic, KafkaSubscription)
+    elif scheme == "awssqs":
+        from kubeai_tpu.messenger.sqs_driver import SqsSubscription, SqsTopic
+
+        register_driver("awssqs", SqsTopic, SqsSubscription)
+    elif scheme == "nats":
+        from kubeai_tpu.messenger.nats_driver import NatsSubscription, NatsTopic
+
+        register_driver("nats", NatsTopic, NatsSubscription)
+    elif scheme == "rabbit":
+        from kubeai_tpu.messenger.amqp_driver import AmqpSubscription, AmqpTopic
+
+        register_driver("rabbit", AmqpTopic, AmqpSubscription)
+    elif scheme == "azuresb":
+        from kubeai_tpu.messenger.azuresb_driver import (
+            AzureSbSubscription,
+            AzureSbTopic,
+        )
+
+        register_driver("azuresb", AzureSbTopic, AzureSbSubscription)
 
 
 def _driver(scheme: str) -> tuple:

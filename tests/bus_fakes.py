@@ -1,0 +1,500 @@
+"""In-repo fake brokers for the SQS / NATS / RabbitMQ / Azure SB drivers
+(the same pattern as tests/kafka_fake.py and tests/pubsub_fake.py: real
+wire protocol, in-memory state, injectable failures)."""
+
+from __future__ import annotations
+
+import json
+import queue
+import socket
+import threading
+import time
+import uuid
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+
+# -- AWS SQS -----------------------------------------------------------------
+
+
+class FakeSQS:
+    """Speaks the SQS JSON protocol (X-Amz-Target dispatch). One fake =
+    any number of queues, keyed by the request's QueueUrl path. Messages
+    carry visibility timeouts; receipt handles rotate per delivery."""
+
+    def __init__(self, visibility: float = 30.0):
+        self.visibility = visibility
+        self.queues: dict[str, list[dict]] = {}
+        self.receive_errors = 0
+        self.send_errors = 0
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                n = int(self.headers.get("Content-Length") or 0)
+                payload = json.loads(self.rfile.read(n) or b"{}")
+                target = self.headers.get("X-Amz-Target", "")
+                op = target.split(".")[-1]
+                try:
+                    out = fake._dispatch(op, payload)
+                except _SqsError as e:
+                    body = json.dumps({"__type": e.kind, "message": str(e)}).encode()
+                    self.send_response(e.status)
+                    self.send_header("Content-Length", str(len(body)))
+                    self.end_headers()
+                    self.wfile.write(body)
+                    return
+                body = json.dumps(out).encode()
+                self.send_response(200)
+                self.send_header("Content-Type", "application/x-amz-json-1.0")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def _q(self, queue_url: str) -> list[dict]:
+        name = queue_url.rstrip("/").rsplit("/", 1)[-1]
+        return self.queues.setdefault(name, [])
+
+    def _dispatch(self, op: str, p: dict) -> dict:
+        with self._lock:
+            if op == "SendMessage":
+                if self.send_errors > 0:
+                    self.send_errors -= 1
+                    raise _SqsError(500, "InternalError", "injected send failure")
+                self._q(p["QueueUrl"]).append(
+                    {"Body": p["MessageBody"], "MessageId": uuid.uuid4().hex,
+                     "visible_at": 0.0, "receipt": None}
+                )
+                return {"MessageId": "m", "MD5OfMessageBody": ""}
+            if op == "ReceiveMessage":
+                if self.receive_errors > 0:
+                    self.receive_errors -= 1
+                    raise _SqsError(503, "ServiceUnavailable", "injected receive failure")
+                deadline = time.monotonic() + min(int(p.get("WaitTimeSeconds", 0)), 5)
+                while True:
+                    now = time.time()
+                    for m in self._q(p["QueueUrl"]):
+                        if m["visible_at"] <= now:
+                            m["visible_at"] = now + self.visibility
+                            m["receipt"] = uuid.uuid4().hex
+                            return {"Messages": [
+                                {"Body": m["Body"], "MessageId": m["MessageId"],
+                                 "ReceiptHandle": m["receipt"]}
+                            ]}
+                    if time.monotonic() >= deadline:
+                        return {}
+                    self._lock.release()
+                    try:
+                        time.sleep(0.02)
+                    finally:
+                        self._lock.acquire()
+            if op == "DeleteMessage":
+                for q in self.queues.values():
+                    for m in list(q):
+                        if m["receipt"] == p["ReceiptHandle"]:
+                            q.remove(m)
+                            return {}
+                return {}
+            if op == "ChangeMessageVisibility":
+                for q in self.queues.values():
+                    for m in q:
+                        if m["receipt"] == p["ReceiptHandle"]:
+                            m["visible_at"] = time.time() + int(p["VisibilityTimeout"])
+                            return {}
+                return {}
+        raise _SqsError(400, "InvalidAction", f"unknown op {op}")
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+
+
+class _SqsError(Exception):
+    def __init__(self, status: int, kind: str, msg: str):
+        super().__init__(msg)
+        self.status = status
+        self.kind = kind
+
+
+# -- NATS --------------------------------------------------------------------
+
+
+class FakeNats:
+    """Core-protocol NATS server: INFO/CONNECT/SUB/PUB/MSG/PING-PONG,
+    queue groups pick one subscriber round-robin."""
+
+    def __init__(self):
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._lock = threading.Lock()
+        # (subject) -> list of (conn, sid, group)
+        self._subs: list[tuple[socket.socket, str, str, str | None]] = []
+        self._rr: dict[tuple[str, str], int] = {}
+        self.published: list[tuple[str, bytes]] = []
+        self._closed = False
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            conn.sendall(b'INFO {"server_id":"fake","max_payload":1048576}\r\n')
+            threading.Thread(target=self._serve, args=(conn,), daemon=True).start()
+
+    def _serve(self, conn: socket.socket):
+        f = conn.makefile("rb")
+        try:
+            while True:
+                line = f.readline()
+                if not line:
+                    return
+                if line.startswith(b"CONNECT"):
+                    continue
+                if line.startswith(b"PING"):
+                    conn.sendall(b"PONG\r\n")
+                elif line.startswith(b"SUB "):
+                    parts = line.decode().split()
+                    if len(parts) == 4:
+                        _, subject, group, sid = parts
+                    else:
+                        _, subject, sid = parts
+                        group = None
+                    with self._lock:
+                        self._subs.append((conn, subject, sid, group))
+                elif line.startswith(b"PUB "):
+                    parts = line.decode().split()
+                    subject, nbytes = parts[1], int(parts[-1])
+                    payload = f.read(nbytes)
+                    f.read(2)
+                    self.published.append((subject, payload))
+                    self._route(subject, payload)
+        except OSError:
+            pass
+
+    def _route(self, subject: str, payload: bytes):
+        with self._lock:
+            matches = [s for s in self._subs if s[1] == subject]
+            # Queue groups: one member per group; plain subs all get it.
+            plain = [s for s in matches if s[3] is None]
+            by_group: dict[str, list] = {}
+            for s in matches:
+                if s[3] is not None:
+                    by_group.setdefault(s[3], []).append(s)
+            targets = list(plain)
+            for g, members in by_group.items():
+                i = self._rr.get((subject, g), 0)
+                targets.append(members[i % len(members)])
+                self._rr[(subject, g)] = i + 1
+            for conn, subj, sid, _ in targets:
+                try:
+                    conn.sendall(
+                        b"MSG %s %s %d\r\n%s\r\n"
+                        % (subj.encode(), sid.encode(), len(payload), payload)
+                    )
+                except OSError:
+                    pass
+
+    def close(self):
+        self._closed = True
+        self._srv.close()
+
+
+# -- RabbitMQ (AMQP 0-9-1) ---------------------------------------------------
+
+
+class FakeRabbit:
+    """Server side of the amqp_driver.py subset: handshake, channel,
+    queue declare, publish (default exchange), consume, ack/nack."""
+
+    def __init__(self):
+        from kubeai_tpu.messenger import amqp_driver as ap
+
+        self.ap = ap
+        self.queues: dict[str, "queue.Queue[bytes]"] = {}
+        self.unacked: dict[tuple[int, int], tuple[str, bytes]] = {}  # (connid, tag)
+        self.acked: list[int] = []
+        self._lock = threading.Lock()
+        self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        self._srv.bind(("127.0.0.1", 0))
+        self._srv.listen(16)
+        self.port = self._srv.getsockname()[1]
+        self._closed = False
+        self._conn_seq = 0
+        threading.Thread(target=self._accept_loop, daemon=True).start()
+
+    def _queue(self, name: str) -> "queue.Queue[bytes]":
+        with self._lock:
+            q = self.queues.get(name)
+            if q is None:
+                q = self.queues[name] = queue.Queue()
+            return q
+
+    def _accept_loop(self):
+        while not self._closed:
+            try:
+                conn, _ = self._srv.accept()
+            except OSError:
+                return
+            self._conn_seq += 1
+            threading.Thread(
+                target=self._serve, args=(conn, self._conn_seq), daemon=True
+            ).start()
+
+    def _serve(self, conn: socket.socket, connid: int):
+        ap = self.ap
+        f = conn.makefile("rb")
+        wlock = threading.Lock()
+        dead = threading.Event()
+
+        def send_method(channel, w):
+            with wlock:
+                ap.write_frame(conn, ap.FRAME_METHOD, channel, w.build())
+
+        try:
+            if f.read(8) != b"AMQP\x00\x00\x09\x01":
+                return
+            send_method(
+                0,
+                ap.method(ap.CONNECTION, ap.CONN_START)
+                .u8(0).u8(9).table({}).longstr(b"PLAIN").longstr(b"en_US"),
+            )
+            consuming: dict[str, bool] = {}
+            delivery_tag = 0
+            pending_publish: str | None = None
+            pending_size = 0
+            pending_body = b""
+
+            def pump(qname: str):
+                nonlocal delivery_tag
+                q = self._queue(qname)
+                while not self._closed and not dead.is_set():
+                    try:
+                        body = q.get(timeout=0.2)
+                    except queue.Empty:
+                        continue
+                    if dead.is_set():
+                        q.put(body)  # taken after the consumer died: give it back
+                        return
+                    with self._lock:
+                        delivery_tag += 1
+                        tag = delivery_tag
+                        self.unacked[(connid, tag)] = (qname, body)
+                    try:
+                        send_method(
+                            1,
+                            ap.method(ap.BASIC, ap.B_DELIVER)
+                            .shortstr("ctag").u64(tag).u8(0).shortstr("").shortstr(qname),
+                        )
+                        with wlock:
+                            ap.write_frame(
+                                conn, ap.FRAME_HEADER, 1,
+                                ap.Writer().u16(ap.BASIC).u16(0).u64(len(body)).u16(0).build(),
+                            )
+                            ap.write_frame(conn, ap.FRAME_BODY, 1, body)
+                    except OSError:
+                        with self._lock:
+                            self.unacked.pop((connid, tag), None)
+                        q.put(body)
+                        return
+
+            while True:
+                ftype, channel, payload = ap.read_frame(f)
+                if ftype == ap.FRAME_HEARTBEAT:
+                    continue
+                if ftype == ap.FRAME_HEADER:
+                    r = ap.Reader(payload)
+                    r.u16(); r.u16()
+                    pending_size = r.u64()
+                    pending_body = b""
+                    if pending_size == 0 and pending_publish:
+                        self._queue(pending_publish).put(b"")
+                        pending_publish = None
+                    continue
+                if ftype == ap.FRAME_BODY:
+                    pending_body += payload
+                    if len(pending_body) >= pending_size and pending_publish:
+                        self._queue(pending_publish).put(pending_body)
+                        pending_publish = None
+                    continue
+                r = ap.Reader(payload)
+                cls, mth = r.u16(), r.u16()
+                if (cls, mth) == (ap.CONNECTION, ap.CONN_START_OK):
+                    send_method(
+                        0, ap.method(ap.CONNECTION, ap.CONN_TUNE).u16(0).u32(131072).u16(0)
+                    )
+                elif (cls, mth) == (ap.CONNECTION, ap.CONN_TUNE_OK):
+                    pass
+                elif (cls, mth) == (ap.CONNECTION, ap.CONN_OPEN):
+                    send_method(0, ap.method(ap.CONNECTION, ap.CONN_OPEN_OK).shortstr(""))
+                elif (cls, mth) == (ap.CHANNEL, ap.CH_OPEN):
+                    send_method(channel, ap.method(ap.CHANNEL, ap.CH_OPEN_OK).longstr(b""))
+                elif (cls, mth) == (ap.QUEUE, ap.Q_DECLARE):
+                    r.u16()
+                    qname = r.shortstr()
+                    self._queue(qname)
+                    send_method(
+                        channel,
+                        ap.method(ap.QUEUE, ap.Q_DECLARE_OK).shortstr(qname).u32(0).u32(0),
+                    )
+                elif (cls, mth) == (ap.BASIC, ap.B_PUBLISH):
+                    r.u16()
+                    r.shortstr()  # exchange ("")
+                    pending_publish = r.shortstr()  # routing key = queue
+                elif (cls, mth) == (ap.BASIC, ap.B_CONSUME):
+                    r.u16()
+                    qname = r.shortstr()
+                    consuming[qname] = True
+                    send_method(
+                        channel, ap.method(ap.BASIC, ap.B_CONSUME_OK).shortstr("ctag")
+                    )
+                    threading.Thread(target=pump, args=(qname,), daemon=True).start()
+                elif (cls, mth) == (ap.BASIC, ap.B_ACK):
+                    tag = r.u64()
+                    with self._lock:
+                        self.unacked.pop((connid, tag), None)
+                        self.acked.append(tag)
+                elif (cls, mth) == (ap.BASIC, ap.B_NACK):
+                    tag = r.u64()
+                    bits = r.u8()
+                    with self._lock:
+                        entry = self.unacked.pop((connid, tag), None)
+                    if entry and bits & 0b10:  # requeue
+                        self._queue(entry[0]).put(entry[1])
+                elif (cls, mth) == (ap.CONNECTION, ap.CONN_CLOSE):
+                    send_method(0, ap.method(ap.CONNECTION, ap.CONN_CLOSE_OK))
+                    return
+        except (OSError, ConnectionError):
+            pass
+        finally:
+            # Connection died with unacked deliveries: stop its pumps,
+            # then requeue them (the broker's crash-redelivery contract).
+            dead.set()
+            with self._lock:
+                orphans = [
+                    self.unacked.pop(k)
+                    for k in list(self.unacked)
+                    if k[0] == connid
+                ]
+            for qname, body in orphans:  # _queue() takes the lock itself
+                self._queue(qname).put(body)
+
+    def close(self):
+        self._closed = True
+        self._srv.close()
+
+
+# -- Azure Service Bus -------------------------------------------------------
+
+
+class FakeAzureSB:
+    """REST surface of azuresb_driver.py: send, peek-lock receive,
+    complete (DELETE), unlock (PUT). Locked messages reappear after the
+    lock duration (crash-redelivery)."""
+
+    def __init__(self, lock_duration: float = 30.0):
+        self.lock_duration = lock_duration
+        self.queues: dict[str, list[dict]] = {}
+        self._lock = threading.Lock()
+        fake = self
+
+        class Handler(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def _reply(self, status: int, body: bytes = b"", headers: dict | None = None):
+                self.send_response(status)
+                for k, v in (headers or {}).items():
+                    self.send_header(k, v)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def do_POST(self):
+                parts = self.path.split("?")[0].strip("/").split("/")
+                n = int(self.headers.get("Content-Length") or 0)
+                data = self.rfile.read(n)
+                if len(parts) == 2 and parts[1] == "messages":
+                    with fake._lock:
+                        fake._q(parts[0]).append(
+                            {"body": data, "id": uuid.uuid4().hex,
+                             "lock": None, "locked_until": 0.0}
+                        )
+                    return self._reply(201)
+                if len(parts) == 3 and parts[1] == "messages" and parts[2] == "head":
+                    m = fake._peek_lock(parts[0])
+                    if m is None:
+                        return self._reply(204)
+                    props = json.dumps({"LockToken": m["lock"], "MessageId": m["id"]})
+                    return self._reply(201, m["body"], {"BrokerProperties": props})
+                return self._reply(400)
+
+            def do_DELETE(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 4 and parts[1] == "messages":
+                    ok = fake._complete(parts[0], parts[2], parts[3])
+                    return self._reply(200 if ok else 404)
+                return self._reply(400)
+
+            def do_PUT(self):
+                parts = self.path.strip("/").split("/")
+                if len(parts) == 4 and parts[1] == "messages":
+                    ok = fake._unlock(parts[0], parts[2], parts[3])
+                    return self._reply(200 if ok else 404)
+                return self._reply(400)
+
+        self._httpd = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self.port = self._httpd.server_address[1]
+        threading.Thread(target=self._httpd.serve_forever, daemon=True).start()
+
+    def _q(self, name: str) -> list[dict]:
+        return self.queues.setdefault(name, [])
+
+    def _peek_lock(self, qname: str):
+        deadline = time.monotonic() + 2
+        while time.monotonic() < deadline:
+            with self._lock:
+                now = time.time()
+                for m in self._q(qname):
+                    if m["locked_until"] <= now:
+                        m["lock"] = uuid.uuid4().hex
+                        m["locked_until"] = now + self.lock_duration
+                        return dict(m)
+            time.sleep(0.02)
+        return None
+
+    def _complete(self, qname: str, mid: str, lock: str) -> bool:
+        with self._lock:
+            for m in list(self._q(qname)):
+                if m["id"] == mid and m["lock"] == lock:
+                    self._q(qname).remove(m)
+                    return True
+        return False
+
+    def _unlock(self, qname: str, mid: str, lock: str) -> bool:
+        with self._lock:
+            for m in self._q(qname):
+                if m["id"] == mid and m["lock"] == lock:
+                    m["locked_until"] = 0.0
+                    return True
+        return False
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
