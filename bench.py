@@ -142,7 +142,7 @@ def synth_int8_params(mc):
     }
 
 
-def build_engine(preset: str):
+def build_engine(preset: str, speculate: int = 0, slots: int = 0):
     import jax
 
     from kubeai_tpu.engine.core import Engine, EngineConfig
@@ -176,8 +176,12 @@ def build_engine(preset: str):
             # paged-attention decode kernel. Round 2 measured the portable
             # gather path instead (VERDICT r2 weak #2).
             mc = mc.replace(use_flash_prefill=True, use_paged_kernel=True)
+        # Slot scaling measured on v5e: 16 slots = 698 tok/s, 32 = 1031,
+        # 48 = 1190 (decode is weight-bandwidth-bound, so batch is nearly
+        # free until HBM fills: 8GB int8 weights + ~6.3GB KV pool at 48
+        # slots is the most the 16GB chip takes; 64 would not fit).
         ec = EngineConfig(
-            max_slots=16, max_seq_len=1024, prefill_buckets=(128, 256, 512),
+            max_slots=48, max_seq_len=1024, prefill_buckets=(128, 256, 512),
             decode_chunk=16,
         )
         t0 = time.monotonic()
@@ -200,6 +204,10 @@ def build_engine(preset: str):
             decode_chunk=16,
         )
         params = llama.init_params(mc, jax.random.key(0))
+    if speculate:
+        ec.speculate_tokens = speculate
+    if slots:
+        ec.max_slots = slots
     return Engine(mc, params, ByteTokenizer(), ec)
 
 
@@ -252,13 +260,28 @@ def run_worker(args) -> None:
 
     t0 = time.monotonic()
     log(f"phase=build constructing engine (weights on device)")
-    eng = build_engine(preset)
+    eng = build_engine(preset, speculate=args.speculate, slots=args.slots)
     eng.start()
     log(f"phase=build done ({time.monotonic()-t0:.1f}s)")
 
     rng = np.random.default_rng(0)
-    prompts = [rng.integers(1, 200, prompt_len).tolist() for _ in range(n_requests)]
-    sp = SamplingParams(temperature=0.7, top_p=0.95, max_tokens=max_tokens, seed=1)
+    if args.speculate or args.greedy:
+        # Speculation comparison runs greedy (drafts are only accepted on
+        # greedy slots — exactness by argmax match) with REPETITIVE
+        # prompts: a repeated phrase pattern gives the device-side 2-gram
+        # lookup real continuations to draft, standing in for the
+        # chat-echoes-its-context workloads speculation targets.
+        phrase = rng.integers(1, 200, 16)
+        prompts = [
+            np.concatenate(
+                [np.roll(phrase, i % 4) for _ in range(prompt_len // 16)]
+            )[:prompt_len].tolist()
+            for i in range(n_requests)
+        ]
+        sp = SamplingParams(temperature=0.0, max_tokens=max_tokens)
+    else:
+        prompts = [rng.integers(1, 200, prompt_len).tolist() for _ in range(n_requests)]
+        sp = SamplingParams(temperature=0.7, top_p=0.95, max_tokens=max_tokens, seed=1)
 
     # Warmup: compile EVERY shape the measure phase hits — the single
     # (pad-1) prefill, the grouped (pad-prefill_group_cap) prefill, and
@@ -341,6 +364,13 @@ def run_worker(args) -> None:
     p50_ttft = sorted(t for t in ttfts if t is not None)[len(ttfts) // 2]
 
     extras = {"preset": preset, "p50_ttft_ms": round(p50_ttft * 1000, 1)}
+    if args.speculate or args.greedy:
+        drafted = eng.m_spec_drafted.value()
+        accepted = eng.m_spec_accepted.value()
+        extras["speculate_tokens"] = args.speculate
+        extras["sampling"] = "greedy"
+        if drafted:
+            extras["spec_acceptance_pct"] = round(100 * accepted / drafted, 1)
     peak = PEAK_FLOPS.get(
         next((k for k in PEAK_FLOPS if k in str(dev_kind).lower()), ""), None
     )
@@ -463,6 +493,12 @@ def run_orchestrated(args) -> int:
             cmd += ["--requests", str(args.requests)]
         if args.max_tokens:
             cmd += ["--max-tokens", str(args.max_tokens)]
+        if args.speculate:
+            cmd += ["--speculate", str(args.speculate)]
+        if args.greedy:
+            cmd += ["--greedy"]
+        if args.slots:
+            cmd += ["--slots", str(args.slots)]
         log(f"phase=run preset={preset} budget={budget}s")
         try:
             out = subprocess.run(
@@ -526,6 +562,20 @@ def main():
     parser.add_argument("--worker", action="store_true", help=argparse.SUPPRESS)
     parser.add_argument("--requests", type=int, default=None)
     parser.add_argument("--max-tokens", type=int, default=None)
+    parser.add_argument(
+        "--speculate", type=int, default=0,
+        help="n-gram speculative decoding: drafts verified per step "
+             "(runs greedy with repetitive prompts; 0 = off)",
+    )
+    parser.add_argument(
+        "--greedy", action="store_true",
+        help="greedy sampling + repetitive prompts WITHOUT speculation "
+             "(the control for --speculate comparisons)",
+    )
+    parser.add_argument(
+        "--slots", type=int, default=0,
+        help="override the preset's max decode slots (batch size)",
+    )
     parser.add_argument(
         "--watchdog", type=int, default=None,
         help="worker hard deadline (s); 0 disables",

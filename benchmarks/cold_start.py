@@ -1,0 +1,127 @@
+"""Cold-start benchmark: Model applied -> first generated token.
+
+BASELINE.json's north-star metrics include 0->N cold start; the
+reference never measures it (its engines are external containers).
+Here the full path is in-repo: Model created -> controller plans a pod
+-> LocalRuntime spawns the engine process -> weights load -> XLA
+compiles -> LB endpoint appears -> the waiting completion's first token
+streams back. Measured twice with the SAME persistent compile cache
+dir: the cold run pays first-compile, the warm run (fresh process,
+fresh model name, same shapes) shows what the cache saves — the number
+that matters for scale-from-zero and slice recovery.
+
+    python benchmarks/cold_start.py [--runs 2] [--json out.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def first_token_seconds(mgr, store, ckpt: str, name: str) -> float:
+    """Create the Model and immediately issue a streaming completion;
+    returns seconds from Model-create to the first streamed token."""
+    import urllib.request
+
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.core_types import KIND_POD
+    from kubeai_tpu.api.model_types import Model, ModelSpec
+    from kubeai_tpu.runtime.store import ObjectMeta
+
+    t0 = time.monotonic()
+    store.create(
+        mt.KIND_MODEL,
+        Model(
+            meta=ObjectMeta(name=name),
+            spec=ModelSpec(
+                url=f"file://{ckpt}",
+                engine=mt.ENGINE_TPU,
+                resource_profile="cpu:1",
+                min_replicas=1,
+                args=["--max-seq-len", "512", "--max-slots", "4"],
+            ),
+        ),
+    )
+    body = json.dumps(
+        {"model": name, "prompt": "hello cold start", "max_tokens": 4,
+         "stream": True}
+    ).encode()
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{mgr.api.port}/openai/v1/completions",
+        data=body,
+        headers={"Content-Type": "application/json"},
+    )
+    # The proxy blocks on scale-from-zero until the replica is Ready —
+    # this request IS the cold-start clock.
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        for raw in resp:
+            line = raw.decode().strip()
+            if line.startswith("data: ") and line != "data: [DONE]":
+                chunk = json.loads(line[len("data: "):])
+                if chunk.get("choices", [{}])[0].get("text"):
+                    t_first = time.monotonic()
+                    break
+        else:
+            raise RuntimeError("stream ended without a token")
+
+    store.delete(mt.KIND_MODEL, name)
+    deadline = time.time() + 60
+    while time.time() < deadline:
+        if not store.list(KIND_POD, selector={mt.LABEL_MODEL: name}):
+            break
+        time.sleep(0.2)
+    return t_first - t0
+
+
+def main():
+    parser = argparse.ArgumentParser()
+    parser.add_argument("--json", default=None)
+    args = parser.parse_args()
+
+    from kubeai_tpu.config.system import System
+    from kubeai_tpu.engine.weights import save_tiny_test_checkpoint
+    from kubeai_tpu.manager import Manager
+
+    import shutil
+
+    ckpt = tempfile.mkdtemp(prefix="cold-start-ckpt-")
+    save_tiny_test_checkpoint(ckpt)
+    xla_cache = tempfile.mkdtemp(prefix="cold-start-xla-")
+
+    system = System().default_and_validate()
+    mgr = Manager(system, local_runtime=True, host="127.0.0.1", port=0)
+    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        mgr.local_runtime.extra_env["JAX_PLATFORMS"] = "cpu"
+    mgr.local_runtime.extra_env["KUBEAI_COMPILE_CACHE"] = xla_cache
+    mgr.start()
+    try:
+        cold = first_token_seconds(mgr, mgr.store, ckpt, "coldstart-cold")
+        print(f"# cold (empty compile cache): {cold:.1f}s", file=sys.stderr)
+        warm = first_token_seconds(mgr, mgr.store, ckpt, "coldstart-warm")
+        print(f"# warm (persistent compile cache): {warm:.1f}s", file=sys.stderr)
+    finally:
+        mgr.stop()
+        shutil.rmtree(ckpt, ignore_errors=True)
+        shutil.rmtree(xla_cache, ignore_errors=True)
+
+    out = {
+        "metric": "cold_start_first_token_seconds",
+        "cold_s": round(cold, 1),
+        "warm_s": round(warm, 1),
+        "compile_cache_saving_pct": round(100 * (1 - warm / cold), 1),
+    }
+    print(json.dumps(out))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(out, f, indent=1)
+
+
+if __name__ == "__main__":
+    main()

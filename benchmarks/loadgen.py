@@ -69,8 +69,26 @@ def load_sharegpt(path: str, max_turn_chars: int = 2000) -> list[list[str]]:
     return out
 
 
-def synthetic_turns(seed: str, turns: int) -> list[str]:
-    return [f"{seed} turn {t}: tell me more." for t in range(turns)]
+def synthetic_turns(seed: str, turns: int, pad_chars: int = 0) -> list[str]:
+    """With pad_chars > 0, turn 0 carries a long conversation-unique
+    context block (a fake document the user pastes) — the regime where
+    prefix-affine routing pays: every later turn re-sends the whole
+    history, so a conversation pinned to one replica re-prefills nothing
+    while a bounced one re-prefills everything (ref: the reference's
+    prefix-aware benchmark uses long shared histories the same way,
+    docs/benchmarks/prefix-aware-load-balancing.md)."""
+    out = [f"{seed} turn {t}: tell me more." for t in range(turns)]
+    if pad_chars > 0:
+        rng = random.Random(seed)
+        words = ("alpha", "bravo", "delta", "echo", "foxtrot", "gamma", "hotel", "india")
+        filler = []
+        n = 0
+        while n < pad_chars:
+            w = rng.choice(words)
+            filler.append(w)
+            n += len(w) + 1
+        out[0] = f"{seed} context: {' '.join(filler)}\nquestion: summarize."
+    return out
 
 
 def run_conversation(base_url: str, model: str, user_turns: list[str], max_tokens: int, stats: ThreadStats, temperature: float = 0.7):
@@ -147,6 +165,7 @@ def run_benchmark(
     max_concurrency: int = 0,
     seed: int = 0,
     temperature: float = 0.7,
+    prefix_pad_chars: int = 0,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy)."""
@@ -156,7 +175,9 @@ def run_benchmark(
         if dataset:
             convo_turns.append(dataset[i % len(dataset)][:turns])
         else:
-            convo_turns.append(synthetic_turns(f"conversation-{i}", turns))
+            convo_turns.append(
+                synthetic_turns(f"conversation-{i}", turns, pad_chars=prefix_pad_chars)
+            )
 
     stats = [ThreadStats() for _ in range(conversations)]
     sem = threading.Semaphore(max_concurrency) if max_concurrency > 0 else None

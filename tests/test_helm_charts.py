@@ -43,7 +43,7 @@ def test_system_configmap_loads_into_system_config(rendered):
     with the TPU profile matrix intact."""
     from kubeai_tpu.config.system import load_system_config
 
-    cm = by_kind(rendered, "ConfigMap")[0]
+    cm = next(c for c in by_kind(rendered, "ConfigMap") if "data" in c)
     assert cm["metadata"]["name"] == "kubeai-config"
     assert cm["metadata"]["namespace"] == "kubeai-ns"
     sys_cfg = load_system_config(data=yaml.safe_load(cm["data"]["system.yaml"]))
@@ -98,7 +98,7 @@ def test_values_overrides_flow_through():
 
     dep = by_kind(docs, "Deployment")[0]
     assert dep["spec"]["replicas"] == 3
-    cm = by_kind(docs, "ConfigMap")[0]
+    cm = next(c for c in by_kind(docs, "ConfigMap") if "data" in c)
     sys_cfg = load_system_config(data=yaml.safe_load(cm["data"]["system.yaml"]))
     assert sys_cfg.autoscaling.interval_seconds == 5
     assert sys_cfg.secret_names.huggingface == "my-hf"
