@@ -326,6 +326,10 @@ def run_worker(args) -> None:
             if ev[0] == "error":
                 raise RuntimeError(ev[1])
     log(f"phase=warmup done ({time.monotonic()-t0:.1f}s)")
+    # Snapshot lifetime speculation counters so the reported acceptance
+    # covers ONLY the measured phase (warmup's random disjoint prompts
+    # draft at near-zero acceptance and would bias it down).
+    spec_base = (eng.m_spec_drafted.value(), eng.m_spec_accepted.value())
 
     results = [None] * n_requests
     ttfts = [None] * n_requests
@@ -365,8 +369,8 @@ def run_worker(args) -> None:
 
     extras = {"preset": preset, "p50_ttft_ms": round(p50_ttft * 1000, 1)}
     if args.speculate or args.greedy:
-        drafted = eng.m_spec_drafted.value()
-        accepted = eng.m_spec_accepted.value()
+        drafted = eng.m_spec_drafted.value() - spec_base[0]
+        accepted = eng.m_spec_accepted.value() - spec_base[1]
         extras["speculate_tokens"] = args.speculate
         extras["sampling"] = "greedy"
         if drafted:

@@ -10,7 +10,7 @@ dir: the cold run pays first-compile, the warm run (fresh process,
 fresh model name, same shapes) shows what the cache saves — the number
 that matters for scale-from-zero and slice recovery.
 
-    python benchmarks/cold_start.py [--runs 2] [--json out.json]
+    python benchmarks/cold_start.py [--json out.json]
 """
 
 from __future__ import annotations

@@ -113,14 +113,18 @@ def run_strategy(mgr, store, ckpt: str, strategy: str, args) -> dict:
         try:
             import urllib.request
 
+            from kubeai_tpu.metrics.registry import parse_prometheus_text
+
             with urllib.request.urlopen(
                 f"http://127.0.0.1:{port}/metrics", timeout=10
             ) as resp:
-                for line in resp.read().decode().splitlines():
-                    if line.startswith("kubeai_engine_prefix_cached_tokens_total "):
-                        cached += float(line.rsplit(" ", 1)[1])
-                    elif line.startswith("kubeai_engine_prefill_tokens_total "):
-                        prefilled += float(line.rsplit(" ", 1)[1])
+                parsed = parse_prometheus_text(resp.read().decode())
+            cached += sum(
+                v for _, v in parsed.get("kubeai_engine_prefix_cached_tokens_total", [])
+            )
+            prefilled += sum(
+                v for _, v in parsed.get("kubeai_engine_prefill_tokens_total", [])
+            )
         except OSError:
             pass
     summary["prefix_cached_tokens"] = int(cached)

@@ -51,6 +51,11 @@ from kubeai_tpu.models.base import ModelConfig
 log = logging.getLogger("kubeai_tpu.engine")
 
 
+class GangLost(ConnectionError):
+    """A gang follower's dispatch connection failed — the gang's
+    collectives can never realign; serving from this rank is over."""
+
+
 @dataclass
 class EngineConfig:
     max_slots: int = 8
@@ -714,29 +719,16 @@ class Engine:
         if self._running:
             pending = []
             for n, tokens, lengths in groups:
-                rq: "queue.Queue" = queue.Queue()
-                self._aux.put((tokens, lengths, rq))
-                self._wake.set()
-                pending.append((n, rq))
+
+                def thunk(tokens=tokens, lengths=lengths):
+                    self._bcast(
+                        "embed", arrays={"tokens": tokens, "lengths": lengths}
+                    )
+                    return self._embed_jit(self.params, tokens, lengths)
+
+                pending.append((n, self._submit_aux(thunk)))
             for n, rq in pending:
-                deadline = time.monotonic() + 600
-                while True:
-                    try:
-                        kind, val = rq.get(timeout=1.0)
-                        break
-                    except queue.Empty:
-                        # An enqueue that raced stop()'s _aux drain would
-                        # otherwise wait the full timeout for a reply
-                        # that can never come.
-                        if not self._running:
-                            raise RuntimeError("engine shutting down") from None
-                        if time.monotonic() > deadline:
-                            raise TimeoutError(
-                                "embedding produced no result within 600s "
-                                "(engine scheduler stalled?)"
-                            ) from None
-                if kind != "ok":
-                    raise RuntimeError(f"embedding failed: {val}")
+                val = self._await_aux(rq, what="embedding")
                 out.append(np.asarray(jax.device_get(val))[:n])
         else:
             if self._multiproc:
@@ -770,38 +762,110 @@ class Engine:
             kw = {"out_shardings": NamedSharding(self._mesh, PartitionSpec())}
         self._embed_jit = jax.jit(embed_fn, **kw)
 
+    def _submit_aux(self, thunk) -> "queue.Queue":
+        """Queue device work for the SCHEDULER thread (all device
+        dispatch is serialized there — and on a gang, broadcast order
+        must equal dispatch order, which only one thread can guarantee)."""
+        rq: "queue.Queue" = queue.Queue()
+        self._aux.put((thunk, rq))
+        self._wake.set()
+        return rq
+
+    def _await_aux(self, rq: "queue.Queue", what: str, timeout: float = 600):
+        deadline = time.monotonic() + timeout
+        while True:
+            try:
+                kind, val = rq.get(timeout=1.0)
+                break
+            except queue.Empty:
+                # An enqueue that raced stop()'s _aux drain would
+                # otherwise wait the full timeout for a reply that can
+                # never come.
+                if not self._running:
+                    raise RuntimeError("engine shutting down") from None
+                if time.monotonic() > deadline:
+                    raise TimeoutError(
+                        f"{what} produced no result within {timeout}s "
+                        "(engine scheduler stalled?)"
+                    ) from None
+        if kind != "ok":
+            raise RuntimeError(f"{what} failed: {val}")
+        return val
+
     def _run_aux(self) -> None:
-        """Execute one queued auxiliary dispatch (scheduler thread only).
+        """Execute one queued auxiliary thunk (scheduler thread only).
         One item per loop iteration so a large embed batch interleaves
         with decode chunks instead of stalling them. Replies carry the
-        (async) device array; the caller's thread does the device_get."""
+        (async) result; the caller's thread does any device_get."""
         try:
-            tokens, lengths, rq = self._aux.get_nowait()
+            thunk, rq = self._aux.get_nowait()
         except queue.Empty:
             return
         try:
-            self._bcast("embed", arrays={"tokens": tokens, "lengths": lengths})
-        except OSError:
-            # Lost follower: this must reach _loop's recovery (which
-            # terminates the rank — the gang cannot realign), not be
-            # swallowed as a per-request error.
+            rq.put(("ok", thunk()))
+        except GangLost:
+            # Lost follower (gang publish failed): this must reach
+            # _loop's recovery — which terminates the rank, the gang
+            # cannot realign — not be swallowed as a per-request error.
             rq.put(("error", "gang follower lost"))
             raise
-        try:
-            rq.put(("ok", self._embed_jit(self.params, tokens, lengths)))
         except Exception as e:  # no donation: decode state is unharmed
-            log.exception("embed dispatch failed")
+            log.exception("aux dispatch failed")
             rq.put(("error", str(e)))
 
     # -- LoRA adapters -----------------------------------------------------
 
     def load_adapter(self, name: str, path: str) -> None:
         """Install a PEFT adapter into the bank (first load allocates it
-        and costs one step-function recompile)."""
-        if self._multiproc:
-            # The adapter bank would need global-mesh allocation + a
-            # broadcast load op on every rank; not wired up yet.
-            raise ValueError("LoRA adapters are not yet supported on multi-host gangs")
+        and costs one step-function recompile). Executed on the
+        SCHEDULER thread: the bank swap must be ordered against decode
+        dispatches, and on a gang the broadcast position in the dispatch
+        stream decides when followers switch banks — an admin-thread
+        publish racing the scheduler's would desync the ranks. *path*
+        may be a remote source; every rank stages it independently."""
+
+        # Stage on THIS (HTTP admin) thread: a multi-GB download inside
+        # the scheduler thunk would freeze every client's token stream
+        # for its duration. Only the bank install/broadcast needs
+        # dispatch-stream ordering.
+        staged = self._stage_adapter(name, path)
+
+        def do():
+            # Install FIRST: a bad checkpoint then fails as a clean
+            # per-request error with no broadcast, leaving every rank
+            # untouched — broadcasting first would make the followers
+            # fail fatally on content rank 0 itself rejected. On
+            # success, the broadcast lands at this thunk's stream
+            # position, so followers install before replaying any
+            # later dispatch that carries lora state. Followers get the
+            # ORIGINAL path — they stage independently (a path staged
+            # on this host means nothing on theirs).
+            self._install_adapter(name, staged)
+            self._bcast("load_adapter", scalars={"name": name, "path": path})
+
+        if self._running:
+            self._await_aux(self._submit_aux(do), what="adapter load")
+        else:
+            do()  # pre-start: no dispatch stream to order against
+
+    @staticmethod
+    def _stage_adapter(name: str, path: str) -> str:
+        import os as _os
+
+        from kubeai_tpu.loader import stage_remote
+
+        return stage_remote(
+            path,
+            _os.environ.get("KUBEAI_ADAPTER_STAGING_DIR", "/tmp/kubeai-adapters"),
+            prefix=f"{name}-",
+        )
+
+    def _load_adapter_local(self, name: str, path: str) -> None:
+        """Follower side: stage (blocking the replay loop is inherent —
+        later ops may depend on the bank) then install."""
+        self._install_adapter(name, self._stage_adapter(name, path))
+
+    def _install_adapter(self, name: str, staged: str) -> None:
         from kubeai_tpu.engine.lora import AdapterRuntime
 
         if self._adapters is None:
@@ -809,13 +873,23 @@ class Engine:
                 self.model_config,
                 max_adapters=self.cfg.max_adapters,
                 max_rank=self.cfg.max_lora_rank,
+                mesh=self._mesh if self._multiproc else None,
             )
-        self._adapters.load(name, path)
+        self._adapters.load(name, staged)
 
     def unload_adapter(self, name: str) -> bool:
         if self._adapters is None:
             return False
-        return self._adapters.unload(name)
+
+        def do():
+            ok = self._adapters.unload(name)
+            if ok:  # no-op unloads broadcast nothing (followers agree)
+                self._bcast("unload_adapter", scalars={"name": name})
+            return ok
+
+        if self._running:
+            return self._await_aux(self._submit_aux(do), what="adapter unload")
+        return do()
 
     def loaded_adapters(self) -> list[str]:
         return self._adapters.names() if self._adapters else []
@@ -846,6 +920,16 @@ class Engine:
 
     # -- gang follower (ranks > 0 of a multi-host slice) -------------------
 
+    def _follower_lora(self, ar: dict) -> dict:
+        """Lora kwargs for a replayed dispatch: keyed off the PAYLOAD
+        (rank 0's state at publish time) — load/unload ops are ordered
+        in the same stream, so local state must agree."""
+        if "lora_rows" not in ar:
+            return {}
+        if self._adapters is None:
+            raise RuntimeError("rank 0 dispatched LoRA state this follower lacks")
+        return {"lora": self._adapters.bank, "lora_rows": ar["lora_rows"]}
+
     def run_follower(self, follower) -> None:
         """Execute rank 0's dispatch stream in lockstep (blocks until the
         publisher sends "stop" or the connection drops). The follower
@@ -866,10 +950,19 @@ class Engine:
             if op == "reset":
                 self._init_device_state()
                 continue
-            if op == "decode":
-                lora_args = {}
+            if op == "load_adapter":
+                # A follower that cannot install what rank 0 installed
+                # cannot stay in lockstep — let the exception end the
+                # follower (the pod exits; the controller restarts the
+                # slice gang).
+                self._load_adapter_local(sc["name"], sc["path"])
+                continue
+            if op == "unload_adapter":
                 if self._adapters is not None:
-                    lora_args = {"lora": self._adapters.bank, "lora_rows": ar["lora_rows"]}
+                    self._adapters.unload(sc["name"])
+                continue
+            if op == "decode":
+                lora_args = self._follower_lora(ar)
                 adm_hist = (
                     {"adm_hist": ar["adm_hist"]} if self.cfg.speculate_tokens > 0 else {}
                 )
@@ -885,9 +978,7 @@ class Engine:
                     self._adm_toks, **adm_hist, **lora_args,
                 )
             elif op == "prefill_batch":
-                lora_args = {}
-                if self._adapters is not None:
-                    lora_args = {"lora": self._adapters.bank, "lora_rows": ar["lora_rows"]}
+                lora_args = self._follower_lora(ar)
                 _, _, self._cache, self._adm_toks = self._prefill_batch_jit(
                     self.params, ar["tokens"], ar["lengths"], ar["tables"],
                     ar["slots"], ar["seeds"], ar["temps"], ar["top_ps"],
@@ -895,7 +986,11 @@ class Engine:
                 )
             elif op == "prefill_chunk":
                 lora_args = {}
-                if self._adapters is not None:
+                if "lora_row" in sc:
+                    if self._adapters is None:
+                        raise RuntimeError(
+                            "rank 0 dispatched LoRA state this follower lacks"
+                        )
                     lora_args = {
                         "lora": self._adapters.bank,
                         "lora_row": np.int32(sc["lora_row"]),
@@ -954,12 +1049,19 @@ class Engine:
         BEFORE executing it locally (order on the wire = dispatch order =
         the lockstep contract). No-op single-host."""
         if self._publisher is not None:
-            self._publisher.publish(op, scalars, arrays)
+            try:
+                self._publisher.publish(op, scalars, arrays)
+            except OSError as e:
+                # Typed so handlers can tell follower loss apart from
+                # ordinary OSErrors inside dispatched work (e.g. an
+                # adapter download failing is a per-request error, NOT
+                # a reason to tear the gang down).
+                raise GangLost(str(e)) from e
 
     def _recover(self):
         try:
             self._bcast("reset")
-        except OSError:
+        except GangLost:
             if self._running:
                 # A follower is gone: the gang's collectives can never
                 # line up again, so serving from this process is over.
@@ -1220,7 +1322,8 @@ class Engine:
                     "start": start, "last_idx": len(chunk) - 1,
                     "slot": slot_idx, "seed": int(seed),
                     "temperature": float(sp.temperature), "top_p": float(sp.top_p),
-                    "top_k": int(sp.top_k), "lora_row": lora_row,
+                    "top_k": int(sp.top_k),
+                    **({"lora_row": lora_row} if self._adapters is not None else {}),
                 },
                 arrays={"tokens": chunk_padded, "table": table},
             )
@@ -1348,7 +1451,11 @@ class Engine:
             arrays={
                 "tokens": tokens, "lengths": lengths, "tables": tables,
                 "slots": slots_arr, "seeds": seeds, "temps": temps,
-                "top_ps": top_ps, "top_ks": top_ks, "lora_rows": lora_rows_arr,
+                "top_ps": top_ps, "top_ks": top_ks,
+                # Included exactly when this rank passes lora kwargs:
+                # followers branch on key presence (their own state must
+                # agree — load ops are ordered in the same stream).
+                **({"lora_rows": lora_rows_arr} if self._adapters is not None else {}),
             },
         )
         toks, lps, self._cache, self._adm_toks = self._prefill_batch_jit(

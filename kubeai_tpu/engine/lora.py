@@ -80,14 +80,35 @@ def load_peft_checkpoint(path: str) -> tuple[dict, dict[str, dict[int, tuple[np.
 
 
 class AdapterRuntime:
-    """Owns the adapter bank + name->row assignment for one engine."""
+    """Owns the adapter bank + name->row assignment for one engine.
 
-    def __init__(self, config: ModelConfig, max_adapters: int = 8, max_rank: int = 64, dtype=None):
+    On a multi-host gang (*mesh* spans processes) the bank is kept as a
+    HOST numpy mirror and published as replicated global-mesh arrays via
+    make_array_from_callback on every change: every rank runs the same
+    (stream-ordered) load/unload against the same checkpoint files, so
+    the mirrors agree bit-for-bit, and eager device scatters — which
+    multi-process arrays forbid — are never needed. Single-host keeps
+    the incremental device-scatter path (no full re-upload per load)."""
+
+    def __init__(self, config: ModelConfig, max_adapters: int = 8, max_rank: int = 64, dtype=None, mesh=None):
+        import jax
+
         self.config = config
         self.max_adapters = max_adapters
         self.max_rank = max_rank
+        self._mesh = mesh
+        self._multiproc = mesh is not None and jax.process_count() > 1
         # Row 0 is the reserved no-adapter identity.
-        self.bank = llama.init_lora_bank(config, max_adapters + 1, max_rank, dtype)
+        if self._multiproc:
+            shapes = jax.eval_shape(
+                lambda: llama.init_lora_bank(config, max_adapters + 1, max_rank, dtype)
+            )
+            self._host_bank = {
+                k: np.zeros(s.shape, s.dtype) for k, s in shapes.items()
+            }
+            self.bank = self._publish_global()
+        else:
+            self.bank = llama.init_lora_bank(config, max_adapters + 1, max_rank, dtype)
         self._rows: dict[str, int] = {}
         # Per-row generation, bumped whenever a row's weights change
         # (load/reload/unload): rows are recycled, so consumers caching
@@ -112,6 +133,18 @@ class AdapterRuntime:
         with self._lock:
             return sorted(self._rows)
 
+    def _publish_global(self) -> dict:
+        """Host mirror -> replicated global-mesh arrays (multiproc only;
+        a full re-upload per admin op, which is rare)."""
+        import jax
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        repl = NamedSharding(self._mesh, PartitionSpec())
+        return {
+            k: jax.make_array_from_callback(v.shape, repl, lambda idx, v=v: v[idx])
+            for k, v in self._host_bank.items()
+        }
+
     def load(self, name: str, path: str) -> None:
         cfg, targets, scale = load_peft_checkpoint(path)
         rank = cfg.get("r", 8)
@@ -127,18 +160,18 @@ class AdapterRuntime:
                     raise RuntimeError(f"adapter capacity {self.max_adapters} exhausted")
                 row = free[0]
 
-            # Build the update against a shallow COPY and publish it with
-            # one reference assignment at the end: the engine thread reads
-            # self.bank without a lock, and mutating the live dict target-
-            # by-target would let a decode chunk dispatched mid-reload run
-            # with mixed old/new A/B weights.
-            bank = dict(self.bank)
+            # Phase 1 — build every row update up front: all shape/name
+            # failures happen here, BEFORE any bank state is touched, so
+            # a rejected checkpoint can never leave a half-written row
+            # (the multiproc host mirror is mutated in place and has no
+            # copy-on-write to fall back on).
             L = self.config.num_layers
-            dtype = bank["wq_A"].dtype
+            dtype = self.bank["wq_A"].dtype
+            updates: dict[str, tuple[np.ndarray, np.ndarray]] = {}
             for target, layers in targets.items():
                 A_key, B_key = target + "_A", target + "_B"
-                din = bank[A_key].shape[2]
-                dout = bank[B_key].shape[3]
+                din = self.bank[A_key].shape[2]
+                dout = self.bank[B_key].shape[3]
                 A = np.zeros((L, din, self.max_rank), np.float32)
                 Bm = np.zeros((L, self.max_rank, dout), np.float32)
                 for li, (a, b) in layers.items():
@@ -148,9 +181,27 @@ class AdapterRuntime:
                     # [in, r] / [r, out], zero-padded to max_rank.
                     A[li, :, : a.shape[0]] = a.T
                     Bm[li, : b.shape[1], :] = b.T
-                bank[A_key] = bank[A_key].at[:, row].set(jnp.asarray(A, dtype))
-                bank[B_key] = bank[B_key].at[:, row].set(jnp.asarray(Bm, dtype))
-            bank["scale"] = bank["scale"].at[row].set(scale)
+                updates[target] = (A, Bm)
+
+            # Phase 2 — apply (infallible) and publish with one
+            # reference assignment at the end: the engine thread reads
+            # self.bank without a lock, and mutating the live dict
+            # target-by-target would let a decode chunk dispatched
+            # mid-reload run with mixed old/new A/B weights.
+            bank = dict(self.bank)
+            for target, (A, Bm) in updates.items():
+                A_key, B_key = target + "_A", target + "_B"
+                if self._multiproc:
+                    self._host_bank[A_key][:, row] = A.astype(dtype)
+                    self._host_bank[B_key][:, row] = Bm.astype(dtype)
+                else:
+                    bank[A_key] = bank[A_key].at[:, row].set(jnp.asarray(A, dtype))
+                    bank[B_key] = bank[B_key].at[:, row].set(jnp.asarray(Bm, dtype))
+            if self._multiproc:
+                self._host_bank["scale"][row] = scale
+                bank = self._publish_global()
+            else:
+                bank["scale"] = bank["scale"].at[row].set(scale)
             self.bank = bank  # atomic snapshot publish
             self._rows[name] = row
             self._row_gen[row] = self._row_gen.get(row, 0) + 1
@@ -160,11 +211,18 @@ class AdapterRuntime:
             row = self._rows.pop(name, None)
             if row is None:
                 return False
-            bank = dict(self.bank)  # atomic snapshot publish (see load)
-            for key in list(bank):
-                if key.endswith("_A") or key.endswith("_B"):
-                    bank[key] = bank[key].at[:, row].set(0.0)
-            bank["scale"] = bank["scale"].at[row].set(0.0)
+            if self._multiproc:
+                for key in self._host_bank:
+                    if key.endswith("_A") or key.endswith("_B"):
+                        self._host_bank[key][:, row] = 0
+                self._host_bank["scale"][row] = 0.0
+                bank = self._publish_global()
+            else:
+                bank = dict(self.bank)  # atomic snapshot publish (see load)
+                for key in list(bank):
+                    if key.endswith("_A") or key.endswith("_B"):
+                        bank[key] = bank[key].at[:, row].set(0.0)
+                bank["scale"] = bank["scale"].at[row].set(0.0)
             self.bank = bank
             self._row_gen[row] = self._row_gen.get(row, 0) + 1
             return True

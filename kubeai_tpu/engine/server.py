@@ -80,11 +80,11 @@ class EngineServer:
 
     @staticmethod
     def _resolve_adapter_path(name: str, path: str) -> str:
-        """Remote adapter sources are staged to local disk first (the
-        reference does this with an exec'd loader sidecar,
-        ref: internal/modelcontroller/adapters.go:143-160); the name was
-        validated against a strict charset by load_adapter."""
-        return _stage_remote(path, "/tmp/kubeai-adapters", prefix=f"{name}-")
+        """The engine stages remote adapter sources itself now (each
+        gang rank must stage independently — a path staged by rank 0 is
+        meaningless on a follower host); the name was validated against
+        a strict charset by load_adapter. Kept as a passthrough seam."""
+        return path
 
     def unload_adapter(self, name: str) -> tuple[bool, str]:
         with self._adapters_lock:
@@ -467,22 +467,9 @@ def _make_handler(srv: EngineServer):
 
 
 def _stage_remote(url: str, base_dir: str, prefix: str = "") -> str:
-    """Shared remote-source staging: file:// strips to a local path,
-    other schemes (hf/s3/gs/oss) download into base_dir under a dest
-    keyed by the URL hash — so a changed URL never reuses a stale
-    download (loader.load skips already-populated destinations) — and
-    plain paths pass through."""
-    if url.startswith("file://"):
-        return url[len("file://") :]
-    if "://" in url:
-        from kubeai_tpu.loader import load
-        from kubeai_tpu.utils.xxh import xxh64
+    from kubeai_tpu.loader import stage_remote
 
-        dest = os.path.join(base_dir, f"{prefix}{xxh64(url) & 0xFFFFFFFFFFFF:012x}")
-        log.info("staging %s -> %s", url, dest)
-        load(url, dest)
-        return dest
-    return url
+    return stage_remote(url, base_dir, prefix=prefix)
 
 
 def _resolve_model_path(model: str) -> str:

@@ -71,6 +71,24 @@ def evict(dest: str) -> None:
         print(f"{dest} already absent")
 
 
+def stage_remote(url: str, base_dir: str, prefix: str = "") -> str:
+    """Shared remote-source staging: file:// strips to a local path,
+    other schemes (hf/s3/gs/oss) download into base_dir under a dest
+    keyed by the URL hash — so a changed URL never reuses a stale
+    download (load() skips already-populated destinations) — and plain
+    paths pass through. Used by the engine server for models and by the
+    engine itself for adapters (each gang rank stages independently)."""
+    if url.startswith("file://"):
+        return url[len("file://") :]
+    if "://" in url:
+        from kubeai_tpu.utils.xxh import xxh64
+
+        dest = os.path.join(base_dir, f"{prefix}{xxh64(url) & 0xFFFFFFFFFFFF:012x}")
+        load(url, dest)
+        return dest
+    return url
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser("kubeai-tpu-loader")
     parser.add_argument("--evict", action="store_true")
