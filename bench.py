@@ -199,8 +199,11 @@ def build_engine(preset: str, speculate: int = 0, slots: int = 0):
         )
         if jax.default_backend() == "tpu":
             mc = mc.replace(use_flash_prefill=True, use_paged_kernel=True)
+        # Slot scaling measured on v5e: 32 slots = 2993 tok/s, 64 = 4389
+        # (p50 TTFT 2.0s), 96 = 4512 but with worse TTFT (2.5s) — 64 is
+        # the throughput/latency knee for this bf16 config.
         ec = EngineConfig(
-            max_slots=32, max_seq_len=1024, prefill_buckets=(128, 256, 512),
+            max_slots=64, max_seq_len=1024, prefill_buckets=(128, 256, 512),
             decode_chunk=16,
         )
         params = llama.init_params(mc, jax.random.key(0))
