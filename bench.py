@@ -142,7 +142,7 @@ def synth_int8_params(mc):
     }
 
 
-def build_engine(preset: str, speculate: int = 0, slots: int = 0):
+def build_engine(preset: str, speculate: int = 0, slots: int = 0, chunk: int = 0):
     import jax
 
     from kubeai_tpu.engine.core import Engine, EngineConfig
@@ -211,6 +211,8 @@ def build_engine(preset: str, speculate: int = 0, slots: int = 0):
         ec.speculate_tokens = speculate
     if slots:
         ec.max_slots = slots
+    if chunk:
+        ec.decode_chunk = chunk
     return Engine(mc, params, ByteTokenizer(), ec)
 
 
@@ -263,7 +265,9 @@ def run_worker(args) -> None:
 
     t0 = time.monotonic()
     log(f"phase=build constructing engine (weights on device)")
-    eng = build_engine(preset, speculate=args.speculate, slots=args.slots)
+    eng = build_engine(
+        preset, speculate=args.speculate, slots=args.slots, chunk=args.chunk
+    )
     eng.start()
     log(f"phase=build done ({time.monotonic()-t0:.1f}s)")
 
@@ -506,6 +510,8 @@ def run_orchestrated(args) -> int:
             cmd += ["--greedy"]
         if args.slots:
             cmd += ["--slots", str(args.slots)]
+        if args.chunk:
+            cmd += ["--chunk", str(args.chunk)]
         log(f"phase=run preset={preset} budget={budget}s")
         try:
             out = subprocess.run(
@@ -582,6 +588,10 @@ def main():
     parser.add_argument(
         "--slots", type=int, default=0,
         help="override the preset's max decode slots (batch size)",
+    )
+    parser.add_argument(
+        "--chunk", type=int, default=0,
+        help="override the preset's fused decode steps per dispatch",
     )
     parser.add_argument(
         "--watchdog", type=int, default=None,
