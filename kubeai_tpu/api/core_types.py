@@ -17,6 +17,7 @@ KIND_POD = "Pod"
 KIND_PVC = "PersistentVolumeClaim"
 KIND_JOB = "Job"
 KIND_CONFIGMAP = "ConfigMap"
+KIND_SECRET = "Secret"
 
 
 @dataclass
@@ -125,6 +126,17 @@ class Job:
 
 @dataclass
 class ConfigMap:
+    meta: ObjectMeta = field(default_factory=ObjectMeta)
+    data: dict[str, str] = field(default_factory=dict)
+
+
+@dataclass
+class Secret:
+    """core/v1 Secret (string data only — the controller provisions
+    tokens, e.g. the per-gang dispatch-stream secret, and references
+    them from pods via envFrom secretRef so the value never appears in
+    a pod spec)."""
+
     meta: ObjectMeta = field(default_factory=ObjectMeta)
     data: dict[str, str] = field(default_factory=dict)
 

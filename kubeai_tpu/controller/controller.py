@@ -25,7 +25,7 @@ from kubeai_tpu.controller.files import ensure_model_files_configmap, patch_file
 from kubeai_tpu.controller.model_source import parse_model_source
 from kubeai_tpu.controller.patch import apply_json_patch_to_pod
 from kubeai_tpu.controller.pod_plan import calculate_pod_plan, pod_spec_hash
-from kubeai_tpu.runtime.store import Conflict, NotFound, Store, WatchEvent
+from kubeai_tpu.runtime.store import Conflict, NotFound, ObjectMeta, Store, WatchEvent
 
 log = logging.getLogger("kubeai_tpu.controller")
 
@@ -232,25 +232,53 @@ class ModelReconciler:
         def gang_stale(gang: list[Pod]) -> bool:
             return any(p.meta.labels.get(mt.LABEL_POD_HASH) != expected_hash for p in gang) or len(gang) != hosts
 
-        keep: list[str] = []
-        for sid, gang in gang_items:
-            if gang_stale(gang):
-                for p in gang:
-                    try:
-                        self.store.delete(KIND_POD, p.meta.name, p.meta.namespace)
-                    except NotFound:
-                        pass
-            else:
-                keep.append(sid)
-        for sid in keep[desired_replicas:]:
-            for p in gangs[sid]:
+        from kubeai_tpu.api.core_types import KIND_SECRET, Secret
+
+        def delete_gang(sid: str, gang: list[Pod]) -> None:
+            for p in gang:
                 try:
                     self.store.delete(KIND_POD, p.meta.name, p.meta.namespace)
                 except NotFound:
                     pass
+            try:  # the gang's dispatch-stream secret dies with it
+                self.store.delete(
+                    KIND_SECRET,
+                    f"model-{model.meta.name}-gang-{sid}",
+                    model.meta.namespace,
+                )
+            except NotFound:
+                pass
+
+        keep: list[str] = []
+        for sid, gang in gang_items:
+            if gang_stale(gang):
+                delete_gang(sid, gang)
+            else:
+                keep.append(sid)
+        for sid in keep[desired_replicas:]:
+            delete_gang(sid, gangs[sid])
         missing = desired_replicas - min(len(keep), desired_replicas)
         for _ in range(missing):
             sid = uuid.uuid4().hex[:8]
+            # Per-gang shared secret for the rank-0 dispatch stream
+            # (engine/gang.py handshake): provisioned as a real Secret
+            # referenced via envFrom, NOT a plaintext pod-spec env value
+            # — pod read access must not yield the token that joins (or
+            # impersonates) the gang publisher.
+            secret_name = f"model-{model.meta.name}-gang-{sid}"
+            gang_secret = Secret(
+                meta=ObjectMeta(
+                    name=secret_name,
+                    namespace=model.meta.namespace,
+                    labels={mt.LABEL_MODEL: model.meta.name, "slice-id": sid},
+                    owner_uids=[model.meta.uid],
+                ),
+                data={"KUBEAI_GANG_SECRET": uuid.uuid4().hex + uuid.uuid4().hex},
+            )
+            try:
+                self.store.create(KIND_SECRET, gang_secret)
+            except Conflict:
+                pass
             hostnames = [
                 f"model-{model.meta.name}-{sid}-{rank}.{desired.spec.subdomain}"
                 for rank in range(hosts)
@@ -267,6 +295,7 @@ class ModelReconciler:
                 server = pod.spec.containers[0]
                 server.env["TPU_WORKER_ID"] = str(rank)
                 server.env["TPU_WORKER_HOSTNAMES"] = ",".join(hostnames)
+                server.env[f"__envFromSecret_{secret_name}"] = secret_name
                 try:
                     self.store.create(KIND_POD, pod)
                 except Conflict:
@@ -296,6 +325,11 @@ class ModelReconciler:
         from kubeai_tpu.controller.cache import CACHE_FINALIZER
 
         self.store.delete_all_of(KIND_POD, model.meta.namespace, {mt.LABEL_MODEL: model.meta.name})
+        from kubeai_tpu.api.core_types import KIND_SECRET
+
+        self.store.delete_all_of(
+            KIND_SECRET, model.meta.namespace, {mt.LABEL_MODEL: model.meta.name}
+        )
         if self.cache_reconciler is not None and model.spec.cache_profile:
             if not self.cache_reconciler.finalize(model):
                 return  # eviction job still running

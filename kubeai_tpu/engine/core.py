@@ -34,6 +34,7 @@ import logging
 import queue
 import threading
 import time
+from contextlib import contextmanager
 from dataclasses import dataclass, field
 from functools import partial
 from typing import Any
@@ -54,6 +55,16 @@ log = logging.getLogger("kubeai_tpu.engine")
 class GangLost(ConnectionError):
     """A gang follower's dispatch connection failed — the gang's
     collectives can never realign; serving from this rank is over."""
+
+
+class GangDesync(RuntimeError):
+    """Rank 0 broadcast an op but failed before/while executing it
+    locally (advisor r3, core.py): the followers have already entered
+    that op's global-mesh collective and are blocked waiting for rank 0
+    to join — a "reset" op can never reach them (they aren't reading the
+    stream), so per-request swallowing or reset recovery just hangs the
+    gang. Fatal for the rank: fail in-flight requests and exit for the
+    controller to recreate the slice gang."""
 
 
 @dataclass
@@ -576,6 +587,15 @@ class Engine:
     # -- public API --------------------------------------------------------
 
     def start(self):
+        # Idempotent: EngineServer.start() starts its engine for the
+        # standalone pod path, but callers that pre-start the engine
+        # (tests, embedding tools) must not end up with TWO scheduler
+        # threads — concurrent loops race on the donated device carries
+        # (cache/adm_toks), which surfaces as "Buffer has been deleted
+        # or donated" on dispatch and a client-facing 500.
+        if self._thread is not None and self._thread.is_alive():
+            self._running = True
+            return
         self._running = True
         self._thread = threading.Thread(target=self._loop, name="engine-loop", daemon=True)
         self._thread.start()
@@ -721,10 +741,10 @@ class Engine:
             for n, tokens, lengths in groups:
 
                 def thunk(tokens=tokens, lengths=lengths):
-                    self._bcast(
+                    with self._lockstep(
                         "embed", arrays={"tokens": tokens, "lengths": lengths}
-                    )
-                    return self._embed_jit(self.params, tokens, lengths)
+                    ):
+                        return self._embed_jit(self.params, tokens, lengths)
 
                 pending.append((n, self._submit_aux(thunk)))
             for n, rq in pending:
@@ -808,6 +828,12 @@ class Engine:
             # _loop's recovery — which terminates the rank, the gang
             # cannot realign — not be swallowed as a per-request error.
             rq.put(("error", "gang follower lost"))
+            raise
+        except GangDesync:
+            # Broadcast succeeded but the local dispatch failed
+            # (advisor r3): the gang cannot realign — escalate to
+            # _loop's fatal path instead of per-request swallowing.
+            rq.put(("error", "gang dispatch stream desynced"))
             raise
         except Exception as e:  # no donation: decode state is unharmed
             log.exception("aux dispatch failed")
@@ -1036,6 +1062,14 @@ class Engine:
                 ):
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
+            except GangDesync as e:
+                # The followers executed an op this rank didn't: no reset
+                # can realign the gang (they're blocked in its collective).
+                # Same blast radius as losing a rank — fail everything and
+                # exit for the controller to recreate the slice gang.
+                log.critical("%s; terminating rank 0", e)
+                self._terminate_rank("gang desynced; slice restarting", code=14)
+                return  # tests stub _terminate_rank; production never gets here
             except Exception:
                 # A failed jitted step may have consumed donated buffers —
                 # the device state is unusable. Fail all in-flight requests
@@ -1058,6 +1092,39 @@ class Engine:
                 # a reason to tear the gang down).
                 raise GangLost(str(e)) from e
 
+    @contextmanager
+    def _lockstep(self, op: str, scalars: dict | None = None, arrays: dict | None = None):
+        """Broadcast *op*, then run the matching local dispatch in the
+        with-body. In a gang, a body failure AFTER the broadcast is
+        unrecoverable-by-reset: the followers replayed an op rank 0
+        never executed, so the ranks' computation streams diverged and
+        they are blocked inside the unmatched collective — escalate to
+        GangDesync (fatal for the rank). Single-host, the original
+        exception propagates unchanged into ordinary reset recovery."""
+        self._bcast(op, scalars, arrays)
+        try:
+            yield
+        except (GangLost, GangDesync):
+            raise
+        except Exception as e:
+            if self._publisher is not None:
+                raise GangDesync(
+                    f"rank 0 failed to execute broadcast op {op!r}: {e}"
+                ) from e
+            raise
+
+    def _terminate_rank(self, message: str, code: int) -> None:
+        """Unrecoverable gang failure: error everything in flight, then
+        exit for the controller to recreate the whole slice gang (same
+        blast radius as losing a Ray/NCCL rank in the reference's
+        delegated engines). Exiting without cleanup would leave clients
+        hanging until timeout. Overridable hook so tests can observe the
+        fatal path without losing the process."""
+        self._fail_inflight(message)
+        import os as _os
+
+        _os._exit(code)
+
     def _recover(self):
         try:
             self._bcast("reset")
@@ -1065,16 +1132,8 @@ class Engine:
             if self._running:
                 # A follower is gone: the gang's collectives can never
                 # line up again, so serving from this process is over.
-                # Error everything in flight, then exit for the
-                # controller to recreate the whole slice gang (same
-                # blast radius as losing a Ray/NCCL rank in the
-                # reference's delegated engines). Exiting without
-                # cleanup would leave clients hanging until timeout.
                 log.critical("gang follower connection lost; terminating rank 0")
-                self._fail_inflight("gang follower lost; slice restarting")
-                import os as _os
-
-                _os._exit(13)
+                self._terminate_rank("gang follower lost; slice restarting", code=13)
         self._fail_inflight("engine reset after device error")
         self._init_device_state()
 
@@ -1316,7 +1375,7 @@ class Engine:
             bucket = max_bucket if not is_last else self._bucket(len(chunk))
             chunk_padded = np.zeros((1, bucket), np.int32)
             chunk_padded[0, : len(chunk)] = chunk
-            self._bcast(
+            with self._lockstep(
                 "prefill_chunk",
                 scalars={
                     "start": start, "last_idx": len(chunk) - 1,
@@ -1326,22 +1385,22 @@ class Engine:
                     **({"lora_row": lora_row} if self._adapters is not None else {}),
                 },
                 arrays={"tokens": chunk_padded, "table": table},
-            )
-            tok, lp, self._cache, self._adm_toks = self._prefill_chunk_jit(
-                self.params,
-                chunk_padded,
-                np.int32(start),
-                np.int32(len(chunk) - 1),
-                table,
-                np.int32(slot_idx),
-                seed,
-                np.float32(sp.temperature),
-                np.float32(sp.top_p),
-                np.int32(sp.top_k),
-                self._adm_toks,
-                self._cache,
-                **lora_args,
-            )
+            ):
+                tok, lp, self._cache, self._adm_toks = self._prefill_chunk_jit(
+                    self.params,
+                    chunk_padded,
+                    np.int32(start),
+                    np.int32(len(chunk) - 1),
+                    table,
+                    np.int32(slot_idx),
+                    seed,
+                    np.float32(sp.temperature),
+                    np.float32(sp.top_p),
+                    np.int32(sp.top_k),
+                    self._adm_toks,
+                    self._cache,
+                    **lora_args,
+                )
 
         self._register(slot_idx, req, seed, lora_row, reuse)
         return (slot_idx, self._slot_epoch[slot_idx], tok, None, lp)
@@ -1446,7 +1505,7 @@ class Engine:
         lora_args = {}
         if self._adapters is not None:
             lora_args = {"lora": self._adapters.bank, "lora_rows": lora_rows_arr}
-        self._bcast(
+        with self._lockstep(
             "prefill_batch",
             arrays={
                 "tokens": tokens, "lengths": lengths, "tables": tables,
@@ -1457,21 +1516,21 @@ class Engine:
                 # agree — load ops are ordered in the same stream).
                 **({"lora_rows": lora_rows_arr} if self._adapters is not None else {}),
             },
-        )
-        toks, lps, self._cache, self._adm_toks = self._prefill_batch_jit(
-            self.params,
-            tokens,
-            lengths,
-            tables,
-            slots_arr,
-            seeds,
-            temps,
-            top_ps,
-            top_ks,
-            self._adm_toks,
-            self._cache,
-            **lora_args,
-        )
+        ):
+            toks, lps, self._cache, self._adm_toks = self._prefill_batch_jit(
+                self.params,
+                tokens,
+                lengths,
+                tables,
+                slots_arr,
+                seeds,
+                temps,
+                top_ps,
+                top_ks,
+                self._adm_toks,
+                self._cache,
+                **lora_args,
+            )
         out = []
         for j, (slot_idx, req) in enumerate(items):
             self._register(slot_idx, req, seeds[j], int(lora_rows_arr[j]), reuse=0)
@@ -1493,7 +1552,7 @@ class Engine:
             if self.cfg.speculate_tokens > 0
             else {}
         )
-        self._bcast(
+        with self._lockstep(
             "decode",
             arrays={
                 "tables": self._page_table, "active": self._h_active,
@@ -1503,29 +1562,29 @@ class Engine:
                 **({"adm_hist": self._adm_hist} if self.cfg.speculate_tokens > 0 else {}),
                 **({"lora_rows": self._h_lora_rows} if self._adapters is not None else {}),
             },
-        )
-        (
-            d_seq, c_seq, a_seq, lpd_seq, lpc_seq,
-            self._cache, self._tok_hist, self._lengths, self._last_tokens, self._keys,
-        ) = self._decode_jit(
-            self.params,
-            self._cache,
-            self._page_table.copy(),
-            self._tok_hist,
-            self._lengths,
-            self._last_tokens,
-            self._keys,
-            self._h_active.copy(),
-            self._h_temp.copy(),
-            self._h_top_p.copy(),
-            self._h_top_k.copy(),
-            self._adm_mask.copy(),
-            self._adm_len.copy(),
-            self._adm_seed.copy(),
-            self._adm_toks,
-            **adm_hist,
-            **lora_args,
-        )
+        ):
+            (
+                d_seq, c_seq, a_seq, lpd_seq, lpc_seq,
+                self._cache, self._tok_hist, self._lengths, self._last_tokens, self._keys,
+            ) = self._decode_jit(
+                self.params,
+                self._cache,
+                self._page_table.copy(),
+                self._tok_hist,
+                self._lengths,
+                self._last_tokens,
+                self._keys,
+                self._h_active.copy(),
+                self._h_temp.copy(),
+                self._h_top_p.copy(),
+                self._h_top_k.copy(),
+                self._adm_mask.copy(),
+                self._adm_len.copy(),
+                self._adm_seed.copy(),
+                self._adm_toks,
+                **adm_hist,
+                **lora_args,
+            )
         self._adm_mask[:] = False
         snapshot = [
             (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None

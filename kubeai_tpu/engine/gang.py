@@ -32,8 +32,11 @@ reference's Ray workers.
 
 from __future__ import annotations
 
+import hmac
+import hashlib
 import json
 import logging
+import os
 import socket
 import struct
 import threading
@@ -44,6 +47,23 @@ import numpy as np
 log = logging.getLogger("kubeai_tpu.engine.gang")
 
 DEFAULT_GANG_PORT = 8477
+
+# Handshake domain-separation tags (follower proof vs publisher proof —
+# without distinct tags a MITM could reflect one side's MAC back at it).
+_TAG_FOLLOWER = b"kubeai-gang-v1:follower"
+_TAG_PUBLISHER = b"kubeai-gang-v1:publisher"
+_CHALLENGE_LEN = 16
+_MAC_LEN = 32  # HMAC-SHA256
+
+
+def _mac(secret: bytes, tag: bytes, challenge: bytes, rank: int) -> bytes:
+    return hmac.new(
+        secret, tag + challenge + struct.pack(">I", rank), hashlib.sha256
+    ).digest()
+
+
+class GangAuthError(ConnectionError):
+    """A peer failed the shared-secret handshake."""
 
 
 def _encode(op: str, scalars: dict | None, arrays: dict[str, np.ndarray] | None) -> bytes:
@@ -57,6 +77,24 @@ def _encode(op: str, scalars: dict | None, arrays: dict[str, np.ndarray] | None)
         {"op": op, "scalars": scalars or {}, "arrays": meta}
     ).encode()
     return b"".join([struct.pack(">I", len(header)), header] + blobs)
+
+
+def _read_exact_sock(sock: socket.socket, n: int, deadline: float | None = None) -> bytes:
+    """Read exactly n bytes from a raw socket. With *deadline* (monotonic
+    time), the TOTAL read is bounded — a per-recv timeout alone lets a
+    peer drip-feed one byte per interval and stall forever."""
+    buf = b""
+    while len(buf) < n:
+        if deadline is not None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise socket.timeout("handshake deadline exceeded")
+            sock.settimeout(remaining)
+        chunk = sock.recv(n - len(buf))
+        if not chunk:
+            raise ConnectionError("gang stream closed during handshake")
+        buf += chunk
+    return buf
 
 
 def _read_exact(f, n: int) -> bytes:
@@ -81,30 +119,135 @@ def _decode(f) -> tuple[str, dict, dict[str, np.ndarray]]:
 
 
 class GangPublisher:
-    """Rank 0's side: accept one connection per follower, then fan every
-    dispatch out in order. publish() is called from the engine scheduler
-    thread (and, rarely, adapter RPC threads) — serialized by a lock."""
+    """Rank 0's side: authenticate one connection per follower rank, then
+    fan every dispatch out in order. publish() is called from the engine
+    scheduler thread (and, rarely, adapter RPC threads) — serialized by a
+    lock.
 
-    def __init__(self, n_followers: int, port: int = DEFAULT_GANG_PORT, host: str = "0.0.0.0"):
+    Every connection must pass a shared-secret challenge-response before
+    it counts as a gang member: the dispatch stream carries all prompt
+    token ids, sampling params and adapter paths, and an unauthenticated
+    accept would both leak that stream to any reachable peer AND let it
+    displace a real follower so the gang never assembles. The secret is
+    provisioned by the controller per slice gang (KUBEAI_GANG_SECRET)."""
+
+    _HANDSHAKE_BUDGET = 10.0  # total seconds per connection attempt
+
+    def __init__(self, n_followers: int, port: int = DEFAULT_GANG_PORT, host: str = "0.0.0.0", *, secret: str | bytes):
+        if not secret:
+            raise ValueError("gang secret must be non-empty (set KUBEAI_GANG_SECRET)")
         self.n_followers = n_followers
+        self._secret = secret.encode() if isinstance(secret, str) else secret
         self._lock = threading.Lock()
         self._conns: list[socket.socket] = []
+        self._ranks: dict[int, socket.socket] = {}
+        self._assembled = threading.Event()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
-        self._srv.listen(n_followers)
+        self._srv.listen(max(n_followers, 4))
         self.port = self._srv.getsockname()[1]
+        if n_followers == 0:
+            self._assembled.set()
+        # Handshakes run on a background acceptor from construction, NOT
+        # inside accept_all: rank 0 builds its engine (minutes for a real
+        # checkpoint, and possibly containing global-mesh programs that
+        # need every rank participating) BEFORE it calls accept_all, and
+        # followers block in their own handshake until the challenge
+        # arrives — challenging only from accept_all would stall every
+        # follower behind rank 0's build (or deadlock the slice).
+        self._acceptor = threading.Thread(
+            target=self._accept_loop, name="gang-accept", daemon=True
+        )
+        self._acceptor.start()
+
+    def _handshake(self, conn: socket.socket, addr) -> int:
+        """Challenge-response on a fresh connection; returns the proven
+        follower rank. Raises GangAuthError on any mismatch. The WHOLE
+        exchange shares one deadline — per-recv timeouts would let a
+        peer drip-feed bytes and stall gang assembly indefinitely."""
+        deadline = time.monotonic() + self._HANDSHAKE_BUDGET
+        challenge = os.urandom(_CHALLENGE_LEN)
+        conn.sendall(challenge)
+        try:
+            buf = _read_exact_sock(conn, 4 + _MAC_LEN, deadline=deadline)
+        except (ConnectionError, socket.timeout) as e:
+            raise GangAuthError(f"{addr}: {e}") from e
+        (rank,) = struct.unpack(">I", buf[:4])
+        want = _mac(self._secret, _TAG_FOLLOWER, challenge, rank)
+        if not hmac.compare_digest(buf[4:], want):
+            raise GangAuthError(f"{addr}: bad handshake MAC")
+        if not (1 <= rank <= self.n_followers):
+            raise GangAuthError(f"{addr}: rank {rank} out of range")
+        if rank in self._ranks:
+            raise GangAuthError(f"{addr}: duplicate rank {rank}")
+        # Prove the publisher knows the secret too (mutual: a follower
+        # must not replay its dispatch stream for an impostor rank 0).
+        conn.sendall(_mac(self._secret, _TAG_PUBLISHER, challenge, rank))
+        return rank
+
+    def _accept_loop(self) -> None:
+        """Accept until the gang is assembled (or the server socket
+        closes). Each handshake runs on its own bounded thread — done
+        serially, one slow/malicious peer reconnecting in a loop would
+        hold the acceptor for _HANDSHAKE_BUDGET per attempt and starve
+        the real followers out of accept_all's whole assembly window."""
+        while not self._assembled.is_set():
+            try:
+                self._srv.settimeout(None)
+                conn, addr = self._srv.accept()
+            except OSError:
+                return  # close() shut the server socket
+            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
+            threading.Thread(
+                target=self._handshake_and_register,
+                args=(conn, addr),
+                name="gang-handshake",
+                daemon=True,
+            ).start()
+
+    def _handshake_and_register(self, conn: socket.socket, addr) -> None:
+        try:
+            rank = self._handshake(conn, addr)
+            conn.settimeout(None)
+        except (GangAuthError, OSError) as e:
+            log.warning("rejecting gang connection from %s: %s", addr, e)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
+        # Membership under the publish lock: concurrent handshakes for
+        # the same rank must not both register, and publish() must not
+        # iterate _conns mid-append.
+        with self._lock:
+            if rank in self._ranks or self._assembled.is_set():
+                log.warning(
+                    "rejecting gang connection from %s: duplicate rank %d", addr, rank
+                )
+                try:
+                    conn.close()
+                except OSError:
+                    pass
+                return
+            self._ranks[rank] = conn
+            self._conns.append(conn)
+            n = len(self._ranks)
+        log.info(
+            "gang follower rank %d (%d/%d) authenticated from %s",
+            rank, n, self.n_followers, addr,
+        )
+        if n >= self.n_followers:
+            self._assembled.set()
 
     def accept_all(self, timeout: float = 300.0) -> None:
-        """Block until every follower has connected (gang assembly)."""
-        self._srv.settimeout(timeout)
-        deadline = time.monotonic() + timeout
-        while len(self._conns) < self.n_followers:
-            self._srv.settimeout(max(1.0, deadline - time.monotonic()))
-            conn, addr = self._srv.accept()
-            conn.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
-            self._conns.append(conn)
-            log.info("gang follower %d/%d connected from %s", len(self._conns), self.n_followers, addr)
+        """Block until every follower rank has connected AND passed the
+        handshake (gang assembly)."""
+        if not self._assembled.wait(timeout):
+            raise TimeoutError(
+                f"gang assembly timed out: {len(self._ranks)}/"
+                f"{self.n_followers} followers authenticated within {timeout}s"
+            )
 
     def publish(self, op: str, scalars: dict | None = None, arrays: dict[str, np.ndarray] | None = None) -> None:
         payload = _encode(op, scalars, arrays)
@@ -137,17 +280,53 @@ class GangPublisher:
 
 
 class GangFollower:
-    """Rank >0's side: connect to rank 0 and yield ops in order."""
+    """Rank >0's side: connect to rank 0, prove the shared secret and this
+    process's rank, verify rank 0's counter-proof, then yield ops in
+    order."""
 
-    def __init__(self, host: str, port: int = DEFAULT_GANG_PORT, timeout: float = 300.0):
+    def __init__(self, host: str, port: int = DEFAULT_GANG_PORT, timeout: float = 300.0, *, secret: str | bytes, rank: int):
+        if not secret:
+            raise ValueError("gang secret must be non-empty (set KUBEAI_GANG_SECRET)")
+        if rank < 1:
+            raise ValueError(f"follower rank must be >= 1, got {rank}")
+        sec = secret.encode() if isinstance(secret, str) else secret
         deadline = time.monotonic() + timeout
         last_err: Exception | None = None
         while True:
             try:
                 self._sock = socket.create_connection((host, port), timeout=10)
+                self._sock.settimeout(10)
+                challenge = _read_exact_sock(self._sock, _CHALLENGE_LEN)
+                self._sock.sendall(
+                    struct.pack(">I", rank) + _mac(sec, _TAG_FOLLOWER, challenge, rank)
+                )
+                proof = _read_exact_sock(self._sock, _MAC_LEN)
+                if not hmac.compare_digest(
+                    proof, _mac(sec, _TAG_PUBLISHER, challenge, rank)
+                ):
+                    raise GangAuthError(
+                        f"publisher {host}:{port} failed counter-proof "
+                        "(wrong secret or impostor)"
+                    )
                 break
-            except OSError as e:  # rank 0 not listening yet
+            except GangAuthError:
+                # A live publisher with the wrong secret will never
+                # change its mind mid-assembly — fail fast, don't retry.
+                try:
+                    self._sock.close()
+                except OSError:
+                    pass
+                raise
+            except OSError as e:  # rank 0 not listening yet, or it
+                # rejected us (duplicate rank during a reconnect race):
+                # retry until the deadline.
                 last_err = e
+                sock = getattr(self, "_sock", None)
+                if sock is not None:  # don't leak the failed attempt
+                    try:
+                        sock.close()
+                    except OSError:
+                        pass
                 if time.monotonic() > deadline:
                     raise TimeoutError(
                         f"could not reach gang publisher {host}:{port}: {last_err}"
@@ -158,7 +337,7 @@ class GangFollower:
         # no requests (the connect timeout must not apply to recv).
         self._sock.settimeout(None)
         self._file = self._sock.makefile("rb")
-        log.info("connected to gang publisher %s:%d", host, port)
+        log.info("connected to gang publisher %s:%d as rank %d", host, port, rank)
 
     def recv(self) -> tuple[str, dict, dict[str, np.ndarray]]:
         return _decode(self._file)

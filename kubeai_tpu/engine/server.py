@@ -543,6 +543,20 @@ def _gang_port() -> int:
     return int(os.environ.get("KUBEAI_GANG_PORT", str(DEFAULT_GANG_PORT)))
 
 
+def _gang_secret() -> str:
+    """Controller-provisioned shared secret authenticating gang members
+    (stamped per slice gang by the controller / LocalRuntime). Required:
+    the dispatch stream carries prompt tokens and adapter paths, so an
+    unauthenticated gang port is both a leak and a denial-of-assembly."""
+    secret = os.environ.get("KUBEAI_GANG_SECRET", "")
+    if not secret:
+        raise SystemExit(
+            "KUBEAI_GANG_SECRET is required for multi-host gangs "
+            "(the controller stamps it on slice pods)"
+        )
+    return secret
+
+
 def run_follower(args, hosts: list[str]) -> None:
     """Serve as a gang follower (rank > 0): build the same engine over
     the global mesh, connect to rank 0's dispatch stream, expose ONLY
@@ -551,7 +565,11 @@ def run_follower(args, hosts: list[str]) -> None:
     so the controller recreates the slice gang."""
     from kubeai_tpu.engine.gang import GangFollower
 
-    follower = GangFollower(hosts[0], _gang_port())
+    import jax
+
+    follower = GangFollower(
+        hosts[0], _gang_port(), secret=_gang_secret(), rank=jax.process_index()
+    )
     engine, name = build_engine_from_args(args)
 
     class FollowerHandler(BaseHTTPRequestHandler):
@@ -670,7 +688,9 @@ def main(argv=None):
         # tensor-parallel serving over the slice; engine/gang.py).
         from kubeai_tpu.engine.gang import GangPublisher
 
-        publisher = GangPublisher(len(gang_hosts) - 1, port=_gang_port())
+        publisher = GangPublisher(
+            len(gang_hosts) - 1, port=_gang_port(), secret=_gang_secret()
+        )
 
     engine, name = build_engine_from_args(args, publisher=publisher)
     if publisher is not None:

@@ -180,9 +180,15 @@ class _AmqpConn:
             .longstr(b"\x00" + user.encode() + b"\x00" + pw.encode())
             .shortstr("en_US"),
         )
-        self._expect(CONNECTION, CONN_TUNE)
+        tune = self._expect(CONNECTION, CONN_TUNE)
+        tune.u16()  # channel-max
+        server_frame_max = tune.u32()
+        # Negotiate down from the server's proposal (0 = unlimited per
+        # spec §1.4.2.6; cap at our default). TuneOk must echo a value
+        # the server allows — and publish() must then respect it.
+        self._frame_max = min(server_frame_max or 131072, 131072)
         self._send_method(
-            0, method(CONNECTION, CONN_TUNE_OK).u16(0).u32(131072).u16(0)
+            0, method(CONNECTION, CONN_TUNE_OK).u16(0).u32(self._frame_max).u16(0)
         )
         self._send_method(
             0, method(CONNECTION, CONN_OPEN).shortstr("/").shortstr("").u8(0)
@@ -261,10 +267,17 @@ class _AmqpConn:
                 self._deliveries.put((-1, b""))  # closed marker
 
     def publish(self, body: bytes) -> None:
-        # Default exchange "" routes by queue name. All THREE frames under
-        # one lock hold: the messenger publishes responses from concurrent
+        # Default exchange "" routes by queue name. ALL frames under one
+        # lock hold: the messenger publishes responses from concurrent
         # handler threads, and an interleaved method frame mid-content is
         # an AMQP protocol violation (UNEXPECTED_FRAME connection close).
+        # Bodies are split into BODY frames of at most frame_max-8 bytes
+        # (7-byte frame header + frame-end octet): one oversized frame —
+        # e.g. a large completion or embedding-response JSON — is itself
+        # a framing violation the broker answers by closing the
+        # connection (advisor r3; the read side already reassembles
+        # multi-frame bodies).
+        chunk_max = self._frame_max - 8
         with self._wlock:
             write_frame(
                 self._sock, FRAME_METHOD, 1,
@@ -274,7 +287,8 @@ class _AmqpConn:
                 self._sock, FRAME_HEADER, 1,
                 Writer().u16(BASIC).u16(0).u64(len(body)).u16(0).build(),
             )
-            write_frame(self._sock, FRAME_BODY, 1, body)
+            for off in range(0, len(body), chunk_max):
+                write_frame(self._sock, FRAME_BODY, 1, body[off : off + chunk_max])
 
     def ack(self, tag: int) -> None:
         self._send_method(1, method(BASIC, B_ACK).u64(tag).u8(0))

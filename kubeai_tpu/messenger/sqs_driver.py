@@ -136,13 +136,21 @@ class SqsTopic(Topic):
         self._client = _SqsClient(ref)
 
     def send(self, body: bytes) -> None:
-        self._client.call(
-            "SendMessage",
-            {
-                "QueueUrl": self._client.queue_url,
-                "MessageBody": base64.b64encode(body).decode(),
-            },
-        )
+        # gocloud's awssnssqs convention (the reference's driver): UTF-8-
+        # safe bodies go raw; only binary payloads are base64-encoded,
+        # flagged via the `base64encoded` message attribute. Sniffing on
+        # receive instead would corrupt a raw text message that happens
+        # to be valid base64 (advisor r3), and unconditional encoding
+        # would be unreadable to reference consumers.
+        payload: dict = {"QueueUrl": self._client.queue_url}
+        try:
+            payload["MessageBody"] = body.decode("utf-8")
+        except UnicodeDecodeError:
+            payload["MessageBody"] = base64.b64encode(body).decode()
+            payload["MessageAttributes"] = {
+                "base64encoded": {"DataType": "String", "StringValue": "true"}
+            }
+        self._client.call("SendMessage", payload)
 
 
 class SqsSubscription(Subscription):
@@ -158,6 +166,7 @@ class SqsSubscription(Subscription):
                 "QueueUrl": self._client.queue_url,
                 "MaxNumberOfMessages": 1,
                 "WaitTimeSeconds": max(wait, 0),
+                "MessageAttributeNames": ["base64encoded"],
             },
         )
         msgs = out.get("Messages") or []
@@ -165,10 +174,14 @@ class SqsSubscription(Subscription):
             return None
         m = msgs[0]
         receipt = m["ReceiptHandle"]
-        try:
-            body = base64.b64decode(m["Body"], validate=True)
-        except Exception:
-            body = m["Body"].encode()  # non-driver producer sent raw text
+        # Decode ONLY when the producer flagged the body as base64
+        # (gocloud's convention) — content sniffing would corrupt a raw
+        # text message that happens to be valid base64 (advisor r3).
+        attrs = m.get("MessageAttributes") or {}
+        if "base64encoded" in attrs:
+            body = base64.b64decode(m["Body"])
+        else:
+            body = m["Body"].encode()
 
         def ack():
             self._client.call(

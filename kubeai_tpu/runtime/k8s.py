@@ -26,7 +26,13 @@ import urllib.request
 from typing import Any, Callable
 
 from kubeai_tpu.api import model_types as mt
-from kubeai_tpu.api.core_types import KIND_CONFIGMAP, KIND_JOB, KIND_POD, KIND_PVC
+from kubeai_tpu.api.core_types import (
+    KIND_CONFIGMAP,
+    KIND_JOB,
+    KIND_POD,
+    KIND_PVC,
+    KIND_SECRET,
+)
 from kubeai_tpu.catalog import model_from_manifest
 from kubeai_tpu.runtime import k8s_manifests as enc
 from kubeai_tpu.runtime import k8s_parse as dec
@@ -49,6 +55,7 @@ _KINDS: dict[str, tuple[str, str, Callable, Callable]] = {
     KIND_JOB: ("/apis/batch/v1", "jobs", enc.job_manifest, dec.parse_job),
     KIND_PVC: ("/api/v1", "persistentvolumeclaims", enc.pvc_manifest, dec.parse_pvc),
     KIND_CONFIGMAP: ("/api/v1", "configmaps", enc.configmap_manifest, dec.parse_configmap),
+    KIND_SECRET: ("/api/v1", "secrets", enc.secret_manifest, dec.parse_secret),
 }
 
 # Internal record kinds (Lease, AutoscalerState) persist as ConfigMaps —

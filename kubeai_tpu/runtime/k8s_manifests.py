@@ -12,7 +12,7 @@ from __future__ import annotations
 
 from typing import Any
 
-from kubeai_tpu.api.core_types import PVC, ConfigMap, Container, Job, Pod, Probe
+from kubeai_tpu.api.core_types import PVC, ConfigMap, Container, Job, Pod, Probe, Secret
 from kubeai_tpu.api.model_types import Model
 
 GROUP = "kubeai.org"
@@ -201,6 +201,19 @@ def configmap_manifest(cm: ConfigMap) -> dict[str, Any]:
     }
 
 
+def secret_manifest(sec: Secret) -> dict[str, Any]:
+    # stringData: the apiserver base64-encodes into .data on write, so
+    # round-trips through a real cluster come back in .data (see
+    # parse_secret).
+    return {
+        "apiVersion": "v1",
+        "kind": "Secret",
+        "metadata": _meta(sec),
+        "type": "Opaque",
+        "stringData": dict(sec.data),
+    }
+
+
 def model_manifest(model: Model) -> dict[str, Any]:
     """Model -> kubeai.org/v1 CRD form (camelCase field names matching
     catalog.model_from_manifest's input, i.e. round-trippable)."""
@@ -266,6 +279,7 @@ MANIFEST_FNS = {
     "Job": job_manifest,
     "PersistentVolumeClaim": pvc_manifest,
     "ConfigMap": configmap_manifest,
+    "Secret": secret_manifest,
     "Model": model_manifest,
 }
 

@@ -17,6 +17,7 @@ from kubeai_tpu.api.core_types import (
     PodStatus,
     Probe,
     PVCSpec,
+    Secret,
     Volume,
     VolumeMount,
 )
@@ -175,3 +176,19 @@ def parse_pvc(doc: dict[str, Any]) -> PVC:
 
 def parse_configmap(doc: dict[str, Any]) -> ConfigMap:
     return ConfigMap(meta=parse_meta(doc), data=doc.get("data", {}) or {})
+
+
+def parse_secret(doc: dict[str, Any]) -> Secret:
+    """A real apiserver returns base64 .data; our own manifests carry
+    .stringData — accept both (stringData wins on key collision, same
+    as the apiserver's write semantics)."""
+    import base64
+
+    data: dict[str, str] = {}
+    for k, v in (doc.get("data", {}) or {}).items():
+        try:
+            data[k] = base64.b64decode(v).decode()
+        except Exception:
+            data[k] = v
+    data.update(doc.get("stringData", {}) or {})
+    return Secret(meta=parse_meta(doc), data=data)

@@ -94,6 +94,25 @@ class LocalRuntime:
             except Exception:
                 log.exception("pod event handling failed")
 
+    def _container_env(self, server, namespace: str) -> dict[str, str]:
+        """Plain env values plus the kubelet's envFrom-secretRef analogue:
+        `__envFromSecret_<name>` markers resolve against Secret objects
+        in the store (missing secrets are skipped — optional:true, same
+        as the rendered manifests)."""
+        from kubeai_tpu.api.core_types import KIND_SECRET
+
+        env: dict[str, str] = {}
+        for k, v in server.env.items():
+            if not k.startswith("__envFromSecret_"):
+                env[k] = v
+                continue
+            try:
+                sec = self.store.get(KIND_SECRET, v, namespace)
+            except NotFound:
+                continue
+            env.update(sec.data)
+        return env
+
     def _run_job(self, job):
         """Execute a Job's container to completion in a worker thread and
         record success/failure in its status (the kubelet's job controller
@@ -103,7 +122,7 @@ class LocalRuntime:
         server = job.spec.containers[0]
         cmd = list(server.command) + list(server.args)
         env = dict(os.environ)
-        env.update({k: v for k, v in server.env.items() if not k.startswith("__envFromSecret_")})
+        env.update(self._container_env(server, job.meta.namespace))
         env.update(self.extra_env)
         env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
 
@@ -144,7 +163,7 @@ class LocalRuntime:
         port = free_port()
         cmd = self._rewrite_port(cmd, port)
         env = dict(os.environ)
-        env.update({k: v for k, v in server.env.items() if not k.startswith("__envFromSecret_")})
+        env.update(self._container_env(server, pod.meta.namespace))
         env.update(self.extra_env)
         env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
         if "TPU_WORKER_HOSTNAMES" in env:

@@ -218,7 +218,14 @@ class FakeNats:
 
 class FakeRabbit:
     """Server side of the amqp_driver.py subset: handshake, channel,
-    queue declare, publish (default exchange), consume, ack/nack."""
+    queue declare, publish (default exchange), consume, ack/nack.
+
+    Proposes a deliberately small frame_max (4096) in Tune and — like
+    RabbitMQ — treats any received frame larger than that as a framing
+    violation, closing the connection. This pins the driver's publish
+    path to actually split large bodies (advisor r3)."""
+
+    FRAME_MAX = 4096
 
     def __init__(self):
         from kubeai_tpu.messenger import amqp_driver as ap
@@ -305,7 +312,13 @@ class FakeRabbit:
                                 conn, ap.FRAME_HEADER, 1,
                                 ap.Writer().u16(ap.BASIC).u16(0).u64(len(body)).u16(0).build(),
                             )
-                            ap.write_frame(conn, ap.FRAME_BODY, 1, body)
+                            # Deliveries honor frame_max too (real
+                            # brokers split exactly like publishers).
+                            step = self.FRAME_MAX - 8
+                            for off in range(0, len(body), step):
+                                ap.write_frame(
+                                    conn, ap.FRAME_BODY, 1, body[off : off + step]
+                                )
                     except OSError:
                         with self._lock:
                             self.unacked.pop((connid, tag), None)
@@ -314,6 +327,11 @@ class FakeRabbit:
 
             while True:
                 ftype, channel, payload = ap.read_frame(f)
+                if len(payload) + 8 > self.FRAME_MAX:
+                    # RabbitMQ: FRAME_ERROR — "frame too large"; the
+                    # connection is closed.
+                    conn.close()
+                    return
                 if ftype == ap.FRAME_HEARTBEAT:
                     continue
                 if ftype == ap.FRAME_HEADER:
@@ -335,7 +353,9 @@ class FakeRabbit:
                 cls, mth = r.u16(), r.u16()
                 if (cls, mth) == (ap.CONNECTION, ap.CONN_START_OK):
                     send_method(
-                        0, ap.method(ap.CONNECTION, ap.CONN_TUNE).u16(0).u32(131072).u16(0)
+                        0,
+                        ap.method(ap.CONNECTION, ap.CONN_TUNE)
+                        .u16(0).u32(self.FRAME_MAX).u16(0),
                     )
                 elif (cls, mth) == (ap.CONNECTION, ap.CONN_TUNE_OK):
                     pass

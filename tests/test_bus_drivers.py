@@ -177,6 +177,28 @@ def test_rabbit_nack_requeues(rabbit):
     topic.close()
 
 
+def test_rabbit_large_body_split_into_frames(rabbit):
+    """Advisor r3 (amqp_driver.py): a body larger than the negotiated
+    frame_max must be split into multiple BODY frames — one oversized
+    frame is a framing violation RabbitMQ answers by closing the
+    connection (the fake enforces this)."""
+    topic = open_topic("rabbit://reqs")
+    sub = open_subscription("rabbit://reqs")
+    big = bytes(range(256)) * 64  # 16 KiB >> fake's 4 KiB frame_max
+    topic.send(big)
+    m = sub.receive(timeout=5)
+    assert m.body == big
+    m.ack()
+    # The connection survived (no framing violation): a second publish
+    # still round-trips.
+    topic.send(b"after")
+    m2 = sub.receive(timeout=5)
+    assert m2.body == b"after"
+    m2.ack()
+    sub.close()
+    topic.close()
+
+
 def test_rabbit_crash_redelivers_unacked(rabbit):
     """Consumer dies with an unacked delivery -> broker requeues it for
     the next consumer (at-least-once)."""

@@ -178,6 +178,34 @@ class TestMultiHostSlice:
         pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
         assert len(pods) == 4
         assert len({p.meta.labels["slice-id"] for p in pods}) == 1
+        # The removed gang's dispatch-stream Secret went with it.
+        secrets = store.list("Secret", selector={mt.LABEL_MODEL: "m1"})
+        assert len(secrets) == 1
+        assert secrets[0].meta.labels["slice-id"] == pods[0].meta.labels["slice-id"]
+
+    def test_gang_secret_not_in_pod_spec(self, env):
+        """The gang auth token is provisioned as a Secret and referenced
+        via envFrom — pod read access must not reveal it (advisor r4)."""
+        store, _, rec = env
+        store.create(mt.KIND_MODEL, mk_model(resource_profile="tpu-v5e-4x4:1", replicas=1))
+        reconcile_until_settled(rec, "m1")
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "m1"})
+        assert pods
+        sid = pods[0].meta.labels["slice-id"]
+        secret = store.get("Secret", f"model-m1-gang-{sid}")
+        token = secret.data["KUBEAI_GANG_SECRET"]
+        assert len(token) >= 32
+        for p in pods:
+            env_map = p.spec.containers[0].env
+            assert "KUBEAI_GANG_SECRET" not in env_map
+            assert env_map[f"__envFromSecret_model-m1-gang-{sid}"] == f"model-m1-gang-{sid}"
+            # The rendered manifest carries a secretRef, not the token.
+            from kubeai_tpu.runtime.k8s_manifests import pod_manifest
+
+            doc = pod_manifest(p)
+            assert token not in str(doc)
+            c0 = doc["spec"]["containers"][0]
+            assert {"secretRef": {"name": f"model-m1-gang-{sid}", "optional": True}} in c0["envFrom"]
 
 
 class TestEngineMatrix:

@@ -17,12 +17,37 @@ from kubeai_tpu.engine.gang import GangFollower, GangPublisher
 from kubeai_tpu.engine.sampling import SamplingParams
 
 
+SECRET = "test-gang-secret"
+
+
+def connect_pair(pub, timeout=10, secret=SECRET, rank=1):
+    """Handshake needs both sides live: connect the follower in a thread
+    while the publisher accepts (production runs them as separate
+    processes)."""
+    out = {}
+
+    def _connect():
+        try:
+            out["fol"] = GangFollower(
+                "127.0.0.1", pub.port, timeout=timeout, secret=secret, rank=rank
+            )
+        except Exception as e:
+            out["err"] = e
+
+    t = threading.Thread(target=_connect, daemon=True)
+    t.start()
+    pub.accept_all(timeout=timeout)
+    t.join(timeout=timeout)
+    if "err" in out:
+        raise out["err"]
+    return out["fol"]
+
+
 @pytest.fixture()
 def pair():
     follower_eng = build_test_engine()
-    pub = GangPublisher(1, port=0, host="127.0.0.1")
-    fol = GangFollower("127.0.0.1", pub.port, timeout=10)
-    pub.accept_all(timeout=10)
+    pub = GangPublisher(1, port=0, host="127.0.0.1", secret=SECRET)
+    fol = connect_pair(pub)
     # Leader shares the follower's params/config (same init seed in a
     # real gang; literally shared arrays here).
     leader = Engine(
@@ -123,3 +148,196 @@ def test_reset_op_reinitializes_follower(pair):
     leader._publisher.publish("reset")
     zeros = np.zeros_like(want)
     np.testing.assert_array_equal(_sync(lambda: follower._lengths, zeros), zeros)
+
+
+class TestHandshake:
+    """Advisor r3 (gang.py): the gang port must not hand the dispatch
+    stream (prompt tokens, adapter paths) to any reachable peer, and an
+    unauthenticated connection must not consume a follower slot."""
+
+    def test_wrong_secret_rejected_and_real_follower_still_joins(self):
+        pub = GangPublisher(1, port=0, host="127.0.0.1", secret=SECRET)
+        results = {}
+
+        def imposter():
+            try:
+                GangFollower(
+                    "127.0.0.1", pub.port, timeout=5,
+                    secret="wrong-secret", rank=1,
+                )
+                results["imposter"] = "joined"
+            except Exception as e:
+                results["imposter"] = e
+
+        t_imp = threading.Thread(target=imposter, daemon=True)
+        t_imp.start()
+        # The real follower joins AFTER the imposter attempted: the
+        # rejected connection must not have consumed the slot.
+        fol = connect_pair(pub, timeout=15)
+        # The imposter's retry loop runs out its deadline (rejected, it
+        # reconnects into the backlog where nothing accepts it).
+        t_imp.join(timeout=30)
+        assert not t_imp.is_alive(), "imposter attempt did not conclude"
+        # The imposter is either rejected by MAC (publisher closes) or
+        # fails its own counter-proof check; it never "joins".
+        assert results["imposter"] != "joined"
+        assert len(pub._ranks) == 1 and 1 in pub._ranks
+        fol.close()
+        pub.close()
+
+    def test_raw_tcp_connect_gets_no_dispatch_stream(self):
+        """A peer that connects but never completes the handshake is
+        dropped; publish() reaches only authenticated members."""
+        import socket as _socket
+
+        pub = GangPublisher(1, port=0, host="127.0.0.1", secret=SECRET)
+        eavesdropper = _socket.create_connection(("127.0.0.1", pub.port), timeout=15)
+
+        def eavesdrop():
+            # Receives the challenge once accept_all picks the conn up,
+            # then answers with garbage instead of a MAC.
+            eavesdropper.recv(16)
+            eavesdropper.sendall(b"\x00" * 36)
+
+        t_eve = threading.Thread(target=eavesdrop, daemon=True)
+        t_eve.start()
+        fol = connect_pair(pub, timeout=15)
+        t_eve.join(timeout=10)
+        pub.publish("decode", {"x": 1}, {"a": np.arange(3, dtype=np.int32)})
+        op, sc, ar = fol.recv()
+        assert op == "decode" and sc == {"x": 1}
+        # The rejected socket sees EOF (closed by the publisher), not ops.
+        eavesdropper.settimeout(5)
+        assert eavesdropper.recv(4096) == b""
+        eavesdropper.close()
+        fol.close()
+        pub.close()
+
+    def test_duplicate_rank_rejected(self):
+        """The acceptor must reject a correctly-MAC'd connection whose
+        rank is already a member (a displacement attack) and out-of-range
+        ranks — while still completing the gang with the legit ranks."""
+        import socket as _socket
+        import struct as _struct
+
+        from kubeai_tpu.engine.gang import _TAG_FOLLOWER, _mac
+
+        pub = GangPublisher(2, port=0, host="127.0.0.1", secret=SECRET)
+
+        def attempt(rank):
+            """Hand-rolled follower handshake; returns the publisher's
+            32-byte counter-proof, or b'' if the publisher rejected
+            (closed) the connection."""
+            s = _socket.create_connection(("127.0.0.1", pub.port), timeout=10)
+            s.settimeout(10)
+            try:
+                ch = s.recv(16)
+                s.sendall(
+                    _struct.pack(">I", rank)
+                    + _mac(SECRET.encode(), _TAG_FOLLOWER, ch, rank)
+                )
+                try:
+                    return s.recv(32), s
+                except OSError:
+                    return b"", s
+            except OSError:
+                return b"", s
+
+        proof1, s1 = attempt(1)
+        assert len(proof1) == 32  # first rank-1 join succeeds
+        deadline = time.monotonic() + 10
+        while 1 not in pub._ranks and time.monotonic() < deadline:
+            time.sleep(0.05)
+        assert 1 in pub._ranks
+
+        dup_proof, s_dup = attempt(1)  # same rank again: closed, no proof
+        assert dup_proof == b""
+        bad_proof, s_bad = attempt(7)  # out-of-range rank: closed
+        assert bad_proof == b""
+
+        proof2, s2 = attempt(2)  # the gang still completes
+        assert len(proof2) == 32
+        pub.accept_all(timeout=10)
+        assert set(pub._ranks) == {1, 2}
+        for s in (s1, s_dup, s_bad, s2):
+            s.close()
+        pub.close()
+
+    def test_accept_all_times_out(self):
+        """accept_all must raise when the gang never assembles — the
+        controller relies on the pod failing to recycle a stuck gang."""
+        pub = GangPublisher(1, port=0, host="127.0.0.1", secret=SECRET)
+        with pytest.raises(TimeoutError):
+            pub.accept_all(timeout=1.0)
+        pub.close()
+
+    def test_missing_secret_is_an_error(self):
+        with pytest.raises(ValueError):
+            GangPublisher(1, port=0, host="127.0.0.1", secret="")
+        with pytest.raises(ValueError):
+            GangFollower("127.0.0.1", 1, timeout=1, secret="", rank=1)
+
+
+class TestDesyncFatal:
+    """Advisor r3 (core.py): after a successful broadcast, a rank-0-only
+    dispatch failure means the followers replayed an op rank 0 never
+    executed — reset recovery would hang the gang in collectives, so the
+    rank must fail in-flight requests and terminate instead."""
+
+    def test_post_broadcast_failure_terminates_rank(self, pair, monkeypatch):
+        leader, follower, _ = pair
+        calls = {}
+
+        def fake_terminate(message, code):
+            calls["msg"] = message
+            calls["code"] = code
+            leader._fail_inflight(message)
+            # Don't _exit (we're pytest); stop the loop like death would.
+            leader._running = False
+
+        monkeypatch.setattr(leader, "_terminate_rank", fake_terminate)
+        real_decode = leader._decode_jit
+
+        def exploding_decode(*a, **kw):
+            raise RuntimeError("simulated rank-0-only dispatch failure")
+
+        # Warm up first so the engine is mid-steady-state.
+        leader.generate([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=2), timeout=120)
+        monkeypatch.setattr(leader, "_decode_jit", exploding_decode)
+        req = leader.submit([4, 5, 6], SamplingParams(temperature=0.0, max_tokens=4))
+        deadline = time.monotonic() + 30
+        ev = None
+        while time.monotonic() < deadline:
+            try:
+                ev = req.out.get(timeout=5)
+            except Exception:
+                break
+            if ev[0] in ("error", "done"):
+                break
+        assert ev is not None and ev[0] == "error", f"expected error event, got {ev}"
+        assert calls.get("code") == 14, "desync must take the fatal path, not reset recovery"
+        monkeypatch.setattr(leader, "_decode_jit", real_decode)
+
+    def test_single_host_failure_still_resets(self):
+        """Without a publisher the same failure stays recoverable: reset,
+        error in-flight, keep serving."""
+        eng = build_test_engine(seed=7)
+        eng.start()
+        eng.generate([1, 2, 3], SamplingParams(temperature=0.0, max_tokens=2), timeout=120)
+        real = eng._decode_jit
+        state = {"n": 0}
+
+        def explode_once(*a, **kw):
+            if state["n"] == 0:
+                state["n"] = 1
+                raise RuntimeError("transient device error")
+            return real(*a, **kw)
+
+        eng._decode_jit = explode_once
+        req = eng.submit([4, 5], SamplingParams(temperature=0.0, max_tokens=3))
+        ev = req.out.get(timeout=60)
+        assert ev[0] == "error"
+        # Engine recovered: a fresh request serves fine.
+        ids, _, fin = eng.generate([6, 7], SamplingParams(temperature=0.0, max_tokens=3), timeout=120)
+        assert len(ids) == 3
+        eng.stop()
