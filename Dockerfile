@@ -1,0 +1,35 @@
+# Operator (manager) image — the control plane only: controller, proxy,
+# autoscaler, load balancer, messenger, KubeStore. Stdlib-only Python, no
+# ML deps (the engine ships separately in Dockerfile.engine).
+# Parity: the reference's multi-stage manager build (ref: Dockerfile:1-33,
+# Go builder -> distroless); here the "build" stage compiles the native
+# fasthash extension and byte-compiles the package so the runtime stage
+# needs no compiler and runs as nonroot.
+#
+#   docker build -t kubeai-tpu/operator:latest .
+
+FROM python:3.12-slim AS builder
+RUN apt-get update && apt-get install -y --no-install-recommends g++ \
+    && rm -rf /var/lib/apt/lists/*
+WORKDIR /src
+COPY pyproject.toml README.md ./
+COPY kubeai_tpu/ kubeai_tpu/
+RUN pip install --no-cache-dir --prefix=/install .
+# Pre-build the C++ fasthash extension; runtime falls back to pure
+# Python if the .so is absent, so failure here degrades perf only.
+COPY native/ /app/native/
+RUN KUBEAI_NATIVE_DIR=/app/native KUBEAI_BUILD_DIR=/app/build \
+    PYTHONPATH=/src python -c "from kubeai_tpu.utils.native import load; load()" || true
+RUN python -m compileall -q /install
+
+FROM python:3.12-slim
+WORKDIR /app
+COPY --from=builder /install /usr/local
+COPY --from=builder /app/native/ /app/native/
+COPY --from=builder /app/build/ /app/build/
+# CRDs for `kubectl apply`-from-image workflows.
+COPY deploy/crds/ /app/deploy/crds/
+ENV KUBEAI_NATIVE_DIR=/app/native KUBEAI_BUILD_DIR=/app/build
+USER 65532:65532
+EXPOSE 8000 8080
+ENTRYPOINT ["python", "-m", "kubeai_tpu.manager"]

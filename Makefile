@@ -1,8 +1,10 @@
 # Dev ergonomics (cf. the reference's Makefile targets).
 
 PY ?= python
+DOCKER ?= docker
+TAG ?= latest
 
-.PHONY: test test-fast bench bench-tiny dryrun loadgen-demo native clean charts
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny dryrun loadgen-demo native clean charts images images-check
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -31,3 +33,23 @@ charts: ## Render both Helm charts to build/manifests (helm-less template check)
 	mkdir -p build/manifests
 	$(PY) -m kubeai_tpu.utils.helmlite template charts/kubeai-tpu > build/manifests/operator.yaml
 	$(PY) -m kubeai_tpu.utils.helmlite template charts/models > build/manifests/models.yaml
+
+test-unit:  ## the fast tier (no engine e2e, no multi-process gangs)
+	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_local.py \
+	    --ignore=tests/test_e2e_chaos.py --ignore=tests/test_e2e_gang.py \
+	    --ignore=tests/test_finetune.py --ignore=tests/test_gang_protocol.py
+
+test-k8s:  ## replay the control-plane integration tests against a REAL cluster
+	@# Usage: make test-k8s KUBECONFIG=~/.kube/config
+	@# Spawns `kubectl proxy`, applies deploy/crds/, points KubeStore at it.
+	@# Closes the env-blocked real-apiserver gap the day a cluster exists
+	@# (ref: test/integration/main_test.go:77-114 is the envtest model).
+	KUBEAI_K8S_TEST=1 $(PY) -m pytest tests/test_k8s_real.py -q -x
+
+images: ## build operator, engine, and model-loader images
+	$(DOCKER) build -t kubeai-tpu/operator:$(TAG) .
+	$(DOCKER) build -f Dockerfile.engine -t kubeai-tpu/engine:$(TAG) .
+	$(DOCKER) build -f components/model-loader/Dockerfile -t kubeai-tpu/model-loader:$(TAG) .
+
+images-check: ## daemonless sanity: Dockerfiles reference only files that exist
+	$(PY) tests/check_dockerfiles.py

@@ -1,8 +1,11 @@
 """Lease-based leader election.
 
 Parity: internal/leader/election.go:16-86 — a coordination lease object
-(here: a ConfigMap-like Lease record in the store) renewed on an
-interval; `is_leader` is the atomic flag the autoscaler gates on.
+renewed on an interval; `is_leader` is the atomic flag the autoscaler
+gates on. Against a real apiserver (KubeStore) the Lease persists as an
+actual coordination.k8s.io/v1 Lease (matching the RBAC grant); the
+in-memory store holds it as a plain record. CAS semantics come from the
+store's resourceVersion conflict check either way.
 """
 
 from __future__ import annotations

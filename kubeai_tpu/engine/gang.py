@@ -129,7 +129,15 @@ class GangPublisher:
     token ids, sampling params and adapter paths, and an unauthenticated
     accept would both leak that stream to any reachable peer AND let it
     displace a real follower so the gang never assembles. The secret is
-    provisioned by the controller per slice gang (KUBEAI_GANG_SECRET)."""
+    provisioned by the controller per slice gang (KUBEAI_GANG_SECRET).
+
+    Mutual freshness: the publisher picks the challenge AND the follower
+    contributes its own nonce, and both are bound into both MACs — with
+    only a publisher challenge, an on-path attacker who captured one
+    prior handshake could replay the (challenge, publisher-proof) pair
+    to impersonate rank 0 (advisor r4). The stream itself is plaintext
+    TCP: confidentiality relies on slice-local network policy, the
+    handshake only authenticates the endpoints."""
 
     _HANDSHAKE_BUDGET = 10.0  # total seconds per connection attempt
 
@@ -161,30 +169,35 @@ class GangPublisher:
         )
         self._acceptor.start()
 
-    def _handshake(self, conn: socket.socket, addr) -> int:
+    def _handshake(self, conn: socket.socket, addr) -> tuple[int, bytes]:
         """Challenge-response on a fresh connection; returns the proven
-        follower rank. Raises GangAuthError on any mismatch. The WHOLE
-        exchange shares one deadline — per-recv timeouts would let a
-        peer drip-feed bytes and stall gang assembly indefinitely."""
+        follower rank and the session transcript (challenge + follower
+        nonce) the counter-proof must cover. Raises GangAuthError on any
+        mismatch. The WHOLE exchange shares one deadline — per-recv
+        timeouts would let a peer drip-feed bytes and stall gang
+        assembly indefinitely. The counter-proof is NOT sent here: it
+        goes out only after registration succeeds under the lock
+        (_handshake_and_register), so a follower that loses the
+        duplicate-rank race sees EOF during its handshake instead of a
+        false success followed by a late GangLost (advisor r4)."""
         deadline = time.monotonic() + self._HANDSHAKE_BUDGET
         challenge = os.urandom(_CHALLENGE_LEN)
         conn.sendall(challenge)
         try:
-            buf = _read_exact_sock(conn, 4 + _MAC_LEN, deadline=deadline)
+            buf = _read_exact_sock(conn, 4 + _CHALLENGE_LEN + _MAC_LEN, deadline=deadline)
         except (ConnectionError, socket.timeout) as e:
             raise GangAuthError(f"{addr}: {e}") from e
         (rank,) = struct.unpack(">I", buf[:4])
-        want = _mac(self._secret, _TAG_FOLLOWER, challenge, rank)
-        if not hmac.compare_digest(buf[4:], want):
+        nonce = buf[4 : 4 + _CHALLENGE_LEN]
+        transcript = challenge + nonce
+        want = _mac(self._secret, _TAG_FOLLOWER, transcript, rank)
+        if not hmac.compare_digest(buf[4 + _CHALLENGE_LEN :], want):
             raise GangAuthError(f"{addr}: bad handshake MAC")
         if not (1 <= rank <= self.n_followers):
             raise GangAuthError(f"{addr}: rank {rank} out of range")
         if rank in self._ranks:
             raise GangAuthError(f"{addr}: duplicate rank {rank}")
-        # Prove the publisher knows the secret too (mutual: a follower
-        # must not replay its dispatch stream for an impostor rank 0).
-        conn.sendall(_mac(self._secret, _TAG_PUBLISHER, challenge, rank))
-        return rank
+        return rank, transcript
 
     def _accept_loop(self) -> None:
         """Accept until the gang is assembled (or the server socket
@@ -208,7 +221,7 @@ class GangPublisher:
 
     def _handshake_and_register(self, conn: socket.socket, addr) -> None:
         try:
-            rank = self._handshake(conn, addr)
+            rank, transcript = self._handshake(conn, addr)
             conn.settimeout(None)
         except (GangAuthError, OSError) as e:
             log.warning("rejecting gang connection from %s: %s", addr, e)
@@ -233,6 +246,23 @@ class GangPublisher:
             self._ranks[rank] = conn
             self._conns.append(conn)
             n = len(self._ranks)
+        # Registration won the race — NOW prove the publisher knows the
+        # secret (mutual: a follower must not replay its dispatch stream
+        # for an impostor rank 0). A rejected racer above saw EOF instead.
+        try:
+            conn.sendall(_mac(self._secret, _TAG_PUBLISHER, transcript, rank))
+        except OSError as e:
+            log.warning("gang follower rank %d from %s died mid-handshake: %s",
+                        rank, addr, e)
+            with self._lock:  # roll back so the rank can reconnect
+                if self._ranks.get(rank) is conn:
+                    del self._ranks[rank]
+                    self._conns.remove(conn)
+            try:
+                conn.close()
+            except OSError:
+                pass
+            return
         log.info(
             "gang follower rank %d (%d/%d) authenticated from %s",
             rank, n, self.n_followers, addr,
@@ -297,12 +327,19 @@ class GangFollower:
                 self._sock = socket.create_connection((host, port), timeout=10)
                 self._sock.settimeout(10)
                 challenge = _read_exact_sock(self._sock, _CHALLENGE_LEN)
+                # Contribute our own nonce so the publisher's counter-
+                # proof is fresh per-connection (replay of a captured
+                # (challenge, proof) pair can't impersonate rank 0).
+                nonce = os.urandom(_CHALLENGE_LEN)
+                transcript = challenge + nonce
                 self._sock.sendall(
-                    struct.pack(">I", rank) + _mac(sec, _TAG_FOLLOWER, challenge, rank)
+                    struct.pack(">I", rank)
+                    + nonce
+                    + _mac(sec, _TAG_FOLLOWER, transcript, rank)
                 )
                 proof = _read_exact_sock(self._sock, _MAC_LEN)
                 if not hmac.compare_digest(
-                    proof, _mac(sec, _TAG_PUBLISHER, challenge, rank)
+                    proof, _mac(sec, _TAG_PUBLISHER, transcript, rank)
                 ):
                     raise GangAuthError(
                         f"publisher {host}:{port} failed counter-proof "

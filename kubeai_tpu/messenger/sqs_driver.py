@@ -144,8 +144,22 @@ class SqsTopic(Topic):
         # would be unreadable to reference consumers.
         payload: dict = {"QueueUrl": self._client.queue_url}
         try:
-            payload["MessageBody"] = body.decode("utf-8")
+            text = body.decode("utf-8")
         except UnicodeDecodeError:
+            text = None
+        # SQS rejects bodies with valid-UTF-8 characters outside its
+        # permitted set (#x9 #xA #xD #x20-#xD7FF #xE000-#xFFFD
+        # #x10000-#x10FFFF) with InvalidMessageContents — treat those
+        # like binary too, not just undecodable bytes.
+        if text is not None and all(
+            c in "\t\n\r"
+            or 0x20 <= ord(c) <= 0xD7FF
+            or 0xE000 <= ord(c) <= 0xFFFD
+            or 0x10000 <= ord(c) <= 0x10FFFF
+            for c in text
+        ):
+            payload["MessageBody"] = text
+        else:
             payload["MessageBody"] = base64.b64encode(body).decode()
             payload["MessageAttributes"] = {
                 "base64encoded": {"DataType": "String", "StringValue": "true"}

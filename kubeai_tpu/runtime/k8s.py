@@ -4,8 +4,10 @@ The control plane (controller, LB, autoscaler, cache, adapters) programs
 against the Store surface; this adapter maps it onto the Kubernetes REST
 API so the exact same components run in-cluster (the reference's
 controller-runtime role). Models are stored as the kubeai.org/v1 CRD
-(deploy/crds/), workloads as core/v1 + batch/v1 objects, the autoscaler
-state and leases as ConfigMap-backed records.
+(deploy/crds/), workloads as core/v1 + batch/v1 objects, leader leases
+as real coordination.k8s.io/v1 Lease objects (matching the RBAC grant
+and the reference, ref: internal/leader/election.go:16-64), and the
+autoscaler state as a ConfigMap-backed record.
 
 Transport is stdlib urllib against the in-cluster endpoint (service
 account bearer token + CA bundle); watches use the apiserver's streaming
@@ -48,6 +50,65 @@ log = logging.getLogger("kubeai_tpu.kubestore")
 
 SA_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
 
+def _ts_encode(t: float) -> str | None:
+    """Epoch seconds -> k8s MicroTime (RFC3339, micros)."""
+    import datetime
+
+    if not t:
+        return None
+    return datetime.datetime.fromtimestamp(t, datetime.timezone.utc).strftime(
+        "%Y-%m-%dT%H:%M:%S.%fZ"
+    )
+
+
+def _ts_decode(s: str | None) -> float:
+    import datetime
+
+    if not s:
+        return 0.0
+    return datetime.datetime.strptime(s, "%Y-%m-%dT%H:%M:%S.%fZ").replace(
+        tzinfo=datetime.timezone.utc
+    ).timestamp()
+
+
+def _lease_manifest(lease: Any) -> dict:
+    """Election's Lease record as a real coordination.k8s.io/v1 Lease
+    (the shape the reference's leaderelection library reads/writes,
+    ref: internal/leader/election.go:16-64)."""
+    spec: dict[str, Any] = {
+        "leaseDurationSeconds": int(lease.duration_seconds),
+    }
+    if lease.holder:
+        spec["holderIdentity"] = lease.holder
+    rt = _ts_encode(lease.renew_time)
+    if rt:
+        spec["renewTime"] = rt
+    doc = {
+        "apiVersion": "coordination.k8s.io/v1",
+        "kind": "Lease",
+        "metadata": {
+            "name": lease.meta.name,
+            "namespace": lease.meta.namespace,
+            "labels": dict(lease.meta.labels or {}),
+        },
+        "spec": spec,
+    }
+    return doc
+
+
+def _parse_lease(doc: dict) -> Any:
+    from kubeai_tpu.autoscaler.leader import Lease
+
+    meta = dec.parse_meta(doc)
+    spec = doc.get("spec") or {}
+    return Lease(
+        meta=meta,
+        holder=spec.get("holderIdentity") or "",
+        renew_time=_ts_decode(spec.get("renewTime")),
+        duration_seconds=float(spec.get("leaseDurationSeconds") or 15.0),
+    )
+
+
 # kind -> (api prefix, plural, encoder, decoder)
 _KINDS: dict[str, tuple[str, str, Callable, Callable]] = {
     mt.KIND_MODEL: ("/apis/kubeai.org/v1", "models", enc.model_manifest, model_from_manifest),
@@ -56,17 +117,18 @@ _KINDS: dict[str, tuple[str, str, Callable, Callable]] = {
     KIND_PVC: ("/api/v1", "persistentvolumeclaims", enc.pvc_manifest, dec.parse_pvc),
     KIND_CONFIGMAP: ("/api/v1", "configmaps", enc.configmap_manifest, dec.parse_configmap),
     KIND_SECRET: ("/api/v1", "secrets", enc.secret_manifest, dec.parse_secret),
+    "Lease": ("/apis/coordination.k8s.io/v1", "leases", _lease_manifest, _parse_lease),
 }
 
-# Internal record kinds (Lease, AutoscalerState) persist as ConfigMaps —
-# the reference stores autoscaler state the same way (ref:
-# internal/modelautoscaler/state.go) and leases via coordination/v1.
+# Internal record kinds (AutoscalerState) persist as ConfigMaps — the
+# reference stores autoscaler state the same way (ref:
+# internal/modelautoscaler/state.go). Leases are NOT records: they are
+# real coordination/v1 objects (_KINDS above), matching the RBAC grant.
 RECORD_LABEL = "records.kubeai.org/kind"
 
 
 def _record_types() -> dict[str, Callable[[dict], Any]]:
     from kubeai_tpu.autoscaler.autoscaler import AutoscalerState
-    from kubeai_tpu.autoscaler.leader import Lease
     from kubeai_tpu.runtime.store import ObjectMeta
 
     def build(cls):
@@ -76,7 +138,7 @@ def _record_types() -> dict[str, Callable[[dict], Any]]:
 
         return decode
 
-    return {"Lease": build(Lease), "AutoscalerState": build(AutoscalerState)}
+    return {"AutoscalerState": build(AutoscalerState)}
 
 
 class KubeStore:

@@ -30,10 +30,23 @@ def load() -> ctypes.CDLL | None:
             return _lib
         _tried = True
         root = _repo_root()
-        src = os.path.join(root, "native", "fasthash.cc")
-        if not os.path.exists(src):
+        # Source search order: explicit override (container images place
+        # sources outside any repo checkout), then the repo layout.
+        candidates = [
+            os.environ.get("KUBEAI_NATIVE_DIR"),
+            os.path.join(root, "native"),
+        ]
+        src = next(
+            (
+                os.path.join(d, "fasthash.cc")
+                for d in candidates
+                if d and os.path.exists(os.path.join(d, "fasthash.cc"))
+            ),
+            None,
+        )
+        if src is None:
             return None
-        build_dir = os.path.join(root, "build")
+        build_dir = os.environ.get("KUBEAI_BUILD_DIR") or os.path.join(root, "build")
         so_path = os.path.join(build_dir, "libfasthash.so")
         try:
             if not os.path.exists(so_path) or os.path.getmtime(so_path) < os.path.getmtime(src):

@@ -72,6 +72,7 @@ class FakeSQS:
                     raise _SqsError(500, "InternalError", "injected send failure")
                 self._q(p["QueueUrl"]).append(
                     {"Body": p["MessageBody"], "MessageId": uuid.uuid4().hex,
+                     "Attributes": p.get("MessageAttributes") or {},
                      "visible_at": 0.0, "receipt": None}
                 )
                 return {"MessageId": "m", "MD5OfMessageBody": ""}
@@ -86,10 +87,18 @@ class FakeSQS:
                         if m["visible_at"] <= now:
                             m["visible_at"] = now + self.visibility
                             m["receipt"] = uuid.uuid4().hex
-                            return {"Messages": [
-                                {"Body": m["Body"], "MessageId": m["MessageId"],
-                                 "ReceiptHandle": m["receipt"]}
-                            ]}
+                            out = {"Body": m["Body"], "MessageId": m["MessageId"],
+                                   "ReceiptHandle": m["receipt"]}
+                            # Real SQS only returns attributes the caller
+                            # asked for via MessageAttributeNames.
+                            wanted = p.get("MessageAttributeNames") or []
+                            attrs = {
+                                k: v for k, v in m["Attributes"].items()
+                                if "All" in wanted or k in wanted
+                            }
+                            if attrs:
+                                out["MessageAttributes"] = attrs
+                            return {"Messages": [out]}
                     if time.monotonic() >= deadline:
                         return {}
                     self._lock.release()

@@ -41,6 +41,24 @@ def test_sqs_roundtrip_ack(sqs):
     assert sqs.queues["reqs"] == []
 
 
+def test_sqs_control_chars_roundtrip(sqs):
+    """Valid-UTF-8 control chars are outside SQS's permitted character
+    ranges (InvalidMessageContents on real AWS) — the driver must base64
+    them like binary, and plain text must stay raw on the wire."""
+    topic = open_topic(SQS_URL)
+    sub = open_subscription(SQS_URL)
+    topic.send(b"ctrl \x00\x08 chars")  # decodes as UTF-8 but SQS-illegal
+    m = sub.receive(timeout=5)
+    assert m.body == b"ctrl \x00\x08 chars"
+    m.ack()
+    topic.send(b"plain text")
+    m2 = sub.receive(timeout=5)
+    assert m2.body == b"plain text"
+    # raw on the wire: reference (gocloud) consumers read it unencoded
+    assert sqs.queues["reqs"][0]["Body"] == "plain text"
+    m2.ack()
+
+
 def test_sqs_nack_redelivers_immediately(sqs):
     topic = open_topic(SQS_URL)
     sub = open_subscription(SQS_URL)

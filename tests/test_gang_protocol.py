@@ -197,7 +197,7 @@ class TestHandshake:
             # Receives the challenge once accept_all picks the conn up,
             # then answers with garbage instead of a MAC.
             eavesdropper.recv(16)
-            eavesdropper.sendall(b"\x00" * 36)
+            eavesdropper.sendall(b"\x00" * 52)  # rank + nonce + bogus MAC
 
         t_eve = threading.Thread(target=eavesdrop, daemon=True)
         t_eve.start()
@@ -232,9 +232,11 @@ class TestHandshake:
             s.settimeout(10)
             try:
                 ch = s.recv(16)
+                nonce = b"\x42" * 16
                 s.sendall(
                     _struct.pack(">I", rank)
-                    + _mac(SECRET.encode(), _TAG_FOLLOWER, ch, rank)
+                    + nonce
+                    + _mac(SECRET.encode(), _TAG_FOLLOWER, ch + nonce, rank)
                 )
                 try:
                     return s.recv(32), s

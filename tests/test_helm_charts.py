@@ -27,11 +27,11 @@ def by_kind(docs, kind):
 def test_operator_chart_renders_all_kinds(rendered):
     kinds = sorted({d["kind"] for d in rendered})
     assert kinds == [
-        "ClusterRole",
-        "ClusterRoleBinding",
         "ConfigMap",
         "CustomResourceDefinition",
         "Deployment",
+        "Role",
+        "RoleBinding",
         "Secret",
         "Service",
         "ServiceAccount",
@@ -77,8 +77,8 @@ def test_deployment_matches_operator_manifest(rendered):
     assert c["env"][0]["name"] == "CONFIG_PATH"
     assert dep["spec"]["replicas"] == plain_dep["spec"]["replicas"]
     # RBAC rule parity.
-    role = by_kind(rendered, "ClusterRole")[0]
-    assert role["rules"] == plain["ClusterRole"]["rules"]
+    role = by_kind(rendered, "Role")[0]
+    assert role["rules"] == plain["Role"]["rules"]
 
 
 def test_values_overrides_flow_through():

@@ -1,0 +1,147 @@
+"""Control-plane integration tests against a REAL kube-apiserver.
+
+The FakeAPIServer suite (tests/test_kubestore.py) pins KubeStore's REST
+semantics; this module replays the same behaviors against an actual
+cluster the day one exists — the env here has no k3s/kwok/kind binary,
+so these are opt-in (VERDICT r3 next-step #6; the reference's envtest
+tier is the model, ref: test/integration/main_test.go:77-114).
+
+Run:  make test-k8s KUBECONFIG=~/.kube/config
+(or)  KUBEAI_K8S_TEST=1 pytest tests/test_k8s_real.py -q
+
+Requires: kubectl on PATH, cluster-admin enough to apply the CRD.
+Everything runs in a throwaway namespace that is deleted afterwards.
+"""
+
+from __future__ import annotations
+
+import os
+import re
+import subprocess
+import time
+import uuid
+
+import pytest
+
+pytestmark = pytest.mark.skipif(
+    os.environ.get("KUBEAI_K8S_TEST") != "1",
+    reason="real-cluster tests are opt-in: make test-k8s KUBECONFIG=...",
+)
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(scope="module")
+def apiserver():
+    """`kubectl proxy` on an ephemeral port — KubeStore speaks plain
+    HTTP to it and the proxy injects the kubeconfig's auth."""
+    proc = subprocess.Popen(
+        ["kubectl", "proxy", "--port=0"],
+        stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT,
+        text=True,
+    )
+    line = proc.stdout.readline()
+    m = re.search(r"127\.0\.0\.1:(\d+)", line)
+    if not m:
+        proc.terminate()
+        pytest.skip(f"kubectl proxy did not start: {line!r}")
+    url = f"http://127.0.0.1:{m.group(1)}"
+    subprocess.run(
+        ["kubectl", "apply", "-f", os.path.join(ROOT, "deploy", "crds")],
+        check=True,
+    )
+    yield url
+    proc.terminate()
+
+
+@pytest.fixture()
+def ns(apiserver):
+    name = f"kubeai-test-{uuid.uuid4().hex[:8]}"
+    subprocess.run(["kubectl", "create", "namespace", name], check=True)
+    yield name
+    subprocess.run(
+        ["kubectl", "delete", "namespace", name, "--wait=false"], check=False
+    )
+
+
+@pytest.fixture()
+def store(apiserver, ns):
+    from kubeai_tpu.runtime.k8s import KubeStore
+
+    s = KubeStore(api_server=apiserver, token="", namespace=ns)
+    yield s
+    s.close()
+
+
+def test_model_crud_against_real_apiserver(store, ns):
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.model_types import Model, ModelSpec
+    from kubeai_tpu.runtime.store import AlreadyExists, Conflict, NotFound, ObjectMeta
+
+    m = Model(
+        meta=ObjectMeta(name="it-m1", namespace=ns),
+        spec=ModelSpec(url="hf://a/b", resource_profile="tpu-v5e-1x1:1", min_replicas=1),
+    )
+    store.create(mt.KIND_MODEL, m)
+    with pytest.raises(AlreadyExists):
+        store.create(mt.KIND_MODEL, m)
+    got = store.get(mt.KIND_MODEL, "it-m1", ns)
+    assert got.spec.url == "hf://a/b"
+    # Real optimistic concurrency: a stale update must 409.
+    stale = store.get(mt.KIND_MODEL, "it-m1", ns)
+    store.mutate(mt.KIND_MODEL, "it-m1", lambda o: setattr(o.spec, "min_replicas", 2), ns)
+    stale.spec.min_replicas = 9
+    with pytest.raises(Conflict):
+        store.update(mt.KIND_MODEL, stale)
+    store.delete(mt.KIND_MODEL, "it-m1", ns)
+    with pytest.raises(NotFound):
+        store.get(mt.KIND_MODEL, "it-m1", ns)
+
+
+def test_lease_contention_against_real_apiserver(apiserver, ns):
+    from kubeai_tpu.autoscaler.leader import Election
+    from kubeai_tpu.runtime.k8s import KubeStore
+
+    sa = KubeStore(api_server=apiserver, token="", namespace=ns)
+    sb = KubeStore(api_server=apiserver, token="", namespace=ns)
+    a = Election(sa, identity="op-a", duration=2.0, namespace=ns)
+    b = Election(sb, identity="op-b", duration=2.0, namespace=ns)
+    a.start()
+    b.start()
+    try:
+        deadline = time.time() + 20
+        while time.time() < deadline and not (a.is_leader.is_set() or b.is_leader.is_set()):
+            time.sleep(0.1)
+        for _ in range(10):
+            assert not (a.is_leader.is_set() and b.is_leader.is_set())
+            time.sleep(0.1)
+        assert a.is_leader.is_set() != b.is_leader.is_set()
+    finally:
+        a.stop()
+        b.stop()
+        sa.close()
+        sb.close()
+
+
+def test_watch_stream_against_real_apiserver(store, ns):
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.model_types import Model, ModelSpec
+    from kubeai_tpu.runtime.store import ObjectMeta
+
+    q = store.watch(mt.KIND_MODEL)
+    store.create(
+        mt.KIND_MODEL,
+        Model(meta=ObjectMeta(name="it-w1", namespace=ns), spec=ModelSpec(url="hf://x/y")),
+    )
+    deadline = time.time() + 20
+    seen = []
+    while time.time() < deadline:
+        try:
+            ev = q.get(timeout=1.0)
+        except Exception:
+            continue
+        seen.append(ev)
+        if any(getattr(e.obj.meta, "name", "") == "it-w1" for e in seen):
+            break
+    assert any(getattr(e.obj.meta, "name", "") == "it-w1" for e in seen)
