@@ -121,6 +121,10 @@ class EngineConfig:
     # byte-identical to non-speculative; sampled (temperature>0) slots
     # never accept drafts and behave exactly as before. 0 disables.
     speculate_tokens: int = 0
+    # KV pool storage dtype override: "" keeps ModelConfig's choice,
+    # "fp8"/"int8" quantize the paged pool (see ModelConfig.kv_cache_dtype
+    # — halves KV HBM, doubling the slot ceiling on a 16GB chip).
+    kv_cache_dtype: str = ""
 
 
 @dataclass
@@ -174,6 +178,12 @@ class Engine:
         publisher=None,
     ):
         self.cfg = engine_config or EngineConfig()
+        if self.cfg.kv_cache_dtype:
+            import dataclasses as _dc
+
+            model_config = _dc.replace(
+                model_config, kv_cache_dtype=self.cfg.kv_cache_dtype
+            )
         self.model_config = model_config
         self.params = params
         self.tokenizer = tokenizer

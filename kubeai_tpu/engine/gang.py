@@ -149,6 +149,13 @@ class GangPublisher:
         self._lock = threading.Lock()
         self._conns: list[socket.socket] = []
         self._ranks: dict[int, socket.socket] = {}
+        # Ranks whose counter-proof send SUCCEEDED. Assembly counts this
+        # set, not _ranks: a registered rank whose proof send fails is
+        # rolled back, and counting it would let a concurrent
+        # registration declare the gang assembled with a member that is
+        # about to vanish — permanently locking that rank's reconnect
+        # out behind the assembled check (advisor r5).
+        self._proven: set[int] = set()
         self._assembled = threading.Event()
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
@@ -267,8 +274,15 @@ class GangPublisher:
             "gang follower rank %d (%d/%d) authenticated from %s",
             rank, n, self.n_followers, addr,
         )
-        if n >= self.n_followers:
-            self._assembled.set()
+        # Assembly counts only PROVEN ranks (see _proven): this rank's
+        # proof send just succeeded, so it is live from the follower's
+        # point of view too — a registered-but-unproven member that gets
+        # rolled back must never have been counted toward assembly.
+        with self._lock:
+            if self._ranks.get(rank) is conn:
+                self._proven.add(rank)
+                if len(self._proven) >= self.n_followers:
+                    self._assembled.set()
 
     def accept_all(self, timeout: float = 300.0) -> None:
         """Block until every follower rank has connected AND passed the

@@ -492,6 +492,7 @@ def build_engine_from_args(args, publisher=None) -> tuple[Engine, str]:
         num_pages=getattr(args, "kv_pages", 0),
         prefix_cache_min=getattr(args, "prefix_cache_min", 16),
         speculate_tokens=getattr(args, "speculate_tokens", 0),
+        kv_cache_dtype=getattr(args, "kv_cache_dtype", ""),
     )
     if args.model.startswith("test:"):
         eng = build_test_engine(engine_config=ec)
@@ -653,6 +654,11 @@ def main(argv=None):
     parser.add_argument("--max-seq-len", type=int, default=2048)
     parser.add_argument("--tensor-parallel-size", type=int, default=1)
     parser.add_argument("--quantization", default="", choices=["", "int8"])
+    parser.add_argument(
+        "--kv-cache-dtype", default="", choices=["", "fp8", "int8"],
+        help="paged KV pool storage dtype (fp8 = float8_e4m3fn, scale-"
+             "free; halves KV HBM so the slot ceiling roughly doubles)",
+    )
     parser.add_argument(
         "--page-size", type=int, default=64, help="KV pool tokens per page"
     )

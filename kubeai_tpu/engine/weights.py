@@ -170,28 +170,64 @@ def load_engine_from_path(
     return Engine(config, params, tokenizer, ec)
 
 
-def save_tiny_test_checkpoint(path: str, seed: int = 0) -> "ModelConfig":
+def save_tiny_test_checkpoint(path: str, seed: int = 0, num_heads: int = 4, num_kv_heads: int = 2) -> "ModelConfig":
     """Write the canonical tiny-Llama HF checkpoint used by e2e tests and
     benchmarks (one source of truth: the e2e suite and
-    benchmarks/routing_compare.py must exercise the same shapes)."""
+    benchmarks/routing_compare.py must exercise the same shapes). The
+    head counts are overridable for high-tp gang tests: sharding the KV
+    pool over tp requires 2*num_kv_heads % tp == 0 (the 8-device dryrun
+    gang uses num_kv_heads=4 for tp=8)."""
     import torch
     from transformers import LlamaConfig, LlamaForCausalLM
 
     cfg = ModelConfig(
         vocab_size=256, hidden_size=64, intermediate_size=128, num_layers=2,
-        num_heads=4, num_kv_heads=2, dtype="float32",
+        num_heads=num_heads, num_kv_heads=num_kv_heads, dtype="float32",
     )
     torch.manual_seed(seed)
     hf = LlamaForCausalLM(
         LlamaConfig(
             vocab_size=256, hidden_size=64, intermediate_size=128,
-            num_hidden_layers=2, num_attention_heads=4, num_key_value_heads=2,
+            num_hidden_layers=2, num_attention_heads=num_heads,
+            num_key_value_heads=num_kv_heads,
             tie_word_embeddings=False,
         )
     )
     sd = {k: v.detach().numpy() for k, v in hf.state_dict().items()}
     save_hf_checkpoint(path, cfg, sd)
     return cfg
+
+
+def write_peft_checkpoint(path, config: "ModelConfig", rank=4, alpha=8, seed=0, targets=("q_proj", "v_proj")):
+    """Minimal PEFT-format adapter dir (adapter_config.json +
+    adapter_model.safetensors) — the fixture generator for LoRA tests,
+    the gang dryrun, and adapter demos. Lives here (not in tests/) so
+    non-pytest consumers don't drag the test suite's imports in."""
+    import json
+
+    from safetensors.numpy import save_file
+
+    os.makedirs(path, exist_ok=True)
+    with open(os.path.join(path, "adapter_config.json"), "w") as f:
+        json.dump({"r": rank, "lora_alpha": alpha, "target_modules": list(targets)}, f)
+    rng = np.random.default_rng(seed)
+    tensors = {}
+    dims = {
+        "q_proj": (config.hidden_size, config.num_heads * config.head_dim_),
+        "k_proj": (config.hidden_size, config.num_kv_heads * config.head_dim_),
+        "v_proj": (config.hidden_size, config.num_kv_heads * config.head_dim_),
+        "o_proj": (config.num_heads * config.head_dim_, config.hidden_size),
+    }
+    for li in range(config.num_layers):
+        for t in targets:
+            din, dout = dims[t]
+            A = rng.normal(0, 0.1, (rank, din)).astype(np.float32)
+            B = rng.normal(0, 0.1, (dout, rank)).astype(np.float32)
+            base = f"base_model.model.model.layers.{li}.self_attn.{t}"
+            tensors[base + ".lora_A.weight"] = A
+            tensors[base + ".lora_B.weight"] = B
+    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
+    return tensors
 
 
 def save_hf_checkpoint(path: str, config: ModelConfig, state_dict: dict[str, np.ndarray], tokenizer_src: str | None = None):

@@ -195,12 +195,27 @@ def init_paged_cache(config: ModelConfig, num_pages: int, page_size: int, dtype=
     keeps the donated buffer in place end-to-end; the only per-layer
     work is the B-token scatter and the kernel's page reads. Logical
     page 0 of every layer (pool row l*P) is that layer's trash page
-    (see engine/paging.py)."""
-    dtype = dtype or jnp.dtype(config.dtype)
+    (see engine/paging.py).
+
+    config.kv_cache_dtype = "fp8"/"int8" stores the pool quantized
+    (see ModelConfig): apply() quantizes on write and the attention
+    paths dequantize on read (in-kernel for the ragged kernel)."""
+    dtype = dtype or kv_pool_dtype(config)
     shape = (
         config.num_layers * num_pages, page_size, 2 * config.num_kv_heads, config.head_dim_,
     )
     return {"kv": jnp.zeros(shape, dtype)}
+
+
+def kv_pool_dtype(config: ModelConfig):
+    """Storage dtype for the paged KV pool (quantization-aware)."""
+    if config.kv_cache_dtype == "fp8":
+        return jnp.dtype(jnp.float8_e4m3fn)
+    if config.kv_cache_dtype == "int8":
+        return jnp.dtype(jnp.int8)
+    if config.kv_cache_dtype in ("", "auto"):
+        return jnp.dtype(config.dtype)
+    return jnp.dtype(config.kv_cache_dtype)
 
 
 # ---------------------------------------------------------------------------
@@ -299,6 +314,11 @@ def apply(
     left_aligned: bool = False,  # caller guarantees positions == arange(S)
     return_hidden: bool = False,  # final-norm hidden states instead of logits
     page_table: jnp.ndarray | None = None,  # [B, max_pages] pool page per seq page
+    ring_mesh=None,  # Mesh with an `sp` axis: cache-less attention runs
+    # as ring attention over sequence-sharded blocks (ppermute ring,
+    # O((S/sp)^2) scores per device — parallel/ring_attention.py). The
+    # trainer's long-context path; requires positions == arange(S),
+    # no sliding window, no softcap.
 ):
     """Run the decoder. Returns (logits, new_cache).
 
@@ -323,6 +343,14 @@ def apply(
     B, S = tokens.shape
     H, Kv, h = config.num_heads, config.num_kv_heads, config.head_dim_
     inv_freq = jnp.asarray(rope_frequencies(h, config.rope_theta, config.rope_scaling))
+    if ring_mesh is not None:
+        # Ring attention derives its causal mask from arange positions
+        # and has no window/softcap arms — reject configs it would
+        # silently mis-serve.
+        assert cache is None, "ring attention is the cache-less (training) path"
+        assert config.sliding_window == 0 and config.attn_softcap == 0.0, (
+            "ring attention does not support sliding windows or softcap"
+        )
 
     x = qgather(params["embed"], tokens, jnp.dtype(config.dtype))
     if config.embed_scale:
@@ -359,9 +387,21 @@ def apply(
     )
 
     paged = page_table is not None
+    kv_quant = False
     if paged:
         page = cache["kv"].shape[1]
         pool_P = cache["kv"].shape[0] // config.num_layers  # logical pages per layer
+        kv_dt = cache["kv"].dtype
+        kv_quant = kv_dt in (jnp.dtype(jnp.int8), jnp.dtype(jnp.float8_e4m3fn))
+        if kv_quant:
+            # Static per-tensor dequant scales (fp8 is scale-free, its
+            # finite range covers K/V activations); head axis interleaves
+            # K (even) / V (odd), so the scale vector does too.
+            kq_scale = float(config.kv_scale_k) if kv_dt == jnp.dtype(jnp.int8) else 1.0
+            vq_scale = float(config.kv_scale_v) if kv_dt == jnp.dtype(jnp.int8) else 1.0
+            kv_scale_vec = jnp.where(
+                jnp.arange(2 * Kv) % 2 == 0, kq_scale, vq_scale
+            )[:, None].astype(jnp.float32)  # [2Kv, 1] vs [..., 2Kv, h]
         max_pages = page_table.shape[1]
         skv = max_pages * page
         key_positions = jnp.arange(skv)[None, None, :]  # [1, 1, Skv]
@@ -430,6 +470,15 @@ def apply(
             # un-sliced — slicing a per-layer plane out of a stacked
             # array cost ~10ms/step in copies (see init_paged_cache).
             interleaved = jnp.stack([k, v], axis=3).reshape(B, S, 2 * Kv, h)
+            if kv_quant:
+                y = interleaved.astype(jnp.float32) / kv_scale_vec
+                if kv_dt == jnp.dtype(jnp.int8):
+                    y = jnp.clip(jnp.round(y), -127.0, 127.0)
+                else:
+                    # e4m3fn overflow converts to NaN, not max — clip to
+                    # the format's finite range first.
+                    y = jnp.clip(y, -448.0, 448.0)
+                interleaved = y.astype(kv_dt)
             table_l = page_table + layer_idx * pool_P
             kv_full = kv_pool.at[w_pages + layer_idx * pool_P, w_offs].set(interleaved)
             k_full = v_full = None
@@ -443,6 +492,10 @@ def apply(
                 k_att = v_att = None
             else:
                 gathered = kv_full[table_l]  # [B, mp, page, 2Kv, h]
+                if kv_quant:
+                    gathered = (
+                        gathered.astype(jnp.float32) * kv_scale_vec
+                    ).astype(jnp.dtype(config.dtype))
                 k_att = gathered[..., 0::2, :].reshape(B, skv, Kv, h)
                 v_att = gathered[..., 1::2, :].reshape(B, skv, Kv, h)
         elif k_cache_l is not None:
@@ -464,6 +517,8 @@ def apply(
                 kv_lengths=positions[:, -1] + 1,  # keys 0..last pos inclusive
                 scale=config.query_scale,
                 softcap=config.attn_softcap,
+                k_scale=kq_scale if kv_quant else None,
+                v_scale=vq_scale if kv_quant else None,
             )
         elif use_flash:
             # Prefill positions are arange(S): the cache columns 0..S-1
@@ -475,6 +530,12 @@ def apply(
             attn_out = flash_attention_tpu(
                 q, k, v, causal=True, sm_scale=config.query_scale,
                 interpret=jax.default_backend() != "tpu",
+            )
+        elif ring_mesh is not None and cache is None:
+            from kubeai_tpu.parallel.ring_attention import ring_attention
+
+            attn_out = ring_attention(
+                q, k, v, ring_mesh, scale=config.query_scale
             )
         else:
             layer_mask = mask

@@ -28,8 +28,12 @@ def paged_attention_ragged(
     kv_lengths: jnp.ndarray,  # [B] int32 — valid keys INCLUDING the S new tokens
     scale: float | None = None,
     softcap: float = 0.0,
+    k_scale: float | None = None,  # static dequant scales for quantized
+    v_scale: float | None = None,  # (int8/fp8) pools; None = pool is bf16
 ) -> jnp.ndarray:
-    """Returns [B, S, H, h] attention output."""
+    """Returns [B, S, H, h] attention output. With a quantized pool the
+    kernel dequantizes pages in-VMEM (x.astype(f32) * scale -> q.dtype),
+    so HBM page traffic stays 8-bit."""
     B, S, H, h = q.shape
     max_pages = page_table.shape[1]
     page = kv_pages.shape[1]
@@ -67,12 +71,14 @@ def paged_attention_ragged(
         cu_q_lens, num_seqs,
         sm_scale=float(scale),
         soft_cap=softcap if softcap > 0.0 else None,
+        k_scale=k_scale,
+        v_scale=v_scale,
         **tuning,
     )
     return out.reshape(B, S, H, h).astype(q.dtype)
 
 
-def _cpu_twin(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None):
+def _cpu_twin(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None, k_scale=None, v_scale=None):
     """Jit-safe semantics twin of ragged_paged_attention, with the SAME
     signature (the library's pure-JAX reference uses Python loops over
     traced bounds, so it only runs eagerly; tests compare this twin
@@ -92,6 +98,12 @@ def _cpu_twin(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, s
     skv = max_pages * page
     k_att = gathered[..., 0::2, :].reshape(B, skv, Kv, h)
     v_att = gathered[..., 1::2, :].reshape(B, skv, Kv, h)
+    # Quantized-pool dequant, same recipe as the kernel (f32 * scale ->
+    # q.dtype).
+    if k_scale is not None:
+        k_att = (k_att.astype(jnp.float32) * k_scale).astype(q.dtype)
+    if v_scale is not None:
+        v_att = (v_att.astype(jnp.float32) * v_scale).astype(q.dtype)
     pos_q = kv_lens[:, None] - S + jnp.arange(S, dtype=jnp.int32)[None, :]
     mask = jnp.arange(skv)[None, None, :] <= pos_q[:, :, None]
     return attention(

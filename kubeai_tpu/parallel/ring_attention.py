@@ -53,7 +53,7 @@ def _block_attend(q, k, v, q_pos, k_pos, scale, o, l, m):
     return o_new, l_new, m_new
 
 
-def _ring_body(my_idx, n, block_len, q, k0, v0, scale):
+def _ring_body(my_idx, n, block_len, q, k0, v0, scale, vary_axes=("sp",)):
     B, Sq, H, h = q.shape
     q_pos = my_idx * block_len + jnp.arange(Sq)
 
@@ -61,8 +61,9 @@ def _ring_body(my_idx, n, block_len, q, k0, v0, scale):
     l = jnp.zeros((B, Sq, H), jnp.float32)
     m = jnp.full((B, Sq, H), _NEG_INF, jnp.float32)
     # The carry becomes device-varying inside the loop (my_idx-dependent
-    # masks); mark the initial values so scan's carry types line up.
-    o, l, m = (jax.lax.pvary(t, ("sp",)) for t in (o, l, m))
+    # masks, and q/k vary over every sharded mesh axis); mark the initial
+    # values over the same axes so scan's carry types line up.
+    o, l, m = (jax.lax.pvary(t, vary_axes) for t in (o, l, m))
 
     def step(carry, i):
         o, l, m, k_cur, v_cur = carry
@@ -91,7 +92,20 @@ def ring_attention(q, k, v, mesh: Mesh, scale: float | None = None):
         scale = h**-0.5
     block_len = S // n
 
-    spec = P(None, "sp", None, None)
+    # Partition every axis the surrounding program shards: batch over dp
+    # and heads over tp (sp-only specs would all-gather dp/tp-sharded
+    # q/k/v at the shard_map boundary — redundant compute AND defeating
+    # tp's memory split). GQA grouping survives tp head sharding because
+    # wq/wk/wv shard H and Kv by the same factor. dp/tp may be size-1
+    # axes (make_mesh always creates all four).
+    Kv = k.shape[2]
+    dp_n = mesh.shape.get("dp", 1)
+    tp_n = mesh.shape.get("tp", 1)
+    dp_ax = "dp" if B % max(dp_n, 1) == 0 else None
+    tp_ax = (
+        "tp" if tp_n >= 1 and H % tp_n == 0 and Kv % tp_n == 0 else None
+    )
+    spec = P(dp_ax, "sp", tp_ax, None)
 
     @partial(
         jax.shard_map,
@@ -101,6 +115,9 @@ def ring_attention(q, k, v, mesh: Mesh, scale: float | None = None):
     )
     def sharded(q_blk, k_blk, v_blk):
         my_idx = jax.lax.axis_index("sp")
-        return _ring_body(my_idx, n, block_len, q_blk, k_blk, v_blk, scale)
+        vary = tuple(a for a in (dp_ax, "sp", tp_ax) if a)
+        return _ring_body(
+            my_idx, n, block_len, q_blk, k_blk, v_blk, scale, vary_axes=vary
+        )
 
     return sharded(q, k, v)

@@ -22,13 +22,16 @@ from kubeai_tpu.models.base import ModelConfig
 from kubeai_tpu.parallel.sharding import llama_param_specs, shard_tree
 
 
-def loss_fn(params, config: ModelConfig, tokens, targets, mask):
+def loss_fn(params, config: ModelConfig, tokens, targets, mask, ring_mesh=None):
     """Mean next-token cross-entropy over mask=1 positions.
-    tokens/targets/mask: [B, S] (targets already shifted by caller)."""
+    tokens/targets/mask: [B, S] (targets already shifted by caller).
+    With *ring_mesh*, attention runs as ring attention over the mesh's
+    sp axis (sequence-parallel long context: O((S/sp)^2) scores per
+    device instead of O(S^2) — parallel/ring_attention.py)."""
     B, S = tokens.shape
     positions = jnp.broadcast_to(jnp.arange(S, dtype=jnp.int32)[None, :], (B, S))
     positions = jax.lax.with_sharding_constraint(positions, P("dp", "sp"))
-    logits, _ = llama.apply(params, config, tokens, positions)
+    logits, _ = llama.apply(params, config, tokens, positions, ring_mesh=ring_mesh)
     logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)[..., 0]
     mask = mask.astype(jnp.float32)
@@ -39,23 +42,38 @@ def make_optimizer(lr: float = 1e-4, weight_decay: float = 0.0):
     return optax.adamw(lr, weight_decay=weight_decay)
 
 
-def train_step(params, opt_state, batch, config: ModelConfig, optimizer):
+def train_step(params, opt_state, batch, config: ModelConfig, optimizer, ring_mesh=None):
     """One SGD step. batch = {"tokens", "targets", "mask"} each [B, S].
     Returns (loss, params, opt_state). Pure function — jit it with donated
     params/opt_state under the target mesh."""
     loss, grads = jax.value_and_grad(loss_fn)(
-        params, config, batch["tokens"], batch["targets"], batch["mask"]
+        params, config, batch["tokens"], batch["targets"], batch["mask"],
+        ring_mesh,
     )
     updates, opt_state = optimizer.update(grads, opt_state, params)
     params = optax.apply_updates(params, updates)
     return loss, params, opt_state
 
 
-def init_sharded_training(config: ModelConfig, mesh, seed: int = 0, lr: float = 1e-4):
+def init_sharded_training(config: ModelConfig, mesh, seed: int = 0, lr: float = 1e-4, ring_attention: bool | None = None):
     """Init params + optimizer state sharded over *mesh* (fsdp over dp,
-    megatron tp). Returns (params, opt_state, optimizer, jitted_step)."""
+    megatron tp). Returns (params, opt_state, optimizer, jitted_step).
+
+    ring_attention: None (default) auto-enables ring attention whenever
+    the mesh's sp axis is >1 and the config supports it — sequence
+    parallelism is what the sp axis IS here, and dense attention over an
+    sp-sharded sequence would silently all-gather the full S (defeating
+    the O((S/sp)^2) memory point). Pass False to force dense."""
     optimizer = make_optimizer(lr)
     specs = llama_param_specs(config, fsdp=True)
+
+    if ring_attention is None:
+        ring_attention = (
+            mesh.shape.get("sp", 1) > 1
+            and config.sliding_window == 0
+            and config.attn_softcap == 0.0
+        )
+    ring_mesh = mesh if ring_attention else None
 
     params = llama.init_params(config, jax.random.key(seed), dtype=jnp.float32)
     params = shard_tree(params, specs, mesh)
@@ -69,6 +87,6 @@ def init_sharded_training(config: ModelConfig, mesh, seed: int = 0, lr: float = 
         batch = {
             k: jax.lax.with_sharding_constraint(v, P("dp", "sp")) for k, v in batch.items()
         }
-        return train_step(params, opt_state, batch, config, optimizer)
+        return train_step(params, opt_state, batch, config, optimizer, ring_mesh)
 
     return params, opt_state, optimizer, step, data_sharding

@@ -20,31 +20,13 @@ CFG = ModelConfig(
 RANK = 4
 
 
-def write_peft_checkpoint(path, config: ModelConfig, rank=RANK, alpha=8, seed=0, targets=("q_proj", "v_proj")):
-    """Minimal PEFT-format adapter dir."""
-    from safetensors.numpy import save_file
+from kubeai_tpu.engine.weights import write_peft_checkpoint as _write_peft
 
-    os.makedirs(path, exist_ok=True)
-    with open(os.path.join(path, "adapter_config.json"), "w") as f:
-        json.dump({"r": rank, "lora_alpha": alpha, "target_modules": list(targets)}, f)
-    rng = np.random.default_rng(seed)
-    tensors = {}
-    dims = {
-        "q_proj": (config.hidden_size, config.num_heads * config.head_dim_),
-        "k_proj": (config.hidden_size, config.num_kv_heads * config.head_dim_),
-        "v_proj": (config.hidden_size, config.num_kv_heads * config.head_dim_),
-        "o_proj": (config.num_heads * config.head_dim_, config.hidden_size),
-    }
-    for li in range(config.num_layers):
-        for t in targets:
-            din, dout = dims[t]
-            A = rng.normal(0, 0.1, (rank, din)).astype(np.float32)
-            B = rng.normal(0, 0.1, (dout, rank)).astype(np.float32)
-            base = f"base_model.model.model.layers.{li}.self_attn.{t}"
-            tensors[base + ".lora_A.weight"] = A
-            tensors[base + ".lora_B.weight"] = B
-    save_file(tensors, os.path.join(path, "adapter_model.safetensors"))
-    return tensors
+
+def write_peft_checkpoint(path, config: ModelConfig, rank=RANK, alpha=8, seed=0, targets=("q_proj", "v_proj")):
+    """Minimal PEFT-format adapter dir (shared generator lives in
+    engine/weights.py so non-pytest consumers don't import the suite)."""
+    return _write_peft(path, config, rank=rank, alpha=alpha, seed=seed, targets=targets)
 
 
 class TestBankMath:

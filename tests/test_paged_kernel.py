@@ -62,15 +62,17 @@ def test_tpu_dispatch_arm_builds_identical_call(monkeypatch):
 
     recorded = {}
 
-    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None, vmem_limit_bytes=None):
+    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None, k_scale=None, v_scale=None, vmem_limit_bytes=None):
         recorded.update(
             q=q_flat, pages=kv_pages, lens=kv_lens, table=page_indices,
             cu=cu_q_lens, n=num_seqs, scale=sm_scale, cap=soft_cap,
+            k_scale=k_scale, v_scale=v_scale,
             vmem=vmem_limit_bytes,
         )
         return pa._cpu_twin(
             q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs,
             sm_scale=sm_scale, soft_cap=soft_cap,
+            k_scale=k_scale, v_scale=v_scale,
         )
 
     import jax.experimental.pallas.ops.tpu.ragged_paged_attention as lib
