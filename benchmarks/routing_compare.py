@@ -62,7 +62,18 @@ def run_strategy(mgr, store, ckpt: str, strategy: str, args) -> dict:
                     "--max-seq-len",
                     str(max(1024, 2 * args.prefix_pad_chars + 512)),
                     "--max-slots", "4",
-                ],
+                ] + (
+                    # Pool sizing is the regime switch (r5 measurement):
+                    # with the default pool (~slots' worth of pages) a
+                    # replica can hold only a handful of conversations,
+                    # so at conversations >> replicas the prefix cache
+                    # thrashes regardless of routing and LeastLoad's
+                    # balance wins. The reference's benchmark regime has
+                    # KV capacity for its conversations; --kv-pages
+                    # reproduces that (size for conversations/replicas x
+                    # history tokens / page_size).
+                    ["--kv-pages", str(args.kv_pages)] if args.kv_pages else []
+                ),
                 load_balancing=LoadBalancing(strategy=strategy, prefix_hash=PrefixHash()),
             ),
         ),
@@ -171,6 +182,11 @@ def main():
         "--prefix-pad-chars", type=int, default=0,
         help="long unique context in each conversation's first turn — the "
              "re-prefill-dominated regime where prefix affinity pays",
+    )
+    parser.add_argument(
+        "--kv-pages", type=int, default=0,
+        help="per-replica KV pool pages (0 = engine default, which holds "
+             "only ~max-slots conversations — see the pool-sizing note)",
     )
     parser.add_argument(
         "--strategies", default="RoundRobin,LeastLoad,PrefixHash",

@@ -257,7 +257,7 @@ class GangPublisher:
         # secret (mutual: a follower must not replay its dispatch stream
         # for an impostor rank 0). A rejected racer above saw EOF instead.
         try:
-            conn.sendall(_mac(self._secret, _TAG_PUBLISHER, transcript, rank))
+            self._send_counter_proof(conn, transcript, rank)
         except OSError as e:
             log.warning("gang follower rank %d from %s died mid-handshake: %s",
                         rank, addr, e)
@@ -283,6 +283,11 @@ class GangPublisher:
                 self._proven.add(rank)
                 if len(self._proven) >= self.n_followers:
                     self._assembled.set()
+
+    def _send_counter_proof(self, conn: socket.socket, transcript: bytes, rank: int) -> None:
+        """Seam for the proof send (tests stub it to fail: TCP buffering
+        makes a real send-to-dead-peer nondeterministic)."""
+        conn.sendall(_mac(self._secret, _TAG_PUBLISHER, transcript, rank))
 
     def accept_all(self, timeout: float = 300.0) -> None:
         """Block until every follower rank has connected AND passed the

@@ -63,6 +63,17 @@ def paged_attention_ragged(
         # memory in memory space vmem"). v5e/v5p have 128MB VMEM; 64MB
         # leaves XLA plenty for the surrounding fusion.
         tuning["vmem_limit_bytes"] = 64 * 1024 * 1024
+        # Optional grid-tuning override ("kv_pages,queries" per block):
+        # the library's tuned table targets vLLM-style shapes; decode at
+        # S=1 per slot is grid-underutilized, and this knob lets bench
+        # sweeps probe better blockings without code edits.
+        import os
+
+        blk = os.environ.get("KUBEAI_PAGED_KERNEL_BLOCK")
+        if blk:
+            blk_pages, blk_queries = (int(x) for x in blk.split(","))
+            tuning["num_kv_pages_per_block"] = blk_pages
+            tuning["num_queries_per_block"] = blk_queries
     # One argument construction for BOTH arms (the twin is signature-
     # identical to the kernel), so CPU tests exercise the exact call the
     # TPU makes; TPU-only tuning kwargs ride separately.

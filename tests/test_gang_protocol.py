@@ -343,3 +343,80 @@ class TestDesyncFatal:
         ids, _, fin = eng.generate([6, 7], SamplingParams(temperature=0.0, max_tokens=3), timeout=120)
         assert len(ids) == 3
         eng.stop()
+
+
+class TestAssemblyCountsProvenRanksOnly:
+    def test_rolled_back_rank_does_not_complete_assembly(self):
+        """Advisor r5: a rank whose counter-proof send fails is rolled
+        back — assembly must NOT have counted it, or the gang declares
+        itself complete with a permanently missing member whose
+        reconnect is then rejected behind the assembled check."""
+        import socket as _socket
+        import struct as _struct
+
+        from kubeai_tpu.engine.gang import _TAG_FOLLOWER, _mac
+
+        pub = GangPublisher(2, port=0, host="127.0.0.1", secret=SECRET)
+        # Deterministically fail rank 1's counter-proof send (a real
+        # send to a dead peer can succeed into the kernel buffer, so a
+        # socket trick can't pin this race).
+        real_send = pub._send_counter_proof
+        fail_once = {"armed": True}
+
+        def flaky_send(conn, transcript, rank):
+            if rank == 1 and fail_once["armed"]:
+                fail_once["armed"] = False
+                raise OSError("injected proof-send failure")
+            real_send(conn, transcript, rank)
+
+        pub._send_counter_proof = flaky_send
+
+        def half_handshake(rank):
+            """Follower that authenticates; the publisher's proof send
+            is injected to fail, triggering the rollback path."""
+            s = _socket.create_connection(("127.0.0.1", pub.port), timeout=10)
+            ch = s.recv(16)
+            nonce = b"\x01" * 16
+            s.sendall(
+                _struct.pack(">I", rank)
+                + nonce
+                + _mac(SECRET.encode(), _TAG_FOLLOWER, ch + nonce, rank)
+            )
+            s.close()
+
+        half_handshake(1)
+        # Wait for the publisher to register + fail the proof send +
+        # roll back.
+        deadline = time.time() + 10
+        while time.time() < deadline and (
+            fail_once["armed"] or 1 in pub._ranks
+        ):
+            time.sleep(0.05)
+        assert 1 not in pub._ranks, "rank 1 was not rolled back"
+
+        # A real rank 2 joins; the gang must NOT assemble on (dead 1, 2).
+        out = {}
+
+        def join2():
+            try:
+                out["fol"] = GangFollower(
+                    "127.0.0.1", pub.port, timeout=10, secret=SECRET, rank=2
+                )
+            except Exception as e:
+                out["err"] = e
+
+        t2 = threading.Thread(target=join2, daemon=True)
+        t2.start()
+        t2.join(timeout=15)
+        assert "fol" in out, out.get("err")
+        assert not pub._assembled.is_set(), (
+            "gang assembled while rank 1 was rolled back"
+        )
+        # Rank 1 reconnects properly -> NOW the gang completes. (wait,
+        # not is_set: the publisher thread sets the event after the
+        # follower's handshake returns.)
+        fol1 = connect_pair(pub, timeout=15, rank=1)
+        assert pub._assembled.wait(5)
+        fol1.close()
+        out["fol"].close()
+        pub.close()

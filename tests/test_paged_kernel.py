@@ -62,11 +62,12 @@ def test_tpu_dispatch_arm_builds_identical_call(monkeypatch):
 
     recorded = {}
 
-    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None, k_scale=None, v_scale=None, vmem_limit_bytes=None):
+    def fake_kernel(q_flat, kv_pages, kv_lens, page_indices, cu_q_lens, num_seqs, *, sm_scale, soft_cap=None, k_scale=None, v_scale=None, num_kv_pages_per_block=None, num_queries_per_block=None, vmem_limit_bytes=None):
         recorded.update(
             q=q_flat, pages=kv_pages, lens=kv_lens, table=page_indices,
             cu=cu_q_lens, n=num_seqs, scale=sm_scale, cap=soft_cap,
             k_scale=k_scale, v_scale=v_scale,
+            blk=(num_kv_pages_per_block, num_queries_per_block),
             vmem=vmem_limit_bytes,
         )
         return pa._cpu_twin(
@@ -87,7 +88,11 @@ def test_tpu_dispatch_arm_builds_identical_call(monkeypatch):
     table = jnp.asarray(np.arange(1, 1 + B * mp, dtype=np.int32).reshape(B, mp))
     kv_lens = jnp.asarray([10, 30], jnp.int32)
 
+    # Grid-tuning env knob must flow through (and not shadow the query
+    # tensor — a r5 review catch).
+    monkeypatch.setenv("KUBEAI_PAGED_KERNEL_BLOCK", "8,4")
     got = pa.paged_attention_ragged(q, kv_pages, table, kv_lens, softcap=25.0)
+    assert recorded["blk"] == (8, 4)
 
     assert recorded["q"].shape == (B * S, H, h)
     np.testing.assert_array_equal(np.asarray(recorded["cu"]), np.arange(B + 1) * S)
