@@ -113,6 +113,20 @@ class LocalRuntime:
             env.update(sec.data)
         return env
 
+    def _finalize_env(self, env: dict, explicit: set) -> None:
+        """Central guard for subprocess env hazards this runtime's host
+        environment carries. The axon sitecustomize (dev/driver images
+        with a tunneled TPU) force-registers the remote-TPU backend in
+        ANY python subprocess where PALLAS_AXON_POOL_IPS is truthy,
+        OVERRIDING JAX_PLATFORMS=cpu — a CPU-pinned pod would dial the
+        one real chip (or hang on a dead tunnel). Close the gate for
+        CPU-pinned pods unless a caller explicitly provided the var
+        (container env or extra_env). Per-caller patches kept leaking
+        (advisor r5: bench/dryrun/tests each re-fixed it); this is the
+        one place every subprocess env flows through."""
+        if env.get("JAX_PLATFORMS") == "cpu" and "PALLAS_AXON_POOL_IPS" not in explicit:
+            env["PALLAS_AXON_POOL_IPS"] = ""
+
     def _run_job(self, job):
         """Execute a Job's container to completion in a worker thread and
         record success/failure in its status (the kubelet's job controller
@@ -122,8 +136,10 @@ class LocalRuntime:
         server = job.spec.containers[0]
         cmd = list(server.command) + list(server.args)
         env = dict(os.environ)
-        env.update(self._container_env(server, job.meta.namespace))
+        cenv = self._container_env(server, job.meta.namespace)
+        env.update(cenv)
         env.update(self.extra_env)
+        self._finalize_env(env, set(cenv) | set(self.extra_env))
         env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
 
         def run():
@@ -163,8 +179,10 @@ class LocalRuntime:
         port = free_port()
         cmd = self._rewrite_port(cmd, port)
         env = dict(os.environ)
-        env.update(self._container_env(server, pod.meta.namespace))
+        cenv = self._container_env(server, pod.meta.namespace)
+        env.update(cenv)
         env.update(self.extra_env)
+        self._finalize_env(env, set(cenv) | set(self.extra_env))
         env["PYTHONPATH"] = self.repo_root + os.pathsep + env.get("PYTHONPATH", "")
         if "TPU_WORKER_HOSTNAMES" in env:
             # Multi-host slice gang running as local processes: the
