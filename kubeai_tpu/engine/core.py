@@ -43,7 +43,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeai_tpu.engine.sampling import SamplingParams, sample
+from kubeai_tpu.engine.sampling import SamplingParams, apply_penalties, sample
 from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.models import llama
@@ -125,6 +125,11 @@ class EngineConfig:
     # "fp8"/"int8" quantize the paged pool (see ModelConfig.kv_cache_dtype
     # — halves KV HBM, doubling the slot ceiling on a 16GB chip).
     kv_cache_dtype: str = ""
+    # OpenAI presence/frequency penalties, computed in-graph from the
+    # token history. On by default (the API accepts the params, so
+    # silently ignoring them would be worse than the ~two fused [B, V]
+    # temporaries per decode step the shared graph costs).
+    enable_penalties: bool = True
 
 
 @dataclass
@@ -339,6 +344,12 @@ class Engine:
         self._h_temp = np.ones((B,), np.float32)
         self._h_top_p = np.ones((B,), np.float32)
         self._h_top_k = np.zeros((B,), np.int32)
+        self._h_presence = np.zeros((B,), np.float32)
+        self._h_freq = np.zeros((B,), np.float32)
+        # First generated position per slot (= prompt length): the
+        # penalty window over the device token history is
+        # [gen_start, lengths) — generated tokens only.
+        self._h_gen_start = np.zeros((B,), np.int32)
         self._h_lora_rows = np.zeros((B,), np.int32)
         # Admission merge-in: filled by _register, consumed by the next
         # decode dispatch (the decode step rebases the admitted slots'
@@ -458,7 +469,9 @@ class Engine:
 
             return jax.vmap(one)(hist, lengths, last)
 
-        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None):
+        penalties_on = self.cfg.enable_penalties
+
+        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None):
             """K fused decode steps, each verifying up to G drafts.
             Returns (drafts [K, B, G], corr [K, B], accepted [K, B]) —
             the host emits drafts[:a] + [corr] per slot per step, where
@@ -503,30 +516,59 @@ class Engine:
                     lora=lora, lora_rows=lora_rows,
                 )
                 logits = mask_pad(logits)  # [B, G+1, V]
+                if penalties_on:
+                    # OpenAI presence/frequency penalties over the
+                    # GENERATED window of the device token history —
+                    # applied to position 0 (the token being chosen this
+                    # step); penalty slots never accept drafts (below),
+                    # so positions 1..G stay penalty-free verify lanes.
+                    # The penalized view steers CHOICE only (argmax /
+                    # sampling); reported logprobs stay the model's raw
+                    # log p(token | prefix), matching how temperature /
+                    # top_p shape choice without reshaping logprobs.
+                    w_idx = jnp.arange(hist.shape[1], dtype=jnp.int32)[None, :]
+                    pen_valid = (w_idx >= gen_start[:, None]) & (
+                        w_idx < lengths[:, None]
+                    )
+                    pen0 = apply_penalties(
+                        logits[:, 0], hist, pen_valid, presence, frequency
+                    )
+                else:
+                    pen0 = logits[:, 0]
                 # Chosen-token logprob = raw logit - logsumexp: avoids
                 # materializing a normalized [B, G+1, V] tensor in the
                 # hottest loop just to gather G+1 entries.
                 lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, G+1]
                 yhat = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+                yhat0_pen = jnp.argmax(pen0, axis=-1).astype(jnp.int32)
                 # Greedy slots accept the longest draft prefix the model
                 # agrees with (exactness by causality); sampled slots
-                # accept nothing and sample position 0 as before.
+                # accept nothing and sample position 0 as before. Slots
+                # with any penalty also accept nothing: draft exactness
+                # is argmax-equivalence against the UNpenalized verify
+                # lanes, which a penalized distribution breaks.
                 greedy = temp <= 0.0
                 if G > 0:
                     matches = (yhat[:, :G] == drafts).astype(jnp.int32)
                     acc = jnp.cumprod(matches, axis=1).sum(axis=1)
-                    acc = jnp.where(greedy & active, acc, 0)
+                    no_pen = (presence == 0.0) & (frequency == 0.0)
+                    acc = jnp.where(greedy & active & no_pen, acc, 0)
                 else:
                     acc = jnp.zeros((B,), jnp.int32)
                 step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
                 sampled0 = sample(
-                    logits[:, 0], step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk
+                    pen0, step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk
                 )
-                corr = jnp.where(
-                    greedy,
+                # Greedy: position 0 picks from the penalized view
+                # (identical to raw when penalties are zero); accepted-
+                # draft positions (acc>0, only reachable penalty-free)
+                # pick from the raw verify lanes.
+                greedy_pick = jnp.where(
+                    acc > 0,
                     jnp.take_along_axis(yhat, acc[:, None], axis=1)[:, 0],
-                    sampled0,
+                    yhat0_pen,
                 )
+                corr = jnp.where(greedy, greedy_pick, sampled0)
                 corr = jnp.where(active, corr, last)
                 if G > 0:
                     lp_d = (
@@ -1010,6 +1052,7 @@ class Engine:
                     self.params, self._cache, ar["tables"], self._tok_hist,
                     self._lengths, self._last_tokens, self._keys,
                     ar["active"], ar["temp"], ar["top_p"], ar["top_k"],
+                    ar["presence"], ar["freq"], ar["gen_start"],
                     ar["adm_mask"], ar["adm_len"], ar["adm_seed"],
                     self._adm_toks, **adm_hist, **lora_args,
                 )
@@ -1462,6 +1505,9 @@ class Engine:
         self._h_temp[slot_idx] = sp.temperature
         self._h_top_p[slot_idx] = sp.top_p
         self._h_top_k[slot_idx] = sp.top_k
+        self._h_presence[slot_idx] = sp.presence_penalty
+        self._h_freq[slot_idx] = sp.frequency_penalty
+        self._h_gen_start[slot_idx] = len(ids)
         self._h_lora_rows[slot_idx] = lora_row
         self._adm_mask[slot_idx] = True
         self._adm_len[slot_idx] = len(ids)
@@ -1567,7 +1613,9 @@ class Engine:
             arrays={
                 "tables": self._page_table, "active": self._h_active,
                 "temp": self._h_temp, "top_p": self._h_top_p,
-                "top_k": self._h_top_k, "adm_mask": self._adm_mask,
+                "top_k": self._h_top_k, "presence": self._h_presence,
+                "freq": self._h_freq, "gen_start": self._h_gen_start,
+                "adm_mask": self._adm_mask,
                 "adm_len": self._adm_len, "adm_seed": self._adm_seed,
                 **({"adm_hist": self._adm_hist} if self.cfg.speculate_tokens > 0 else {}),
                 **({"lora_rows": self._h_lora_rows} if self._adapters is not None else {}),
@@ -1588,6 +1636,9 @@ class Engine:
                 self._h_temp.copy(),
                 self._h_top_p.copy(),
                 self._h_top_k.copy(),
+                self._h_presence.copy(),
+                self._h_freq.copy(),
+                self._h_gen_start.copy(),
                 self._adm_mask.copy(),
                 self._adm_len.copy(),
                 self._adm_seed.copy(),

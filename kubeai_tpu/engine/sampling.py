@@ -29,6 +29,34 @@ class SamplingParams:
     stop: tuple[str, ...] = ()
     seed: int | None = None
     logprobs: bool = False
+    # OpenAI penalties over the generated text so far: presence is a
+    # flat subtraction for any token that has appeared, frequency scales
+    # with its occurrence count. Applied device-side from the engine's
+    # token history (sampling.apply_penalties).
+    presence_penalty: float = 0.0
+    frequency_penalty: float = 0.0
+
+
+def apply_penalties(
+    logits: jnp.ndarray,  # [B, V] float32
+    hist: jnp.ndarray,  # [B, W] int32 token ids (engine token history)
+    hist_valid: jnp.ndarray,  # [B, W] bool — which history columns count
+    presence: jnp.ndarray,  # [B] float32
+    frequency: jnp.ndarray,  # [B] float32
+) -> jnp.ndarray:
+    """OpenAI presence/frequency penalties, computed in-graph from the
+    engine's device-resident token history (no [B, V] count state to
+    carry/donate): scatter-max builds the appeared-at-all flag, scatter-
+    add the occurrence counts — duplicate history entries accumulate
+    exactly count * frequency. Rows with both penalties zero subtract
+    zeros (the compiled graph is shared; the two [B, V] temporaries are
+    ~50 MB of fused traffic per call, noise next to the weight reads)."""
+    B, V = logits.shape
+    b_idx = jnp.arange(B)[:, None]
+    v = hist_valid.astype(jnp.float32)
+    occurred = jnp.zeros((B, V), jnp.float32).at[b_idx, hist].max(v)
+    counts = jnp.zeros((B, V), jnp.float32).at[b_idx, hist].add(v)
+    return logits - presence[:, None] * occurred - frequency[:, None] * counts
 
 
 def sample(

@@ -285,13 +285,21 @@ def _make_handler(srv: EngineServer):
                 max_tokens = 16 if not chat else srv.engine.cfg.default_max_tokens
             elif not isinstance(max_tokens, int) or max_tokens < 1:
                 return self._error(400, "max_tokens must be a positive integer")
+            def num(key, default):
+                # OpenAI documents these as "number or null": an explicit
+                # JSON null must mean the default, not float(None).
+                v = body.get(key)
+                return default if v is None else v
+
             params = SamplingParams(
-                temperature=float(body.get("temperature", 1.0)),
-                top_p=float(body.get("top_p", 1.0)),
-                top_k=int(body.get("top_k", 0)),
+                temperature=float(num("temperature", 1.0)),
+                top_p=float(num("top_p", 1.0)),
+                top_k=int(num("top_k", 0)),
                 max_tokens=int(max_tokens),
                 stop=tuple(stop),
                 seed=body.get("seed"),
+                presence_penalty=float(num("presence_penalty", 0.0)),
+                frequency_penalty=float(num("frequency_penalty", 0.0)),
             )
             if prompt_ids is None:
                 prompt_ids = tok.encode(prompt_text)
