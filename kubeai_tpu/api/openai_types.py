@@ -73,6 +73,20 @@ def _check_sampling(data: dict) -> None:
     _check_int(data, "n", lo=1)
     _check_int(data, "seed")
     _check_int(data, "top_k", lo=0)
+    lb = data.get("logit_bias")
+    if lb is not None:
+        _check(isinstance(lb, dict), "'logit_bias' must be an object")
+        _check(len(lb) <= 300, "'logit_bias' supports at most 300 entries")
+        for k, v in lb.items():
+            _check(
+                isinstance(v, (int, float)) and not isinstance(v, bool)
+                and -100 <= v <= 100,  # also rejects NaN (comparisons False)
+                "'logit_bias' values must be numbers in [-100, 100]",
+            )
+            try:
+                _check(int(k) >= 0, "'logit_bias' keys must be token ids")
+            except (TypeError, ValueError):
+                _check(False, "'logit_bias' keys must be token ids")
     _check_stop(data)
     if "stream" in data:
         _check(isinstance(data["stream"], bool), "'stream' must be a boolean")

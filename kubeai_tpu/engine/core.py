@@ -43,7 +43,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from kubeai_tpu.engine.sampling import SamplingParams, apply_penalties, sample
+from kubeai_tpu.engine.sampling import (
+    SamplingParams,
+    apply_logit_bias,
+    apply_penalties,
+    sample,
+)
 from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.models import llama
@@ -130,6 +135,10 @@ class EngineConfig:
     # silently ignoring them would be worse than the ~two fused [B, V]
     # temporaries per decode step the shared graph costs).
     enable_penalties: bool = True
+    # logit_bias entries honored per request (OpenAI caps the map at
+    # 300; requests beyond this keep the first N entries). Static shape
+    # — the bias arrays ride every dispatch regardless of use.
+    max_logit_bias: int = 32
 
 
 @dataclass
@@ -350,6 +359,11 @@ class Engine:
         # penalty window over the device token history is
         # [gen_start, lengths) — generated tokens only.
         self._h_gen_start = np.zeros((B,), np.int32)
+        # OpenAI logit_bias per slot (pad: token 0 / bias 0.0 — the
+        # scatter-add no-op).
+        Kb = self.cfg.max_logit_bias
+        self._h_bias_ids = np.zeros((B, Kb), np.int32)
+        self._h_bias_vals = np.zeros((B, Kb), np.float32)
         self._h_lora_rows = np.zeros((B,), np.int32)
         # Admission merge-in: filled by _register, consumed by the next
         # decode dispatch (the decode step rebases the admitted slots'
@@ -406,7 +420,7 @@ class Engine:
 
         mtk = self.cfg.max_top_k
 
-        def prefill_batch_fn(params, tokens, lengths, tables, slots, seeds, temp, top_p, top_k, adm_toks, cache, lora=None, lora_rows=None):
+        def prefill_batch_fn(params, tokens, lengths, tables, slots, seeds, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_rows=None):
             """Cold prefill for N requests in ONE call (N is a static pad
             size — 1 for steady-state singles, max_slots for cold
             bursts): tokens [N, S] land in the pages of *tables*
@@ -422,14 +436,19 @@ class Engine:
                 lora=lora, lora_rows=lora_rows,
             )
             masked = mask_pad(logits[:, -1])
-            toks = sample(masked, keys, temp, top_p, top_k, max_top_k=mtk)
+            # Bias steers choice; the reported logprob stays the model's
+            # raw log p (same contract as decode).
+            toks = sample(
+                apply_logit_bias(masked, bias_ids, bias_vals),
+                keys, temp, top_p, top_k, max_top_k=mtk,
+            )
             lps = jnp.take_along_axis(
                 jax.nn.log_softmax(masked, axis=-1), toks[:, None], axis=1
             )[:, 0]
             adm_toks = adm_toks.at[slots].set(toks)
             return toks, lps, cache, adm_toks
 
-        def prefill_chunk_fn(params, tokens, start, last_idx, table, slot, seed, temp, top_p, top_k, adm_toks, cache, lora=None, lora_row=None):
+        def prefill_chunk_fn(params, tokens, start, last_idx, table, slot, seed, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_row=None):
             """One chunk of a long or prefix-resuming prompt."""
             key = jax.random.key(seed)
             logits, cache = llama.prefill_paged(
@@ -439,7 +458,8 @@ class Engine:
             )
             masked = mask_pad(logits[:, -1])
             tok = sample(
-                masked, key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk
+                apply_logit_bias(masked, bias_ids[None], bias_vals[None]),
+                key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk,
             )[0]
             lp = jax.nn.log_softmax(masked, axis=-1)[0, tok]
             adm_toks = adm_toks.at[slot].set(tok)
@@ -471,7 +491,7 @@ class Engine:
 
         penalties_on = self.cfg.enable_penalties
 
-        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None):
+        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, bias_ids, bias_vals, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None):
             """K fused decode steps, each verifying up to G drafts.
             Returns (drafts [K, B, G], corr [K, B], accepted [K, B]) —
             the host emits drafts[:a] + [corr] per slot per step, where
@@ -535,6 +555,7 @@ class Engine:
                     )
                 else:
                     pen0 = logits[:, 0]
+                pen0 = apply_logit_bias(pen0, bias_ids, bias_vals)
                 # Chosen-token logprob = raw logit - logsumexp: avoids
                 # materializing a normalized [B, G+1, V] tensor in the
                 # hottest loop just to gather G+1 entries.
@@ -551,7 +572,13 @@ class Engine:
                 if G > 0:
                     matches = (yhat[:, :G] == drafts).astype(jnp.int32)
                     acc = jnp.cumprod(matches, axis=1).sum(axis=1)
-                    no_pen = (presence == 0.0) & (frequency == 0.0)
+                    # Penalty/bias slots accept nothing: the verify
+                    # lanes (positions 1..G) are raw-argmax.
+                    no_pen = (
+                        (presence == 0.0)
+                        & (frequency == 0.0)
+                        & (bias_vals == 0.0).all(axis=1)
+                    )
                     acc = jnp.where(greedy & active & no_pen, acc, 0)
                 else:
                     acc = jnp.zeros((B,), jnp.int32)
@@ -601,7 +628,7 @@ class Engine:
                 cache, hist, lengths, last, jax.random.key_data(keys),
             )
 
-        # adm_toks (prefill arg 9 / chunk arg 10) and the cache are
+        # adm_toks (prefill arg 11 / chunk arg 12) and the cache are
         # donated through prefill calls; decode reads adm_toks without
         # donating it (it survives until the next prefill overwrites it).
         # Multi-process gangs pin out_shardings explicitly: the KV pool
@@ -625,10 +652,10 @@ class Engine:
             }
             chunk_kw = {"out_shardings": (repl, repl, cache_sh, repl)}
         self._prefill_chunk_jit = jax.jit(
-            prefill_chunk_fn, donate_argnums=(10, 11), **chunk_kw
+            prefill_chunk_fn, donate_argnums=(12, 13), **chunk_kw
         )
         self._prefill_batch_jit = jax.jit(
-            prefill_batch_fn, donate_argnums=(9, 10), **chunk_kw
+            prefill_batch_fn, donate_argnums=(11, 12), **chunk_kw
         )
         # tables + per-slot request state (active/temp/top_p/top_k and
         # the adm_* merge arrays) are host-authoritative numpy uploaded
@@ -1053,6 +1080,7 @@ class Engine:
                     self._lengths, self._last_tokens, self._keys,
                     ar["active"], ar["temp"], ar["top_p"], ar["top_k"],
                     ar["presence"], ar["freq"], ar["gen_start"],
+                    ar["bias_ids"], ar["bias_vals"],
                     ar["adm_mask"], ar["adm_len"], ar["adm_seed"],
                     self._adm_toks, **adm_hist, **lora_args,
                 )
@@ -1061,7 +1089,8 @@ class Engine:
                 _, _, self._cache, self._adm_toks = self._prefill_batch_jit(
                     self.params, ar["tokens"], ar["lengths"], ar["tables"],
                     ar["slots"], ar["seeds"], ar["temps"], ar["top_ps"],
-                    ar["top_ks"], self._adm_toks, self._cache, **lora_args,
+                    ar["top_ks"], ar["bias_ids"], ar["bias_vals"],
+                    self._adm_toks, self._cache, **lora_args,
                 )
             elif op == "prefill_chunk":
                 lora_args = {}
@@ -1079,6 +1108,7 @@ class Engine:
                     np.int32(sc["last_idx"]), ar["table"], np.int32(sc["slot"]),
                     np.uint32(sc["seed"]), np.float32(sc["temperature"]),
                     np.float32(sc["top_p"]), np.int32(sc["top_k"]),
+                    ar["bias_ids"], ar["bias_vals"],
                     self._adm_toks, self._cache, **lora_args,
                 )
             elif op == "embed":
@@ -1421,6 +1451,7 @@ class Engine:
 
         table = self._page_table[slot_idx : slot_idx + 1].copy()
         max_bucket = max(self.cfg.prefill_buckets)
+        bias_ids, bias_vals = self._bias_rows(sp)
         tok = lp = None
         for start in range(reuse, len(ids), max_bucket):
             chunk = ids[start : start + max_bucket]
@@ -1437,7 +1468,10 @@ class Engine:
                     "top_k": int(sp.top_k),
                     **({"lora_row": lora_row} if self._adapters is not None else {}),
                 },
-                arrays={"tokens": chunk_padded, "table": table},
+                arrays={
+                    "tokens": chunk_padded, "table": table,
+                    "bias_ids": bias_ids, "bias_vals": bias_vals,
+                },
             ):
                 tok, lp, self._cache, self._adm_toks = self._prefill_chunk_jit(
                     self.params,
@@ -1450,6 +1484,8 @@ class Engine:
                     np.float32(sp.temperature),
                     np.float32(sp.top_p),
                     np.int32(sp.top_k),
+                    bias_ids,
+                    bias_vals,
                     self._adm_toks,
                     self._cache,
                     **lora_args,
@@ -1457,6 +1493,18 @@ class Engine:
 
         self._register(slot_idx, req, seed, lora_row, reuse)
         return (slot_idx, self._slot_epoch[slot_idx], tok, None, lp)
+
+    def _bias_rows(self, sp: SamplingParams) -> tuple[np.ndarray, np.ndarray]:
+        """A request's logit_bias as fixed-width (ids, vals) rows
+        (pad: token 0 / bias 0.0 — the scatter-add no-op). Entries past
+        the static cap are dropped (first N win, like the OpenAI cap)."""
+        K = self.cfg.max_logit_bias
+        ids = np.zeros((K,), np.int32)
+        vals = np.zeros((K,), np.float32)
+        for j, (t, b) in enumerate(tuple(sp.logit_bias)[:K]):
+            ids[j] = int(t)
+            vals[j] = float(b)
+        return ids, vals
 
     @staticmethod
     def _seed32(sp: SamplingParams, j: int = 0) -> np.uint32:
@@ -1508,6 +1556,7 @@ class Engine:
         self._h_presence[slot_idx] = sp.presence_penalty
         self._h_freq[slot_idx] = sp.frequency_penalty
         self._h_gen_start[slot_idx] = len(ids)
+        self._h_bias_ids[slot_idx], self._h_bias_vals[slot_idx] = self._bias_rows(sp)
         self._h_lora_rows[slot_idx] = lora_row
         self._adm_mask[slot_idx] = True
         self._adm_len[slot_idx] = len(ids)
@@ -1538,6 +1587,8 @@ class Engine:
         temps = np.ones((n_pad,), np.float32)
         top_ps = np.ones((n_pad,), np.float32)
         top_ks = np.zeros((n_pad,), np.int32)
+        bias_ids = np.zeros((n_pad, self.cfg.max_logit_bias), np.int32)
+        bias_vals = np.zeros((n_pad, self.cfg.max_logit_bias), np.float32)
         lora_rows_arr = np.zeros((n_pad,), np.int32)
         # Seeds computed once per ITEM (time-based when unset): padding
         # rows must replicate the last row exactly so their duplicate
@@ -1555,6 +1606,7 @@ class Engine:
             temps[j] = sp.temperature
             top_ps[j] = sp.top_p
             top_ks[j] = sp.top_k
+            bias_ids[j], bias_vals[j] = self._bias_rows(sp)
             if self._adapters is not None:
                 lora_rows_arr[j] = self._adapters.row_for(req.adapter)
 
@@ -1567,6 +1619,7 @@ class Engine:
                 "tokens": tokens, "lengths": lengths, "tables": tables,
                 "slots": slots_arr, "seeds": seeds, "temps": temps,
                 "top_ps": top_ps, "top_ks": top_ks,
+                "bias_ids": bias_ids, "bias_vals": bias_vals,
                 # Included exactly when this rank passes lora kwargs:
                 # followers branch on key presence (their own state must
                 # agree — load ops are ordered in the same stream).
@@ -1583,6 +1636,8 @@ class Engine:
                 temps,
                 top_ps,
                 top_ks,
+                bias_ids,
+                bias_vals,
                 self._adm_toks,
                 self._cache,
                 **lora_args,
@@ -1615,6 +1670,7 @@ class Engine:
                 "temp": self._h_temp, "top_p": self._h_top_p,
                 "top_k": self._h_top_k, "presence": self._h_presence,
                 "freq": self._h_freq, "gen_start": self._h_gen_start,
+                "bias_ids": self._h_bias_ids, "bias_vals": self._h_bias_vals,
                 "adm_mask": self._adm_mask,
                 "adm_len": self._adm_len, "adm_seed": self._adm_seed,
                 **({"adm_hist": self._adm_hist} if self.cfg.speculate_tokens > 0 else {}),
@@ -1639,6 +1695,8 @@ class Engine:
                 self._h_presence.copy(),
                 self._h_freq.copy(),
                 self._h_gen_start.copy(),
+                self._h_bias_ids.copy(),
+                self._h_bias_vals.copy(),
                 self._adm_mask.copy(),
                 self._adm_len.copy(),
                 self._adm_seed.copy(),

@@ -35,6 +35,10 @@ class SamplingParams:
     # token history (sampling.apply_penalties).
     presence_penalty: float = 0.0
     frequency_penalty: float = 0.0
+    # OpenAI logit_bias: ((token_id, bias), ...) with bias in [-100,
+    # 100]; applied to every choice including the first generated token
+    # (prefill's sample). Entry count capped by EngineConfig.
+    logit_bias: tuple = ()
 
 
 def apply_penalties(
@@ -57,6 +61,18 @@ def apply_penalties(
     occurred = jnp.zeros((B, V), jnp.float32).at[b_idx, hist].max(v)
     counts = jnp.zeros((B, V), jnp.float32).at[b_idx, hist].add(v)
     return logits - presence[:, None] * occurred - frequency[:, None] * counts
+
+
+def apply_logit_bias(
+    logits: jnp.ndarray,  # [B, V] float32
+    bias_ids: jnp.ndarray,  # [B, K] int32 (pad rows: id 0 / bias 0.0)
+    bias_vals: jnp.ndarray,  # [B, K] float32
+) -> jnp.ndarray:
+    """OpenAI logit_bias as a per-slot scatter-add (padding adds 0.0 at
+    token 0 — a no-op). Like penalties, bias steers CHOICE only; callers
+    keep reported logprobs on the raw logits."""
+    B = logits.shape[0]
+    return logits.at[jnp.arange(B)[:, None], bias_ids].add(bias_vals)
 
 
 def sample(

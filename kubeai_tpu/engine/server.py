@@ -291,6 +291,34 @@ def _make_handler(srv: EngineServer):
                 v = body.get(key)
                 return default if v is None else v
 
+            bias_raw = body.get("logit_bias") or {}
+            if not isinstance(bias_raw, dict):
+                return self._error(400, "logit_bias must be an object")
+            if len(bias_raw) > srv.engine.cfg.max_logit_bias:
+                # Silent truncation would drop bans without a signal.
+                return self._error(
+                    400,
+                    f"logit_bias supports at most "
+                    f"{srv.engine.cfg.max_logit_bias} entries on this engine",
+                )
+            logit_bias = []
+            for k, v in bias_raw.items():
+                try:
+                    tok_id = int(k)
+                    val = float(v)
+                except (TypeError, ValueError):
+                    return self._error(
+                        400, "logit_bias keys must be token ids, values numbers"
+                    )
+                # Explicit finite+range gate: NaN slips through a
+                # min/max clamp (comparisons are False) and negative
+                # ids would wrap to the end of the vocab in the device
+                # scatter.
+                if tok_id < 0 or not (val == val) or val in (float("inf"), float("-inf")):
+                    return self._error(
+                        400, "logit_bias requires token ids >= 0 and finite values"
+                    )
+                logit_bias.append((tok_id, max(-100.0, min(100.0, val))))
             params = SamplingParams(
                 temperature=float(num("temperature", 1.0)),
                 top_p=float(num("top_p", 1.0)),
@@ -300,6 +328,7 @@ def _make_handler(srv: EngineServer):
                 seed=body.get("seed"),
                 presence_penalty=float(num("presence_penalty", 0.0)),
                 frequency_penalty=float(num("frequency_penalty", 0.0)),
+                logit_bias=tuple(logit_bias),
             )
             if prompt_ids is None:
                 prompt_ids = tok.encode(prompt_text)

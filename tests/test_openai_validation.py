@@ -71,6 +71,12 @@ def test_chat_assistant_tool_call_without_content_valid():
         ({"model": "m", "messages": [{"role": "user", "content": "x"}],
           "tools": [{"type": "function", "function": {}}]}, "function.name"),
         ({"model": "m", "messages": [{"role": "user", "content": "x"}], "top_p": 3}, "top_p"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": [1]}, "logit_bias"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": {"5": 500}}, "logit_bias"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": {"x": 5}}, "logit_bias"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": {"5": True}}, "logit_bias"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": {"-1": -100}}, "logit_bias"),
+        ({"model": "m", "messages": [{"role": "user", "content": "x"}], "logit_bias": {str(i): 0 for i in range(301)}}, "logit_bias"),
     ],
 )
 def test_chat_invalid(body, match):

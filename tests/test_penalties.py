@@ -97,3 +97,24 @@ def test_null_penalties_over_http_are_defaults(tmp_path):
         assert out["usage"]["completion_tokens"] >= 1
     finally:
         srv.stop()
+
+
+def test_logit_bias_bans_and_forces_tokens():
+    """-100 bans a token everywhere INCLUDING the first generated token
+    (prefill's sample applies bias too); +100 forces it greedily."""
+    eng = build_test_engine(
+        engine_config=EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=(16, 32))
+    )
+    eng.start()
+    try:
+        prompt = eng.tokenizer.encode("bias test")
+        base = _greedy_tokens(eng, prompt, 12)
+        banned = base[0]  # would otherwise be the FIRST generated token
+        out = _greedy_tokens(eng, prompt, 12, logit_bias=((banned, -100.0),))
+        assert banned not in out, (banned, out)
+        forced = _greedy_tokens(eng, prompt, 6, logit_bias=((77, 100.0),))
+        assert forced == [77] * 6, forced
+        # Per-request state: next unbiased request is unaffected.
+        assert _greedy_tokens(eng, prompt, 12) == base
+    finally:
+        eng.stop()
