@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import dataclasses
 import logging
 import os
 import queue
@@ -95,6 +96,11 @@ class EngineServer:
         # Idempotency-tolerant like the reference client
         # (ref: internal/vllmclient/client.go:30-73).
         return True, "ok" if existed else "adapter was not loaded"
+
+
+def _cancel_all(reqs) -> None:
+    for r in reqs:
+        r.cancelled.set()
 
 
 def _make_handler(srv: EngineServer):
@@ -337,11 +343,35 @@ def _make_handler(srv: EngineServer):
             # to the bare adapter name before forwarding).
             requested = str(body.get("model", ""))
             adapter = requested if requested in srv.adapters else None
+            # n > 1: one engine request per choice (the cross-slot prefix
+            # cache makes the shared prompt nearly free for choices 2..n).
+            # A set seed derives seed+i per choice — identical-seed
+            # submissions would produce n copies of one sample.
+            n_choices = body.get("n")
+            if n_choices is None:
+                n_choices = 1
+            if (
+                not isinstance(n_choices, int)
+                or isinstance(n_choices, bool)
+                or not 1 <= n_choices <= 16
+            ):
+                return self._error(400, "n must be an integer between 1 and 16")
+            if params.seed is not None and (
+                not isinstance(params.seed, int) or isinstance(params.seed, bool)
+            ):
+                return self._error(400, "seed must be an integer")
+            reqs = []
             try:
-                req = srv.engine.submit(prompt_ids, params, adapter=adapter)
+                for i in range(n_choices):
+                    p_i = params
+                    if i > 0 and params.seed is not None:
+                        p_i = dataclasses.replace(params, seed=params.seed + i)
+                    reqs.append(srv.engine.submit(prompt_ids, p_i, adapter=adapter))
             except ValueError as e:
+                _cancel_all(reqs)
                 return self._error(400, str(e))
             except queue.Full:
+                _cancel_all(reqs)
                 return self._error(503, "engine saturated", "overloaded_error")
 
             rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
@@ -354,9 +384,9 @@ def _make_handler(srv: EngineServer):
             lp_field = body.get("logprobs")
             want_logprobs = lp_field is not None and lp_field is not False
             if body.get("stream"):
-                self._stream_response(req, rid, created, chat, want_logprobs)
+                self._stream_response(reqs, rid, created, chat, want_logprobs)
             else:
-                self._full_response(req, rid, created, chat, want_logprobs)
+                self._full_response(reqs, rid, created, chat, want_logprobs)
 
         def _token_text(self, token_id: int) -> str:
             """The token's OWN text (OpenAI logprobs semantics) — NOT the
@@ -367,61 +397,68 @@ def _make_handler(srv: EngineServer):
             except Exception:
                 return ""
 
-        def _full_response(self, req, rid, created, chat, want_logprobs=False):
-            chunks, pieces, fin = [], [], None
-            while True:
-                try:
-                    ev = req.out.get(timeout=600)
-                except queue.Empty:
-                    req.cancelled.set()
-                    return self._error(504, "generation timed out", "timeout_error")
-                if ev[0] == "token":
-                    chunks.append(ev[2])
-                    if ev[1] >= 0:  # -1 marks a text-only flush
-                        pieces.append((ev[1], ev[3] if len(ev) > 3 else None))
-                elif ev[0] == "done":
-                    fin = ev[1]
-                    break
+        def _full_response(self, reqs, rid, created, chat, want_logprobs=False):
+            choices = []
+            prompt_tokens = 0
+            completion_tokens = 0
+            for idx, req in enumerate(reqs):
+                chunks, pieces, fin = [], [], None
+                while True:
+                    try:
+                        ev = req.out.get(timeout=600)
+                    except queue.Empty:
+                        _cancel_all(reqs)
+                        return self._error(504, "generation timed out", "timeout_error")
+                    if ev[0] == "token":
+                        chunks.append(ev[2])
+                        if ev[1] >= 0:  # -1 marks a text-only flush
+                            pieces.append((ev[1], ev[3] if len(ev) > 3 else None))
+                    elif ev[0] == "done":
+                        fin = ev[1]
+                        break
+                    else:
+                        _cancel_all(reqs)
+                        return self._error(500, ev[1], "internal_error")
+                text = "".join(chunks)
+                prompt_tokens = fin.prompt_tokens  # same prompt per choice
+                completion_tokens += fin.completion_tokens
+                if chat:
+                    choice = {
+                        "index": idx,
+                        "message": {"role": "assistant", "content": text},
+                        "finish_reason": fin.reason,
+                    }
+                    if want_logprobs:
+                        choice["logprobs"] = {
+                            "content": [
+                                {"token": self._token_text(tid), "logprob": lp}
+                                for tid, lp in pieces
+                                if lp is not None
+                            ]
+                        }
                 else:
-                    return self._error(500, ev[1], "internal_error")
-            text = "".join(chunks)
+                    choice = {"index": idx, "text": text, "finish_reason": fin.reason}
+                    if want_logprobs:
+                        choice["logprobs"] = {
+                            "tokens": [self._token_text(tid) for tid, lp in pieces if lp is not None],
+                            "token_logprobs": [lp for _, lp in pieces if lp is not None],
+                            # Top-N alternatives are not computed (chosen-
+                            # token logprobs only).
+                            "top_logprobs": None,
+                        }
+                choices.append(choice)
             usage = {
-                "prompt_tokens": fin.prompt_tokens,
-                "completion_tokens": fin.completion_tokens,
-                "total_tokens": fin.prompt_tokens + fin.completion_tokens,
+                "prompt_tokens": prompt_tokens,
+                "completion_tokens": completion_tokens,
+                "total_tokens": prompt_tokens + completion_tokens,
             }
-            if chat:
-                choice = {
-                    "index": 0,
-                    "message": {"role": "assistant", "content": text},
-                    "finish_reason": fin.reason,
-                }
-                if want_logprobs:
-                    choice["logprobs"] = {
-                        "content": [
-                            {"token": self._token_text(tid), "logprob": lp}
-                            for tid, lp in pieces
-                            if lp is not None
-                        ]
-                    }
-                obj = "chat.completion"
-            else:
-                choice = {"index": 0, "text": text, "finish_reason": fin.reason}
-                if want_logprobs:
-                    choice["logprobs"] = {
-                        "tokens": [self._token_text(tid) for tid, lp in pieces if lp is not None],
-                        "token_logprobs": [lp for _, lp in pieces if lp is not None],
-                        # Top-N alternatives are not computed (chosen-token
-                        # logprobs only).
-                        "top_logprobs": None,
-                    }
-                obj = "text_completion"
+            obj = "chat.completion" if chat else "text_completion"
             self._json(200, {
                 "id": rid, "object": obj, "created": created,
-                "model": srv.model_name, "choices": [choice], "usage": usage,
+                "model": srv.model_name, "choices": choices, "usage": usage,
             })
 
-        def _stream_response(self, req, rid, created, chat, want_logprobs=False):
+        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -434,13 +471,64 @@ def _make_handler(srv: EngineServer):
                 self.wfile.flush()
 
             obj = "chat.completion.chunk" if chat else "text_completion"
-            if chat:
-                first = {"id": rid, "object": obj, "created": created, "model": srv.model_name,
-                         "choices": [{"index": 0, "delta": {"role": "assistant"}, "finish_reason": None}]}
-                send_chunk(json.dumps(first))
-            try:
+
+            # n > 1 choices decode concurrently; their events interleave
+            # into one SSE stream tagged by choice index (OpenAI's n>1
+            # stream shape) via a merge queue fed by one pump per choice.
+            merged: "queue.Queue[tuple[int, tuple]]" = queue.Queue()
+
+            def pump(idx, r):
+                # Short poll + cancellation check: a cancelled request's
+                # slot frees with NO terminal event (deliver=False), so a
+                # long blocking get would strand this thread for the full
+                # timeout after every client disconnect (review r5).
+                waited = 0.0
                 while True:
-                    ev = req.out.get(timeout=600)
+                    try:
+                        ev = r.out.get(timeout=1.0)
+                    except queue.Empty:
+                        if r.cancelled.is_set():
+                            return
+                        waited += 1.0
+                        if waited >= 600.0:
+                            merged.put((idx, ("error", "generation timed out")))
+                            return
+                        continue
+                    waited = 0.0
+                    merged.put((idx, ev))
+                    if ev[0] in ("done", "error"):
+                        return
+
+            if len(reqs) == 1:
+                pumps = None  # single choice: read its queue directly
+            else:
+                pumps = [
+                    threading.Thread(target=pump, args=(i, r), daemon=True)
+                    for i, r in enumerate(reqs)
+                ]
+                for t in pumps:
+                    t.start()
+
+            remaining = len(reqs)
+            prompt_tokens = 0
+            completion_tokens = 0
+            try:
+                if chat:
+                    # Inside the try: a client that disconnected before
+                    # the role chunks flush must cancel all n choices,
+                    # not leave them generating for a dead socket.
+                    for idx in range(len(reqs)):
+                        first = {"id": rid, "object": obj, "created": created, "model": srv.model_name,
+                                 "choices": [{"index": idx, "delta": {"role": "assistant"}, "finish_reason": None}]}
+                        send_chunk(json.dumps(first))
+                while remaining:
+                    if pumps is None:
+                        try:
+                            idx, ev = 0, reqs[0].out.get(timeout=600)
+                        except queue.Empty:
+                            idx, ev = 0, ("error", "generation timed out")
+                    else:
+                        idx, ev = merged.get()
                     if ev[0] == "token":
                         has_lp = (
                             want_logprobs and ev[1] >= 0 and len(ev) > 3
@@ -449,7 +537,7 @@ def _make_handler(srv: EngineServer):
                         if not ev[2] and not has_lp:
                             continue
                         if chat:
-                            choice = {"index": 0, "delta": {"content": ev[2]}, "finish_reason": None}
+                            choice = {"index": idx, "delta": {"content": ev[2]}, "finish_reason": None}
                             if has_lp:
                                 choice["logprobs"] = {
                                     "content": [{
@@ -458,7 +546,7 @@ def _make_handler(srv: EngineServer):
                                     }]
                                 }
                         else:
-                            choice = {"index": 0, "text": ev[2], "finish_reason": None}
+                            choice = {"index": idx, "text": ev[2], "finish_reason": None}
                             if has_lp:
                                 choice["logprobs"] = {
                                     "tokens": [self._token_text(ev[1])],
@@ -471,30 +559,37 @@ def _make_handler(srv: EngineServer):
                         }))
                     elif ev[0] == "done":
                         fin = ev[1]
+                        remaining -= 1
+                        prompt_tokens = fin.prompt_tokens
+                        completion_tokens += fin.completion_tokens
                         choice = (
-                            {"index": 0, "delta": {}, "finish_reason": fin.reason}
+                            {"index": idx, "delta": {}, "finish_reason": fin.reason}
                             if chat
-                            else {"index": 0, "text": "", "finish_reason": fin.reason}
+                            else {"index": idx, "text": "", "finish_reason": fin.reason}
                         )
-                        send_chunk(json.dumps({
+                        payload = {
                             "id": rid, "object": obj, "created": created,
                             "model": srv.model_name, "choices": [choice],
-                            "usage": {
-                                "prompt_tokens": fin.prompt_tokens,
-                                "completion_tokens": fin.completion_tokens,
-                                "total_tokens": fin.prompt_tokens + fin.completion_tokens,
-                            },
-                        }))
-                        send_chunk("[DONE]")
-                        self.wfile.write(b"0\r\n\r\n")
-                        self.wfile.flush()
-                        return
+                        }
+                        if remaining == 0:
+                            payload["usage"] = {
+                                "prompt_tokens": prompt_tokens,
+                                "completion_tokens": completion_tokens,
+                                "total_tokens": prompt_tokens + completion_tokens,
+                            }
+                        send_chunk(json.dumps(payload))
+                        if remaining == 0:
+                            send_chunk("[DONE]")
+                            self.wfile.write(b"0\r\n\r\n")
+                            self.wfile.flush()
+                            return
                     else:
+                        _cancel_all(reqs)
                         send_chunk(json.dumps({"error": {"message": ev[1]}}))
                         self.wfile.write(b"0\r\n\r\n")
                         return
             except (BrokenPipeError, ConnectionResetError):
-                req.cancelled.set()
+                _cancel_all(reqs)
 
     return Handler
 

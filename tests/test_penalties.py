@@ -118,3 +118,63 @@ def test_logit_bias_bans_and_forces_tokens():
         assert _greedy_tokens(eng, prompt, 12) == base
     finally:
         eng.stop()
+
+
+def test_n_choices_over_http():
+    """OpenAI `n`: multiple choices per request — distinct indices,
+    summed completion usage, seed+i derivation gives distinct sampled
+    outputs while n=1 with the same seed stays reproducible."""
+    import json
+    import urllib.request
+
+    from kubeai_tpu.engine.server import EngineServer
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(max_slots=4, max_seq_len=128, prefill_buckets=(16, 32))
+    )
+    srv = EngineServer(eng, model_name="test:tiny", host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        def post(body):
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with urllib.request.urlopen(req, timeout=180) as resp:
+                return json.loads(resp.read())
+
+        out = post({"model": "test:tiny", "prompt": "n test", "max_tokens": 8,
+                    "temperature": 0.9, "seed": 5, "n": 3})
+        assert [c["index"] for c in out["choices"]] == [0, 1, 2]
+        assert out["usage"]["completion_tokens"] >= 3  # summed over choices
+        texts = [c["text"] for c in out["choices"]]
+        assert len(set(texts)) > 1, texts  # seed+i: not three copies
+        # choice 0 reproduces a plain n=1 run with the same seed.
+        solo = post({"model": "test:tiny", "prompt": "n test", "max_tokens": 8,
+                     "temperature": 0.9, "seed": 5})
+        assert solo["choices"][0]["text"] == texts[0]
+
+        # Streaming n=2: chunks carry per-choice indices; final usage sums.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=json.dumps({"model": "test:tiny", "prompt": "n stream", "max_tokens": 4,
+                             "temperature": 0.8, "seed": 9, "n": 2, "stream": True}).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        seen_idx = set()
+        usage = None
+        with urllib.request.urlopen(req, timeout=180) as resp:
+            for line in resp:
+                line = line.decode().strip()
+                if not line.startswith("data: ") or line == "data: [DONE]":
+                    continue
+                d = json.loads(line[6:])
+                for c in d.get("choices", []):
+                    seen_idx.add(c["index"])
+                if "usage" in d:
+                    usage = d["usage"]
+        assert seen_idx == {0, 1}
+        assert usage and usage["completion_tokens"] >= 2
+    finally:
+        srv.stop()
