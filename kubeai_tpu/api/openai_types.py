@@ -88,6 +88,8 @@ def _check_sampling(data: dict) -> None:
             except (TypeError, ValueError):
                 _check(False, "'logit_bias' keys must be token ids")
     _check_stop(data)
+    if data.get("echo") is not None:
+        _check(isinstance(data["echo"], bool), "'echo' must be a boolean")
     if "stream" in data:
         _check(isinstance(data["stream"], bool), "'stream' must be a boolean")
     so = data.get("stream_options")

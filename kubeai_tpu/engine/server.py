@@ -383,21 +383,36 @@ def _make_handler(srv: EngineServer):
             # (documented).
             lp_field = body.get("logprobs")
             want_logprobs = lp_field is not None and lp_field is not False
+            # OpenAI `echo` (completions only): prepend the prompt text
+            # to every choice. Prompt logprobs are not computed
+            # (documented limit, like top-N alternatives).
+            echo_val = body.get("echo")
+            if echo_val is not None and not isinstance(echo_val, bool):
+                return self._error(400, "echo must be a boolean")
+            echo_text = ""
+            if not chat and echo_val:
+                echo_text = (
+                    prompt_text if prompt_text is not None
+                    else self._decode_safe(prompt_ids)
+                )
             if body.get("stream"):
-                self._stream_response(reqs, rid, created, chat, want_logprobs)
+                self._stream_response(reqs, rid, created, chat, want_logprobs, echo_text)
             else:
-                self._full_response(reqs, rid, created, chat, want_logprobs)
+                self._full_response(reqs, rid, created, chat, want_logprobs, echo_text)
+
+        def _decode_safe(self, ids) -> str:
+            try:
+                return srv.engine.tokenizer.decode(list(ids))
+            except Exception:
+                return ""
 
         def _token_text(self, token_id: int) -> str:
             """The token's OWN text (OpenAI logprobs semantics) — NOT the
             stream delta, which can be empty or combined when the
             detokenizer holds back partial UTF-8 / stop-string windows."""
-            try:
-                return srv.engine.tokenizer.decode([token_id])
-            except Exception:
-                return ""
+            return self._decode_safe([token_id])
 
-        def _full_response(self, reqs, rid, created, chat, want_logprobs=False):
+        def _full_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text=""):
             choices = []
             prompt_tokens = 0
             completion_tokens = 0
@@ -437,7 +452,7 @@ def _make_handler(srv: EngineServer):
                             ]
                         }
                 else:
-                    choice = {"index": idx, "text": text, "finish_reason": fin.reason}
+                    choice = {"index": idx, "text": echo_text + text, "finish_reason": fin.reason}
                     if want_logprobs:
                         choice["logprobs"] = {
                             "tokens": [self._token_text(tid) for tid, lp in pieces if lp is not None],
@@ -458,7 +473,7 @@ def _make_handler(srv: EngineServer):
                 "model": srv.model_name, "choices": choices, "usage": usage,
             })
 
-        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False):
+        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text=""):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -521,6 +536,14 @@ def _make_handler(srv: EngineServer):
                         first = {"id": rid, "object": obj, "created": created, "model": srv.model_name,
                                  "choices": [{"index": idx, "delta": {"role": "assistant"}, "finish_reason": None}]}
                         send_chunk(json.dumps(first))
+                elif echo_text:
+                    for idx in range(len(reqs)):
+                        send_chunk(json.dumps({
+                            "id": rid, "object": obj, "created": created,
+                            "model": srv.model_name,
+                            "choices": [{"index": idx, "text": echo_text,
+                                         "finish_reason": None}],
+                        }))
                 while remaining:
                     if pumps is None:
                         try:

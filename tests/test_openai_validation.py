@@ -109,6 +109,7 @@ def test_completions_prompt_forms_valid(prompt):
         ({"model": "m", "prompt": []}, "prompt"),
         ({"model": "m", "prompt": "x", "n": 0}, "'n'"),
         ({"model": "m", "prompt": "x", "logprobs": -1}, "logprobs"),
+        ({"model": "m", "prompt": "x", "echo": "false"}, "echo"),
     ],
 )
 def test_completions_invalid(body, match):

@@ -32,6 +32,30 @@ def test_apply_penalties_math():
     np.testing.assert_array_equal(out[1], 0.0)
 
 
+
+
+def _post(srv, body, stream=False, path="/v1/completions"):
+    """Module-level HTTP helper for the server-endpoint tests (one copy
+    of the urllib boilerplate)."""
+    import json
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    resp = urllib.request.urlopen(req, timeout=180)
+    if not stream:
+        return json.loads(resp.read())
+    lines = []
+    for line in resp:
+        line = line.decode().strip()
+        if line.startswith("data: ") and line != "data: [DONE]":
+            lines.append(json.loads(line[6:]))
+    return lines
+
+
 def _greedy_tokens(eng, prompt, n, **pen):
     sp = SamplingParams(temperature=0.0, max_tokens=n, **pen)
     ids, _, fin = eng.generate(prompt, sp, timeout=120)
@@ -135,46 +159,57 @@ def test_n_choices_over_http():
     srv = EngineServer(eng, model_name="test:tiny", host="127.0.0.1", port=0)
     srv.start()
     try:
-        def post(body):
-            req = urllib.request.Request(
-                f"http://127.0.0.1:{srv.port}/v1/completions",
-                data=json.dumps(body).encode(),
-                headers={"Content-Type": "application/json"},
-            )
-            with urllib.request.urlopen(req, timeout=180) as resp:
-                return json.loads(resp.read())
-
-        out = post({"model": "test:tiny", "prompt": "n test", "max_tokens": 8,
-                    "temperature": 0.9, "seed": 5, "n": 3})
+        out = _post(srv, {"model": "test:tiny", "prompt": "n test", "max_tokens": 8,
+                          "temperature": 0.9, "seed": 5, "n": 3})
         assert [c["index"] for c in out["choices"]] == [0, 1, 2]
         assert out["usage"]["completion_tokens"] >= 3  # summed over choices
         texts = [c["text"] for c in out["choices"]]
         assert len(set(texts)) > 1, texts  # seed+i: not three copies
         # choice 0 reproduces a plain n=1 run with the same seed.
-        solo = post({"model": "test:tiny", "prompt": "n test", "max_tokens": 8,
-                     "temperature": 0.9, "seed": 5})
+        solo = _post(srv, {"model": "test:tiny", "prompt": "n test", "max_tokens": 8,
+                           "temperature": 0.9, "seed": 5})
         assert solo["choices"][0]["text"] == texts[0]
 
         # Streaming n=2: chunks carry per-choice indices; final usage sums.
-        req = urllib.request.Request(
-            f"http://127.0.0.1:{srv.port}/v1/completions",
-            data=json.dumps({"model": "test:tiny", "prompt": "n stream", "max_tokens": 4,
-                             "temperature": 0.8, "seed": 9, "n": 2, "stream": True}).encode(),
-            headers={"Content-Type": "application/json"},
-        )
         seen_idx = set()
         usage = None
-        with urllib.request.urlopen(req, timeout=180) as resp:
-            for line in resp:
-                line = line.decode().strip()
-                if not line.startswith("data: ") or line == "data: [DONE]":
-                    continue
-                d = json.loads(line[6:])
-                for c in d.get("choices", []):
-                    seen_idx.add(c["index"])
-                if "usage" in d:
-                    usage = d["usage"]
+        for d in _post(srv, {"model": "test:tiny", "prompt": "n stream", "max_tokens": 4,
+                             "temperature": 0.8, "seed": 9, "n": 2, "stream": True},
+                       stream=True):
+            for c in d.get("choices", []):
+                seen_idx.add(c["index"])
+            if "usage" in d:
+                usage = d["usage"]
         assert seen_idx == {0, 1}
         assert usage and usage["completion_tokens"] >= 2
+    finally:
+        srv.stop()
+
+
+def test_echo_prepends_prompt():
+    """OpenAI `echo` (completions): response text = prompt + completion,
+    in both full and streaming modes; chat ignores it."""
+    import json
+    import urllib.request
+
+    from kubeai_tpu.engine.server import EngineServer
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=(16, 32))
+    )
+    srv = EngineServer(eng, model_name="test:tiny", host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        base = {"model": "test:tiny", "prompt": "echo me", "max_tokens": 4,
+                "temperature": 0.0}
+        plain = _post(srv, base)["choices"][0]["text"]
+        echoed = _post(srv, {**base, "echo": True})["choices"][0]["text"]
+        assert echoed == "echo me" + plain
+        streamed = "".join(
+            c.get("text", "")
+            for d in _post(srv, {**base, "echo": True, "stream": True}, stream=True)
+            for c in d.get("choices", [])
+        )
+        assert streamed.startswith("echo me")
     finally:
         srv.stop()
