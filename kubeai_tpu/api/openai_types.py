@@ -44,13 +44,15 @@ def _check_number(data: dict, field: str, lo=None, hi=None) -> None:
         _check(v <= hi, f"'{field}' must be <= {hi}")
 
 
-def _check_int(data: dict, field: str, lo=None) -> None:
+def _check_int(data: dict, field: str, lo=None, hi=None) -> None:
     v = data.get(field)
     if v is None:
         return
     _check(isinstance(v, int) and not isinstance(v, bool), f"'{field}' must be an integer")
     if lo is not None:
         _check(v >= lo, f"'{field}' must be >= {lo}")
+    if hi is not None:
+        _check(v <= hi, f"'{field}' must be <= {hi}")
 
 
 def _check_stop(data: dict) -> None:
@@ -73,6 +75,7 @@ def _check_sampling(data: dict) -> None:
     _check_int(data, "n", lo=1)
     _check_int(data, "seed")
     _check_int(data, "top_k", lo=0)
+    _check_int(data, "top_logprobs", lo=0, hi=20)
     lb = data.get("logit_bias")
     if lb is not None:
         _check(isinstance(lb, dict), "'logit_bias' must be an object")
@@ -184,7 +187,6 @@ class ChatCompletionRequest(_Body):
         if tc is not None:
             _check(isinstance(tc, (str, dict)),
                    "'tool_choice' must be a string or object")
-        _check_int(self.data, "top_logprobs", lo=0)
         _check_sampling(self.data)
 
     def prefix(self, n: int) -> str:

@@ -139,6 +139,11 @@ class EngineConfig:
     # 300; requests beyond this keep the first N entries). Static shape
     # — the bias arrays ride every dispatch regardless of use.
     max_logit_bias: int = 32
+    # Top-N alternative logprobs computed per choice point (one extra
+    # lax.top_k over the vocab per step — same order of work as the
+    # sampler's candidate top_k). Requests can ask for at most this many
+    # (OpenAI caps completions logprobs at 5, chat top_logprobs at 20).
+    top_logprobs_k: int = 5
 
 
 @dataclass
@@ -154,7 +159,7 @@ class Request:
     params: SamplingParams
     adapter: str | None = None
     out: "queue.Queue[Any]" = field(default_factory=queue.Queue)
-    # events on `out`: ("token", id, text_delta, logprob) |
+    # events on `out`: ("token", id, text_delta, logprob, top) |
     # ("done", FinishInfo) | ("error", message). id -1 = text-only flush
     # (held-back chars; logprob None).
     cancelled: threading.Event = field(default_factory=threading.Event)
@@ -419,6 +424,7 @@ class Engine:
             return logits
 
         mtk = self.cfg.max_top_k
+        topn = max(1, self.cfg.top_logprobs_k)
 
         def prefill_batch_fn(params, tokens, lengths, tables, slots, seeds, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_rows=None):
             """Cold prefill for N requests in ONE call (N is a static pad
@@ -442,11 +448,11 @@ class Engine:
                 apply_logit_bias(masked, bias_ids, bias_vals),
                 keys, temp, top_p, top_k, max_top_k=mtk,
             )
-            lps = jnp.take_along_axis(
-                jax.nn.log_softmax(masked, axis=-1), toks[:, None], axis=1
-            )[:, 0]
+            logp = jax.nn.log_softmax(masked, axis=-1)
+            lps = jnp.take_along_axis(logp, toks[:, None], axis=1)[:, 0]
+            t_lp, t_ids = jax.lax.top_k(logp, topn)
             adm_toks = adm_toks.at[slots].set(toks)
-            return toks, lps, cache, adm_toks
+            return toks, lps, t_ids.astype(jnp.int32), t_lp, cache, adm_toks
 
         def prefill_chunk_fn(params, tokens, start, last_idx, table, slot, seed, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_row=None):
             """One chunk of a long or prefix-resuming prompt."""
@@ -461,9 +467,11 @@ class Engine:
                 apply_logit_bias(masked, bias_ids[None], bias_vals[None]),
                 key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk,
             )[0]
-            lp = jax.nn.log_softmax(masked, axis=-1)[0, tok]
+            logp = jax.nn.log_softmax(masked, axis=-1)
+            lp = logp[0, tok]
+            t_lp, t_ids = jax.lax.top_k(logp[0], topn)
             adm_toks = adm_toks.at[slot].set(tok)
-            return tok, lp, cache, adm_toks
+            return tok, lp, t_ids.astype(jnp.int32), t_lp, cache, adm_toks
 
         K = self.cfg.decode_chunk
         G = self.cfg.speculate_tokens
@@ -611,6 +619,10 @@ class Engine:
                     jnp.take_along_axis(logits_at_a, corr[:, None], axis=1)[:, 0]
                     - jnp.take_along_axis(lse, acc[:, None], axis=1)[:, 0]
                 )
+                # Top-N alternatives per position (raw model dist, pre-
+                # penalty/bias — same contract as the chosen logprob).
+                t_raw, t_ids = jax.lax.top_k(logits, topn)  # [B, G+1, N]
+                t_lp = t_raw - lse[..., None]
                 # Record the inputs just written into KV at positions
                 # lengths..lengths+G (history width covers overshoot).
                 pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
@@ -618,13 +630,18 @@ class Engine:
                     jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
                 )
                 lengths = jnp.where(active, lengths + acc + 1, lengths)
-                return (cache, hist, lengths, corr, step_keys[:, 1]), (drafts, corr, acc, lp_d, lp_corr)
+                return (cache, hist, lengths, corr, step_keys[:, 1]), (
+                    drafts, corr, acc, lp_d, lp_corr,
+                    t_ids.astype(jnp.int32), t_lp,
+                )
 
-            (cache, hist, lengths, last, keys), (d_seq, c_seq, a_seq, lpd_seq, lpc_seq) = jax.lax.scan(
+            (cache, hist, lengths, last, keys), (
+                d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
+            ) = jax.lax.scan(
                 body, (cache, hist, lengths, last_tokens, keys), None, length=K
             )
             return (
-                d_seq, c_seq, a_seq, lpd_seq, lpc_seq,
+                d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
                 cache, hist, lengths, last, jax.random.key_data(keys),
             )
 
@@ -648,9 +665,9 @@ class Engine:
                 for k, s in paged_cache_specs().items()
             }
             shard_kw = {
-                "out_shardings": (repl, repl, repl, repl, repl, cache_sh, repl, repl, repl, repl)
+                "out_shardings": (repl, repl, repl, repl, repl, repl, repl, cache_sh, repl, repl, repl, repl)
             }
-            chunk_kw = {"out_shardings": (repl, repl, cache_sh, repl)}
+            chunk_kw = {"out_shardings": (repl, repl, repl, repl, cache_sh, repl)}
         self._prefill_chunk_jit = jax.jit(
             prefill_chunk_fn, donate_argnums=(12, 13), **chunk_kw
         )
@@ -1072,7 +1089,7 @@ class Engine:
                     {"adm_hist": ar["adm_hist"]} if self.cfg.speculate_tokens > 0 else {}
                 )
                 (
-                    _, _, _, _, _,
+                    _, _, _, _, _, _, _,
                     self._cache, self._tok_hist, self._lengths,
                     self._last_tokens, self._keys,
                 ) = self._decode_jit(
@@ -1086,7 +1103,7 @@ class Engine:
                 )
             elif op == "prefill_batch":
                 lora_args = self._follower_lora(ar)
-                _, _, self._cache, self._adm_toks = self._prefill_batch_jit(
+                _, _, _, _, self._cache, self._adm_toks = self._prefill_batch_jit(
                     self.params, ar["tokens"], ar["lengths"], ar["tables"],
                     ar["slots"], ar["seeds"], ar["temps"], ar["top_ps"],
                     ar["top_ks"], ar["bias_ids"], ar["bias_vals"],
@@ -1103,7 +1120,7 @@ class Engine:
                         "lora": self._adapters.bank,
                         "lora_row": np.int32(sc["lora_row"]),
                     }
-                _, _, self._cache, self._adm_toks = self._prefill_chunk_jit(
+                _, _, _, _, self._cache, self._adm_toks = self._prefill_chunk_jit(
                     self.params, ar["tokens"], np.int32(sc["start"]),
                     np.int32(sc["last_idx"]), ar["table"], np.int32(sc["slot"]),
                     np.uint32(sc["seed"]), np.float32(sc["temperature"]),
@@ -1337,17 +1354,26 @@ class Engine:
         for client streaming only and overlaps device compute)."""
         if not admitted:
             return
-        toks, lps = jax.device_get(
-            ([t for _, _, t, _, _ in admitted], [l for _, _, _, _, l in admitted])
-        )
-        for (slot_idx, epoch, _, j, _), tarr, larr in zip(admitted, toks, lps):
+        toks, lps, tids, tlps = jax.device_get((
+            [a[2] for a in admitted], [a[4] for a in admitted],
+            [a[5] for a in admitted], [a[6] for a in admitted],
+        ))
+        for (slot_idx, epoch, _, j, *_), tarr, larr, tid, tlp in zip(
+            admitted, toks, lps, tids, tlps
+        ):
             tok = int(tarr if j is None else tarr[j])
             lp = float(larr if j is None else larr[j])
             if self._slot_epoch[slot_idx] == epoch:
                 # This token is what the next decode step writes.
                 self._kv_pending[slot_idx] = tok
-            if self._slots[slot_idx] is not None and self._slot_epoch[slot_idx] == epoch:
-                self._emit_token(slot_idx, tok, lp)
+            slot = self._slots[slot_idx]
+            if slot is not None and self._slot_epoch[slot_idx] == epoch:
+                top = None
+                if slot.req.params.logprobs:
+                    row = tid if j is None else tid[j]
+                    lrow = tlp if j is None else tlp[j]
+                    top = list(zip(row.tolist(), lrow.tolist()))
+                self._emit_token(slot_idx, tok, lp, top)
 
     def _lora_sig(self, adapter: str | None) -> tuple[int, int]:
         if self._adapters is None:
@@ -1473,7 +1499,7 @@ class Engine:
                     "bias_ids": bias_ids, "bias_vals": bias_vals,
                 },
             ):
-                tok, lp, self._cache, self._adm_toks = self._prefill_chunk_jit(
+                tok, lp, t_ids, t_lp, self._cache, self._adm_toks = self._prefill_chunk_jit(
                     self.params,
                     chunk_padded,
                     np.int32(start),
@@ -1492,7 +1518,7 @@ class Engine:
                 )
 
         self._register(slot_idx, req, seed, lora_row, reuse)
-        return (slot_idx, self._slot_epoch[slot_idx], tok, None, lp)
+        return (slot_idx, self._slot_epoch[slot_idx], tok, None, lp, t_ids, t_lp)
 
     def _bias_rows(self, sp: SamplingParams) -> tuple[np.ndarray, np.ndarray]:
         """A request's logit_bias as fixed-width (ids, vals) rows
@@ -1626,7 +1652,7 @@ class Engine:
                 **({"lora_rows": lora_rows_arr} if self._adapters is not None else {}),
             },
         ):
-            toks, lps, self._cache, self._adm_toks = self._prefill_batch_jit(
+            toks, lps, t_ids, t_lp, self._cache, self._adm_toks = self._prefill_batch_jit(
                 self.params,
                 tokens,
                 lengths,
@@ -1645,7 +1671,7 @@ class Engine:
         out = []
         for j, (slot_idx, req) in enumerate(items):
             self._register(slot_idx, req, seeds[j], int(lora_rows_arr[j]), reuse=0)
-            out.append((slot_idx, self._slot_epoch[slot_idx], toks, j, lps))
+            out.append((slot_idx, self._slot_epoch[slot_idx], toks, j, lps, t_ids, t_lp))
         return out
 
     def _dispatch_chunk(self):
@@ -1678,7 +1704,7 @@ class Engine:
             },
         ):
             (
-                d_seq, c_seq, a_seq, lpd_seq, lpc_seq,
+                d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
                 self._cache, self._tok_hist, self._lengths, self._last_tokens, self._keys,
             ) = self._decode_jit(
                 self.params,
@@ -1708,10 +1734,23 @@ class Engine:
         snapshot = [
             (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        return (d_seq, c_seq, a_seq, lpd_seq, lpc_seq), snapshot
+        return (d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq), snapshot
 
     def _process_chunk(self, payload, snapshot):
-        drafts, corr, acc, lp_d, lp_c = jax.device_get(payload)
+        # The top-N alternative arrays are fetched only when some slot in
+        # this chunk's snapshot asked for logprobs: the device compute is
+        # part of the static graph either way, but the host transfer
+        # (~hundreds of KB per chunk at high slots) is gateable.
+        any_top = any(
+            s_obj.req.params.logprobs for _, s_obj, _ in snapshot
+        )
+        if any_top:
+            drafts, corr, acc, lp_d, lp_c, t_ids, t_lp = jax.device_get(payload)
+            t_ids = np.asarray(t_ids)  # [K, B, G+1, N] top-N alternative ids
+            t_lp = np.asarray(t_lp)  # [K, B, G+1, N]
+        else:
+            drafts, corr, acc, lp_d, lp_c = jax.device_get(payload[:5])
+            t_ids = t_lp = None
         drafts = np.asarray(drafts)  # [K, B, G]
         corr = np.asarray(corr)  # [K, B]
         acc = np.asarray(acc)  # [K, B]
@@ -1721,18 +1760,31 @@ class Engine:
         for k in range(acc.shape[0]):
             for i, slot_obj, epoch in snapshot:
                 a = int(acc[k, i])
+                want_top = (
+                    t_ids is not None
+                    and self._slots[i] is slot_obj
+                    and slot_obj.req.params.logprobs
+                )
+
+                def top_at(pos):
+                    if not want_top:
+                        return None
+                    return list(zip(t_ids[k, i, pos].tolist(), t_lp[k, i, pos].tolist()))
+
                 # Accepted drafts then the device-chosen next token (the
                 # model's continuation input — greedy argmax OR sampled),
-                # each with its logprob under the model.
+                # each with its logprob under the model. Position j's
+                # top-N is the model's distribution at that choice point.
                 emitted = [
-                    (int(drafts[k, i, j]), float(lp_d[k, i, j])) for j in range(a)
+                    (int(drafts[k, i, j]), float(lp_d[k, i, j]), top_at(j))
+                    for j in range(a)
                 ]
-                emitted.append((int(corr[k, i]), float(lp_c[k, i])))
+                emitted.append((int(corr[k, i]), float(lp_c[k, i]), top_at(a)))
                 if G and self._slots[i] is slot_obj \
                         and slot_obj.req.params.temperature <= 0.0:
                     self.m_spec_drafted.inc(G)
                     self.m_spec_accepted.inc(a)
-                for tok, lp in emitted:
+                for tok, lp, top in emitted:
                     # Record KV residency for prefix reuse: each step
                     # WROTE its pending (input) token; each emitted token
                     # becomes the next write. Skip if a new occupant
@@ -1746,12 +1798,15 @@ class Engine:
                     # mid-chunk, or have been freed and re-admitted
                     # since dispatch).
                     if self._slots[i] is slot_obj:
-                        self._emit_token(i, tok, lp)
+                        self._emit_token(i, tok, lp, top)
 
-    def _emit_token(self, slot_idx: int, token_id: int, logprob: float | None = None):
+    def _emit_token(self, slot_idx: int, token_id: int, logprob: float | None = None, top=None):
         """Deliver one generated token to the request; apply stop logic.
-        Events are ("token", id, text_delta, logprob) — the logprob is
-        the model's log p(token | prefix) (None for text-only flushes)."""
+        Events are ("token", id, text_delta, logprob, top) — the logprob
+        is the model's log p(token | prefix) (None for text-only
+        flushes); *top* is the model's top-N alternatives at that choice
+        point as [(token_id, logprob), ...] when the request asked for
+        logprobs, else None."""
         slot = self._slots[slot_idx]
         req = slot.req
         if req.cancelled.is_set():
@@ -1780,14 +1835,14 @@ class Engine:
             if pos != -1:
                 tail = text[slot.delivered_chars : pos]
                 slot.delivered_chars = pos
-                req.out.put(("token", token_id, tail, logprob))
+                req.out.put(("token", token_id, tail, logprob, top))
                 self._free(slot_idx, "stop", flush=False)
                 return
 
         emit_upto = max(len(text) - slot.holdback, slot.delivered_chars)
         delta = text[slot.delivered_chars : emit_upto]
         slot.delivered_chars = emit_upto
-        req.out.put(("token", token_id, delta, logprob))
+        req.out.put(("token", token_id, delta, logprob, top))
 
         if slot.generated >= slot.budget:
             self._free(slot_idx, "length")
@@ -1817,7 +1872,7 @@ class Engine:
                         reason = "stop"
                 tail = text[slot.delivered_chars : end]
                 if tail:
-                    slot.req.out.put(("token", -1, tail, None))
+                    slot.req.out.put(("token", -1, tail, None, None))
             slot.req.out.put(
                 ("done", FinishInfo(reason, slot.prompt_len, slot.generated))
             )

@@ -325,6 +325,28 @@ def _make_handler(srv: EngineServer):
                         400, "logit_bias requires token ids >= 0 and finite values"
                     )
                 logit_bias.append((tok_id, max(-100.0, min(100.0, val))))
+            # OpenAI logprobs: completions spells it `logprobs: <int>` (0
+            # is a VALID request: chosen-token logprobs with zero
+            # alternatives), chat spells it `logprobs: true` with the
+            # alternative count in `top_logprobs`.
+            lp_field = body.get("logprobs")
+            want_logprobs = lp_field is not None and lp_field is not False
+            if chat:
+                top_n = body.get("top_logprobs") or 0
+                if top_n and not want_logprobs:
+                    return self._error(
+                        400, "logprobs must be set to true if top_logprobs is used"
+                    )
+            else:
+                top_n = lp_field if isinstance(lp_field, int) and not isinstance(lp_field, bool) else 0
+            if not isinstance(top_n, int) or isinstance(top_n, bool) or top_n < 0:
+                return self._error(400, "top_logprobs must be a non-negative integer")
+            if top_n > srv.engine.cfg.top_logprobs_k:
+                return self._error(
+                    400,
+                    f"at most {srv.engine.cfg.top_logprobs_k} alternative "
+                    "logprobs are supported on this engine",
+                )
             params = SamplingParams(
                 temperature=float(num("temperature", 1.0)),
                 top_p=float(num("top_p", 1.0)),
@@ -332,6 +354,7 @@ def _make_handler(srv: EngineServer):
                 max_tokens=int(max_tokens),
                 stop=tuple(stop),
                 seed=body.get("seed"),
+                logprobs=want_logprobs,
                 presence_penalty=float(num("presence_penalty", 0.0)),
                 frequency_penalty=float(num("frequency_penalty", 0.0)),
                 logit_bias=tuple(logit_bias),
@@ -376,13 +399,6 @@ def _make_handler(srv: EngineServer):
 
             rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
             created = int(time.time())
-            # OpenAI logprobs: completions spells it `logprobs: <int>`
-            # (0 is a VALID request: chosen-token logprobs with zero
-            # alternatives), chat spells it `logprobs: true`. Chosen-token
-            # logprobs are returned; top-N alternatives are not
-            # (documented).
-            lp_field = body.get("logprobs")
-            want_logprobs = lp_field is not None and lp_field is not False
             # OpenAI `echo` (completions only): prepend the prompt text
             # to every choice. Prompt logprobs are not computed
             # (documented limit, like top-N alternatives).
@@ -396,9 +412,9 @@ def _make_handler(srv: EngineServer):
                     else self._decode_safe(prompt_ids)
                 )
             if body.get("stream"):
-                self._stream_response(reqs, rid, created, chat, want_logprobs, echo_text)
+                self._stream_response(reqs, rid, created, chat, want_logprobs, echo_text, top_n)
             else:
-                self._full_response(reqs, rid, created, chat, want_logprobs, echo_text)
+                self._full_response(reqs, rid, created, chat, want_logprobs, echo_text, top_n)
 
         def _decode_safe(self, ids) -> str:
             try:
@@ -412,7 +428,28 @@ def _make_handler(srv: EngineServer):
             detokenizer holds back partial UTF-8 / stop-string windows."""
             return self._decode_safe([token_id])
 
-        def _full_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text=""):
+        def _top_entries(self, top, top_n, chat):
+            """Format the engine's [(token_id, logprob), ...] top-N for
+            the OpenAI response shape (chat: list of objects; legacy
+            completions: token-text -> logprob map)."""
+            if not top_n or not top:
+                return None
+            pairs = top[:top_n]
+            if chat:
+                return [
+                    {"token": self._token_text(tid), "logprob": lp}
+                    for tid, lp in pairs
+                ]
+            # Legacy completions shape is a text->logprob map: distinct
+            # token ids can decode to the SAME text (byte fallbacks), and
+            # pairs arrive sorted best-first — keep the best per text
+            # rather than letting a later worse entry overwrite it.
+            out = {}
+            for tid, lp in pairs:
+                out.setdefault(self._token_text(tid), lp)
+            return out
+
+        def _full_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0):
             choices = []
             prompt_tokens = 0
             completion_tokens = 0
@@ -427,7 +464,11 @@ def _make_handler(srv: EngineServer):
                     if ev[0] == "token":
                         chunks.append(ev[2])
                         if ev[1] >= 0:  # -1 marks a text-only flush
-                            pieces.append((ev[1], ev[3] if len(ev) > 3 else None))
+                            pieces.append((
+                                ev[1],
+                                ev[3] if len(ev) > 3 else None,
+                                ev[4] if len(ev) > 4 else None,
+                            ))
                     elif ev[0] == "done":
                         fin = ev[1]
                         break
@@ -444,22 +485,27 @@ def _make_handler(srv: EngineServer):
                         "finish_reason": fin.reason,
                     }
                     if want_logprobs:
-                        choice["logprobs"] = {
-                            "content": [
-                                {"token": self._token_text(tid), "logprob": lp}
-                                for tid, lp in pieces
-                                if lp is not None
-                            ]
-                        }
+                        content = []
+                        for tid, lp, top in pieces:
+                            if lp is None:
+                                continue
+                            entry = {"token": self._token_text(tid), "logprob": lp}
+                            if top_n:
+                                entry["top_logprobs"] = self._top_entries(top, top_n, chat) or []
+                            content.append(entry)
+                        choice["logprobs"] = {"content": content}
                 else:
                     choice = {"index": idx, "text": echo_text + text, "finish_reason": fin.reason}
                     if want_logprobs:
+                        kept = [(tid, lp, top) for tid, lp, top in pieces if lp is not None]
                         choice["logprobs"] = {
-                            "tokens": [self._token_text(tid) for tid, lp in pieces if lp is not None],
-                            "token_logprobs": [lp for _, lp in pieces if lp is not None],
-                            # Top-N alternatives are not computed (chosen-
-                            # token logprobs only).
-                            "top_logprobs": None,
+                            "tokens": [self._token_text(tid) for tid, _, _ in kept],
+                            "token_logprobs": [lp for _, lp, _ in kept],
+                            "top_logprobs": (
+                                [self._top_entries(top, top_n, chat) or {} for _, _, top in kept]
+                                if top_n
+                                else None
+                            ),
                         }
                 choices.append(choice)
             usage = {
@@ -473,7 +519,7 @@ def _make_handler(srv: EngineServer):
                 "model": srv.model_name, "choices": choices, "usage": usage,
             })
 
-        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text=""):
+        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -559,22 +605,25 @@ def _make_handler(srv: EngineServer):
                         )
                         if not ev[2] and not has_lp:
                             continue
+                        top = ev[4] if len(ev) > 4 else None
                         if chat:
                             choice = {"index": idx, "delta": {"content": ev[2]}, "finish_reason": None}
                             if has_lp:
-                                choice["logprobs"] = {
-                                    "content": [{
-                                        "token": self._token_text(ev[1]),
-                                        "logprob": ev[3],
-                                    }]
-                                }
+                                entry = {"token": self._token_text(ev[1]), "logprob": ev[3]}
+                                if top_n:
+                                    entry["top_logprobs"] = self._top_entries(top, top_n, chat) or []
+                                choice["logprobs"] = {"content": [entry]}
                         else:
                             choice = {"index": idx, "text": ev[2], "finish_reason": None}
                             if has_lp:
                                 choice["logprobs"] = {
                                     "tokens": [self._token_text(ev[1])],
                                     "token_logprobs": [ev[3]],
-                                    "top_logprobs": None,
+                                    "top_logprobs": (
+                                        [self._top_entries(top, top_n, chat) or {}]
+                                        if top_n
+                                        else None
+                                    ),
                                 }
                         send_chunk(json.dumps({
                             "id": rid, "object": obj, "created": created,

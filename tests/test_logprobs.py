@@ -180,3 +180,39 @@ def test_streaming_logprobs(server):
                 lps.append(c["logprob"])
     assert len(lps) == 3
     assert all(lp <= 0.0 for lp in lps)
+
+
+def test_top_logprobs_completions_and_chat(server):
+    """OpenAI top-N alternatives (r5: previously a documented gap):
+    completions `logprobs: N` returns per-position token->logprob maps
+    of size <= N whose best entry is at least the chosen logprob; chat
+    `top_logprobs: N` returns entry lists; N beyond the engine cap 400s."""
+    out = _post(server.port, "/v1/completions", {
+        "model": "m", "prompt": "top lp", "max_tokens": 4,
+        "temperature": 0.0, "logprobs": 3,
+    })
+    lp = out["choices"][0]["logprobs"]
+    assert len(lp["top_logprobs"]) == len(lp["token_logprobs"]) >= 1
+    for chosen_lp, top in zip(lp["token_logprobs"], lp["top_logprobs"]):
+        assert 1 <= len(top) <= 3
+        best = max(top.values())
+        assert best >= chosen_lp - 1e-5
+    out = _post(server.port, "/v1/chat/completions", {
+        "model": "m", "messages": [{"role": "user", "content": "hi"}],
+        "max_tokens": 4, "temperature": 0.0,
+        "logprobs": True, "top_logprobs": 2,
+    })
+    content = out["choices"][0]["logprobs"]["content"]
+    assert content and all(
+        1 <= len(e["top_logprobs"]) <= 2 and "token" in e["top_logprobs"][0]
+        for e in content
+    )
+    # Greedy: the chosen token IS the argmax, so it heads the top list.
+    assert content[0]["top_logprobs"][0]["logprob"] == content[0]["logprob"]
+    import urllib.error
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        _post(server.port, "/v1/completions", {
+            "model": "m", "prompt": "x", "max_tokens": 2, "logprobs": 50,
+        })
+    assert ei.value.code == 400
