@@ -411,8 +411,17 @@ def _make_handler(srv: EngineServer):
                     prompt_text if prompt_text is not None
                     else self._decode_safe(prompt_ids)
                 )
+            so = body.get("stream_options")
+            if so is not None and not isinstance(so, dict):
+                return self._error(400, "stream_options must be an object")
+            if so is not None and not body.get("stream"):
+                return self._error(400, "stream_options requires stream: true")
+            so = so or {}
             if body.get("stream"):
-                self._stream_response(reqs, rid, created, chat, want_logprobs, echo_text, top_n)
+                self._stream_response(
+                    reqs, rid, created, chat, want_logprobs, echo_text, top_n,
+                    include_usage=bool(so.get("include_usage")),
+                )
             else:
                 self._full_response(reqs, rid, created, chat, want_logprobs, echo_text, top_n)
 
@@ -519,7 +528,7 @@ def _make_handler(srv: EngineServer):
                 "model": srv.model_name, "choices": choices, "usage": usage,
             })
 
-        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0):
+        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0, include_usage=False):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -643,13 +652,20 @@ def _make_handler(srv: EngineServer):
                             "id": rid, "object": obj, "created": created,
                             "model": srv.model_name, "choices": [choice],
                         }
-                        if remaining == 0:
-                            payload["usage"] = {
-                                "prompt_tokens": prompt_tokens,
-                                "completion_tokens": completion_tokens,
-                                "total_tokens": prompt_tokens + completion_tokens,
-                            }
                         send_chunk(json.dumps(payload))
+                        if remaining == 0 and include_usage:
+                            # OpenAI stream_options semantics: usage
+                            # arrives as its own final chunk with EMPTY
+                            # choices (SDK consumers key on that shape).
+                            send_chunk(json.dumps({
+                                "id": rid, "object": obj, "created": created,
+                                "model": srv.model_name, "choices": [],
+                                "usage": {
+                                    "prompt_tokens": prompt_tokens,
+                                    "completion_tokens": completion_tokens,
+                                    "total_tokens": prompt_tokens + completion_tokens,
+                                },
+                            }))
                         if remaining == 0:
                             send_chunk("[DONE]")
                             self.wfile.write(b"0\r\n\r\n")

@@ -147,6 +147,7 @@ class TestHTTP:
                     "max_tokens": 5,
                     "temperature": 0,
                     "stream": True,
+                    "stream_options": {"include_usage": True},
                 }
             ).encode(),
             headers={"Content-Type": "application/json"},
@@ -162,8 +163,11 @@ class TestHTTP:
         assert events[-1] == "[DONE]"
         parsed = [json.loads(e) for e in events[:-1]]
         assert parsed[0]["choices"][0]["delta"]["role"] == "assistant"
-        finals = [p for p in parsed if p["choices"][0].get("finish_reason")]
-        assert finals and "usage" in finals[-1]
+        finals = [p for p in parsed if p["choices"] and p["choices"][0].get("finish_reason")]
+        assert finals
+        # Usage arrives as its own empty-choices chunk (OpenAI shape).
+        usage_chunks = [p for p in parsed if not p["choices"]]
+        assert usage_chunks and usage_chunks[-1]["usage"]["completion_tokens"] >= 1
 
     def test_validation_errors(self, server):
         status, body = post(server, "/v1/completions", {"model": "m"})

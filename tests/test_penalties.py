@@ -174,12 +174,13 @@ def test_n_choices_over_http():
         seen_idx = set()
         usage = None
         for d in _post(srv, {"model": "test:tiny", "prompt": "n stream", "max_tokens": 4,
-                             "temperature": 0.8, "seed": 9, "n": 2, "stream": True},
+                             "temperature": 0.8, "seed": 9, "n": 2, "stream": True,
+                             "stream_options": {"include_usage": True}},
                        stream=True):
             for c in d.get("choices", []):
                 seen_idx.add(c["index"])
-            if "usage" in d:
-                usage = d["usage"]
+            if not d.get("choices") and "usage" in d:
+                usage = d["usage"]  # the empty-choices usage chunk
         assert seen_idx == {0, 1}
         assert usage and usage["completion_tokens"] >= 2
     finally:
