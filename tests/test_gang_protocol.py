@@ -420,3 +420,28 @@ class TestAssemblyCountsProvenRanksOnly:
         fol1.close()
         out["fol"].close()
         pub.close()
+
+
+def test_penalized_and_biased_generation_replays(pair):
+    """r5 dispatch-key additions (presence/freq/gen_start/bias arrays)
+    ride the lockstep stream: a penalized+biased generation must leave
+    follower device carries bit-identical to the leader's."""
+    leader, follower, _ = pair
+    ids, _, fin = leader.generate(
+        list(range(1, 20)),
+        SamplingParams(
+            temperature=0.0, max_tokens=10,
+            presence_penalty=1.0, frequency_penalty=1.5,
+            logit_bias=((7, -100.0),),
+        ),
+        timeout=120,
+    )
+    assert fin.completion_tokens >= 1
+    assert 7 not in ids  # bias honored on the leader
+    want = np.asarray(jax.device_get(leader._lengths))
+    got = _sync(lambda: follower._lengths, want)
+    np.testing.assert_array_equal(got, want)
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(follower._last_tokens)),
+        np.asarray(jax.device_get(leader._last_tokens)),
+    )
