@@ -14,6 +14,19 @@ so the top cost is attributable before touching engine code
 guesses).
 
 Usage: python benchmarks/profile_engine.py [--preset 1.3b|8b-int8] [--paths gather,paged]
+
+--sweep: kernel-level decode-attention microbench — per-step latency of
+the paged attention call ALONE (weights out of the picture, so the
+attention term of the 96-slot cliff is measured in isolation), swept
+over slot counts x ragged-kernel block shapes (KUBEAI_PAGED_KERNEL_BLOCK
+grid) x the dedicated decode kernel, emitted as one JSON document with
+grid-utilization diagnosis fields per config. `--smoke` shrinks shapes
+so the identical harness runs on CPU in CI (timings are then reference-
+implementation numbers — structure and relative trends only, labeled as
+such in the output). See docs/benchmarks.md ("the 96-slot cliff").
+
+Usage: python benchmarks/profile_engine.py --sweep [--smoke] [--out f.json]
+           [--sweep-slots 16,48,64,96] [--sweep-blocks default,8:32,16:32]
 """
 
 import argparse
@@ -43,13 +56,217 @@ def timeit(fn, n=10, warmup=2):
     return (time.monotonic() - t0) / n
 
 
+def _sweep_shapes(smoke: bool) -> dict:
+    """Attention shapes for the kernel microbench. Full mode mirrors the
+    8b-int8 flagship preset's attention dims at 1024-token tables (the
+    config the 96-slot cliff was measured on); smoke shrinks every axis
+    so the identical harness runs on CPU in CI seconds."""
+    if smoke:
+        return dict(H=4, Kv=2, h=128, page=16, seq=64, iters=3, warmup=1)
+    return dict(H=32, Kv=8, h=128, page=64, seq=1024, iters=20, warmup=3)
+
+
+def run_sweep(
+    slots_list=(16, 48, 64, 96),
+    blocks=("default", "8:32", "16:32", "32:8", "64:4"),
+    smoke=False,
+    qlen=1,
+    seed=0,
+):
+    """Kernel-level decode-attention microbench: per-step latency of ONE
+    paged-attention call (per layer, S=qlen queries per slot) for every
+    (kernel, block, slots) combination. Returns the JSON-able document.
+
+    What the numbers attribute (the 96-slot cliff diagnosis):
+      - If the RAGGED kernel's latency is flat in `slots` at S=1, its
+        grid has collapsed (all B queries fit one query block — grid
+        underutilization): more slots add work per program, not more
+        programs, and past the VMEM-resident span the serial page walk
+        dominates — latency then jumps superlinearly (the cliff shape).
+      - The DEDICATED kernel's grid is Kv x slots x pages: programs
+        scale with slots by construction, so its latency-vs-slots curve
+        separates grid effects from raw page-walk bandwidth.
+      - `grid_programs` / `q_rows_per_program` per row are the derived
+        utilization facts; `kv_mb_walked` is the per-call page traffic
+        (identical across kernels at equal slots — any latency delta at
+        equal traffic is scheduling, not bandwidth).
+
+    CPU runs (smoke or no accelerator) time the REFERENCE
+    implementations — structure and relative trends only, and the
+    emitted document says so (`degraded: true`).
+    """
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kubeai_tpu.ops.paged_attention import paged_attention_ragged
+    from kubeai_tpu.ops.paged_decode_attention import paged_decode_attention
+
+    sh = _sweep_shapes(smoke)
+    H, Kv, h, page, seq = sh["H"], sh["Kv"], sh["h"], sh["page"], sh["seq"]
+    iters, warmup = sh["iters"], sh["warmup"]
+    backend = jax.default_backend()
+    kind = getattr(jax.devices()[0], "device_kind", "unknown")
+    degraded = backend == "cpu"
+    rng = np.random.default_rng(seed)
+    max_pages = seq // page
+    dtype = jnp.float32 if degraded else jnp.bfloat16
+
+    results = []
+    # The block knob is trace-time global state: remember the caller's
+    # value (tuned deployments export it) and restore it afterwards —
+    # the sweep must not silently erase a live process's tuning.
+    prior_blk = os.environ.get("KUBEAI_PAGED_KERNEL_BLOCK")
+    for B in slots_list:
+        P = 1 + B * max_pages
+        q = jnp.asarray(rng.standard_normal((B, qlen, H, h)), dtype)
+        kv_pages = jnp.asarray(rng.standard_normal((P, page, 2 * Kv, h)), dtype)
+        table = np.zeros((B, max_pages), np.int32)
+        for b in range(B):
+            table[b] = np.arange(1 + b * max_pages, 1 + (b + 1) * max_pages)
+        table = jnp.asarray(table)
+        # Mid-generation lengths: tables half full (the steady-state
+        # decode regime, not the freshly-prefilled best case).
+        kv_lens = jnp.full((B,), seq // 2 + qlen, jnp.int32)
+
+        kv_mb = float(B * (seq // 2) * 2 * Kv * h * np.dtype(
+            "float32" if degraded else "bfloat16").itemsize) / 1e6
+
+        configs = [("dedicated", "slotwise")] + [("ragged", blk) for blk in blocks]
+        for kernel, blk in configs:
+            if kernel == "ragged":
+                if blk == "default":
+                    os.environ.pop("KUBEAI_PAGED_KERNEL_BLOCK", None)
+                    blk_pages = blk_queries = None
+                else:
+                    blk_pages, blk_queries = (int(x) for x in blk.split(":"))
+                    os.environ["KUBEAI_PAGED_KERNEL_BLOCK"] = f"{blk_pages},{blk_queries}"
+                # Fresh lambda per config: the env knob is read at trace
+                # time, so a shared jitted callable would silently reuse
+                # the first config's grid for every row of the table.
+                fn = jax.jit(
+                    lambda q, kv, t, l: paged_attention_ragged(q, kv, t, l)
+                )
+                # Grid math for the diagnosis columns (library default
+                # query block is prefill-tuned; at S=1 the whole batch
+                # is B*qlen rows).
+                qb = blk_queries or 32
+                programs = -(-B * qlen // qb)
+                q_rows = min(B * qlen, qb)
+            else:
+                fn = jax.jit(
+                    lambda q, kv, t, l: paged_decode_attention(q, kv, t, l)
+                )
+                programs = Kv * B  # x pages innermost
+                q_rows = qlen * (H // Kv)
+            try:
+                for _ in range(warmup):
+                    jax.block_until_ready(fn(q, kv_pages, table, kv_lens))
+                t0 = time.monotonic()
+                for _ in range(iters):
+                    out = fn(q, kv_pages, table, kv_lens)
+                jax.block_until_ready(out)
+                ms = (time.monotonic() - t0) / iters * 1e3
+                err = None
+            except Exception as e:  # pragma: no cover - TPU-side compile loss
+                ms = None
+                err = str(e)[:200]
+            row = {
+                "kernel": kernel,
+                "block": blk,
+                "slots": B,
+                "qlen": qlen,
+                "latency_ms": None if ms is None else round(ms, 4),
+                "toks_per_sec_equiv": (
+                    None if not ms else round(B * qlen / (ms / 1e3), 1)
+                ),
+                "grid_programs": programs,
+                "q_rows_per_program": q_rows,
+                "kv_mb_walked": round(kv_mb, 2),
+            }
+            if err:
+                row["error"] = err
+            results.append(row)
+            log(
+                f"sweep kernel={kernel} block={blk} slots={B}: "
+                f"{'%.3f ms' % ms if ms else 'FAILED'}"
+            )
+    if prior_blk is None:
+        os.environ.pop("KUBEAI_PAGED_KERNEL_BLOCK", None)
+    else:
+        os.environ["KUBEAI_PAGED_KERNEL_BLOCK"] = prior_blk
+    return {
+        "metric": "paged_decode_attention_sweep",
+        "backend": backend,
+        "device": str(kind),
+        "degraded": degraded,
+        "note": (
+            "CPU reference timings — relative trends only, not TPU numbers"
+            if degraded else "per-layer kernel call, mid-generation tables"
+        ),
+        "shapes": {
+            "H": H, "Kv": Kv, "head_dim": h, "page": page, "seq": seq,
+            "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+        },
+        "results": results,
+    }
+
+
 def main():
     p = argparse.ArgumentParser()
     p.add_argument("--preset", default="1.3b", choices=["1.3b", "8b-int8"])
     p.add_argument("--paths", default="gather,paged")
     p.add_argument("--chunk", type=int, default=16)
     p.add_argument("--slots", type=int, default=32)
+    p.add_argument(
+        "--sweep", action="store_true",
+        help="kernel-level decode-attention sweep (blocks x slots x "
+             "kernels) -> one JSON document; see module docstring",
+    )
+    p.add_argument(
+        "--smoke", action="store_true",
+        help="tiny shapes for the sweep (CI/CPU; labeled degraded)",
+    )
+    p.add_argument("--out", default="", help="write the sweep JSON here (default stdout)")
+    p.add_argument(
+        "--sweep-slots", default="",
+        help="comma list of slot counts (default 16,48,64,96; smoke: 2,4)",
+    )
+    p.add_argument(
+        "--sweep-blocks", default="",
+        help="comma list of ragged-kernel blocks as pages:queries or "
+             "'default' (default: default,8:32,16:32,32:8,64:4)",
+    )
+    p.add_argument(
+        "--sweep-qlen", type=int, default=1,
+        help="queries per slot (1 = plain decode; G+1 probes speculative)",
+    )
     args = p.parse_args()
+
+    if args.sweep:
+        import json
+
+        slots = (
+            tuple(int(x) for x in args.sweep_slots.split(","))
+            if args.sweep_slots
+            else ((2, 4) if args.smoke else (16, 48, 64, 96))
+        )
+        blocks = (
+            tuple(args.sweep_blocks.split(","))
+            if args.sweep_blocks
+            else (("default", "2:8") if args.smoke else ("default", "8:32", "16:32", "32:8", "64:4"))
+        )
+        doc = run_sweep(
+            slots_list=slots, blocks=blocks, smoke=args.smoke, qlen=args.sweep_qlen
+        )
+        payload = json.dumps(doc, indent=2)
+        if args.out:
+            with open(args.out, "w") as f:
+                f.write(payload + "\n")
+            log(f"sweep written to {args.out}")
+        else:
+            print(payload)
+        return
 
     import jax
 

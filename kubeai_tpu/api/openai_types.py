@@ -22,6 +22,12 @@ from typing import Any
 
 ROLES = {"system", "user", "assistant", "tool", "developer", "function"}
 
+# OpenAI's documented logit_bias entry cap. The engine's default
+# EngineConfig.max_logit_bias equals this so a request that passes proxy
+# validation can never 400 downstream at the engine server (ADVICE r5:
+# the layers previously disagreed, 300 here vs 32 there).
+LOGIT_BIAS_CAP = 300
+
 
 class ValidationError(ValueError):
     """Raised for malformed request bodies (mapped to HTTP 400)."""
@@ -79,7 +85,8 @@ def _check_sampling(data: dict) -> None:
     lb = data.get("logit_bias")
     if lb is not None:
         _check(isinstance(lb, dict), "'logit_bias' must be an object")
-        _check(len(lb) <= 300, "'logit_bias' supports at most 300 entries")
+        _check(len(lb) <= LOGIT_BIAS_CAP,
+               f"'logit_bias' supports at most {LOGIT_BIAS_CAP} entries")
         for k, v in lb.items():
             _check(
                 isinstance(v, (int, float)) and not isinstance(v, bool)

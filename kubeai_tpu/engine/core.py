@@ -135,10 +135,25 @@ class EngineConfig:
     # silently ignoring them would be worse than the ~two fused [B, V]
     # temporaries per decode step the shared graph costs).
     enable_penalties: bool = True
-    # logit_bias entries honored per request (OpenAI caps the map at
-    # 300; requests beyond this keep the first N entries). Static shape
-    # — the bias arrays ride every dispatch regardless of use.
-    max_logit_bias: int = 32
+    # Decode-path paged-attention kernel: "ragged" keeps the shared
+    # ragged kernel (prefill-tuned grid) on the decode step, "dedicated"
+    # uses ops/paged_decode_attention (S=1/G+1-specialized blocking:
+    # grid scales with kv_heads x slots instead of collapsing at one
+    # query per slot), "auto" keys on the decode query length at trace
+    # time. The RESOLVED choice rides every decode broadcast so gang
+    # followers compile the same program (lockstep contract). Default
+    # "ragged" keeps existing behavior/replay suites unchanged; only
+    # affects TPU runs (CPU dispatches to semantics twins either way).
+    decode_kernel: str = "ragged"
+    # logit_bias entries honored per request. Default equals the
+    # OpenAI/proxy cap (openai_types.LOGIT_BIAS_CAP) so proxy-valid
+    # requests can't be rejected downstream; the engine server 400s
+    # requests exceeding this cap (it does NOT silently truncate —
+    # _bias_rows' first-N drop is only a backstop for direct submit()
+    # callers). Static shape — the [B, K] bias arrays ride every decode
+    # dispatch regardless of use (~2.4 KB/slot at 300; operators can
+    # shrink it for dispatch-bandwidth-sensitive remote-TPU setups).
+    max_logit_bias: int = 300
     # Top-N alternative logprobs computed per choice point (one extra
     # lax.top_k over the vocab per step — same order of work as the
     # sampler's candidate top_k). Requests can ask for at most this many
@@ -499,7 +514,16 @@ class Engine:
 
         penalties_on = self.cfg.enable_penalties
 
-        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, bias_ids, bias_vals, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None):
+        def make_decode_fn(decode_kernel: str):
+            """Decode step builder, parameterized by the CONCRETE paged-
+            attention kernel ("ragged" | "dedicated") baked into the
+            trace. Rank 0 resolves EngineConfig.decode_kernel once and
+            broadcasts the resolution with every decode op; a follower
+            whose own config disagrees compiles the broadcast flavor
+            (gang lockstep: all ranks must run the same program)."""
+            return partial(decode_fn, _decode_kernel=decode_kernel)
+
+        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, bias_ids, bias_vals, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None, _decode_kernel="ragged"):
             """K fused decode steps, each verifying up to G drafts.
             Returns (drafts [K, B, G], corr [K, B], accepted [K, B]) —
             the host emits drafts[:a] + [corr] per slot per step, where
@@ -539,24 +563,42 @@ class Engine:
                 else:
                     drafts = jnp.zeros((B, 0), jnp.int32)
                 inputs = jnp.concatenate([last[:, None], drafts], axis=1)
+                # Record the inputs this step WRITES into KV at positions
+                # lengths..lengths+G (history width covers overshoot) —
+                # BEFORE the penalty window is read, so position
+                # `lengths` (= the previously emitted token, this step's
+                # input) is already in the history when penalties count
+                # it (ADVICE r5: computing penalties first lagged them
+                # one token — the most recent token's first immediate
+                # repeat went unpenalized, off OpenAI/vLLM semantics).
+                pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
+                hist = hist.at[jnp.arange(B)[:, None], pos].set(
+                    jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
+                )
                 logits, cache = llama.decode_speculative_paged(
                     params, mc, inputs, cache, tables, lengths,
                     lora=lora, lora_rows=lora_rows,
+                    decode_kernel=_decode_kernel,
                 )
                 logits = mask_pad(logits)  # [B, G+1, V]
                 if penalties_on:
                     # OpenAI presence/frequency penalties over the
                     # GENERATED window of the device token history —
-                    # applied to position 0 (the token being chosen this
-                    # step); penalty slots never accept drafts (below),
-                    # so positions 1..G stay penalty-free verify lanes.
+                    # [gen_start, lengths] INCLUSIVE: position `lengths`
+                    # holds this step's input (the token emitted last
+                    # step, just scattered above), so the full output so
+                    # far counts. Unaccepted-draft overshoot sits at
+                    # positions > lengths, outside the window. Applied
+                    # to position 0 (the token being chosen this step);
+                    # penalty slots never accept drafts (below), so
+                    # positions 1..G stay penalty-free verify lanes.
                     # The penalized view steers CHOICE only (argmax /
                     # sampling); reported logprobs stay the model's raw
                     # log p(token | prefix), matching how temperature /
                     # top_p shape choice without reshaping logprobs.
                     w_idx = jnp.arange(hist.shape[1], dtype=jnp.int32)[None, :]
                     pen_valid = (w_idx >= gen_start[:, None]) & (
-                        w_idx < lengths[:, None]
+                        w_idx <= lengths[:, None]
                     )
                     pen0 = apply_penalties(
                         logits[:, 0], hist, pen_valid, presence, frequency
@@ -623,12 +665,6 @@ class Engine:
                 # penalty/bias — same contract as the chosen logprob).
                 t_raw, t_ids = jax.lax.top_k(logits, topn)  # [B, G+1, N]
                 t_lp = t_raw - lse[..., None]
-                # Record the inputs just written into KV at positions
-                # lengths..lengths+G (history width covers overshoot).
-                pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
-                hist = hist.at[jnp.arange(B)[:, None], pos].set(
-                    jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
-                )
                 lengths = jnp.where(active, lengths + acc + 1, lengths)
                 return (cache, hist, lengths, corr, step_keys[:, 1]), (
                     drafts, corr, acc, lp_d, lp_corr,
@@ -677,8 +713,35 @@ class Engine:
         # tables + per-slot request state (active/temp/top_p/top_k and
         # the adm_* merge arrays) are host-authoritative numpy uploaded
         # per dispatch — not donated. cache/hist/lengths/last/keys are
-        # the device carries.
-        self._decode_jit = jax.jit(decode_fn, donate_argnums=(1, 3, 4, 5, 6), **shard_kw)
+        # the device carries. One jit per kernel flavor, built lazily
+        # (_decode_jit_for): the configured flavor compiles at warmup as
+        # before; a follower only pays for a second flavor if rank 0's
+        # broadcast actually asks for it.
+        if self.cfg.decode_kernel not in ("ragged", "dedicated", "auto"):
+            raise ValueError(
+                f"decode_kernel must be 'ragged', 'dedicated' or 'auto', "
+                f"got {self.cfg.decode_kernel!r}"
+            )
+        from kubeai_tpu.ops.paged_decode_attention import resolve_decode_kernel
+
+        self._decode_kernel = resolve_decode_kernel(
+            self.cfg.decode_kernel, 1 + self.cfg.speculate_tokens
+        )
+        self._decode_jits = {}
+        self._make_decode_jit = lambda kernel: jax.jit(
+            make_decode_fn(kernel), donate_argnums=(1, 3, 4, 5, 6), **shard_kw
+        )
+        self._decode_jit = self._decode_jit_for(self._decode_kernel)
+
+    def _decode_jit_for(self, kernel: str):
+        """The jitted decode step for a concrete kernel flavor, built on
+        first use. Keyed storage (not attributes) so a gang follower can
+        honor a broadcast flavor that differs from its local config
+        without clobbering its own."""
+        fn = self._decode_jits.get(kernel)
+        if fn is None:
+            fn = self._decode_jits[kernel] = self._make_decode_jit(kernel)
+        return fn
 
     # -- public API --------------------------------------------------------
 
@@ -1088,11 +1151,19 @@ class Engine:
                 adm_hist = (
                     {"adm_hist": ar["adm_hist"]} if self.cfg.speculate_tokens > 0 else {}
                 )
+                # The kernel flavor is keyed off the PAYLOAD, not this
+                # rank's config: rank 0's resolution is authoritative
+                # (all ranks must execute the same compiled program).
+                # Absent key = a pre-flag publisher; fall back to the
+                # local resolution.
+                decode_fn = self._decode_jit_for(
+                    (sc or {}).get("decode_kernel", self._decode_kernel)
+                )
                 (
                     _, _, _, _, _, _, _,
                     self._cache, self._tok_hist, self._lengths,
                     self._last_tokens, self._keys,
-                ) = self._decode_jit(
+                ) = decode_fn(
                     self.params, self._cache, ar["tables"], self._tok_hist,
                     self._lengths, self._last_tokens, self._keys,
                     ar["active"], ar["temp"], ar["top_p"], ar["top_k"],
@@ -1691,6 +1762,12 @@ class Engine:
         )
         with self._lockstep(
             "decode",
+            # The resolved kernel flavor rides every decode broadcast:
+            # followers must compile the SAME program (a rank pairing a
+            # different attention kernel would still agree numerically
+            # but break the "identical jitted computation" lockstep
+            # contract the gang's collectives rely on).
+            scalars={"decode_kernel": self._decode_kernel},
             arrays={
                 "tables": self._page_table, "active": self._h_active,
                 "temp": self._h_temp, "top_p": self._h_top_p,

@@ -383,6 +383,19 @@ def _make_handler(srv: EngineServer):
                 not isinstance(params.seed, int) or isinstance(params.seed, bool)
             ):
                 return self._error(400, "seed must be an integer")
+            # echo / stream_options validate BEFORE the submit loop: a
+            # 400 after submitting would leave up to n live generations
+            # with no consumer, burning slots/KV pages per malformed
+            # request (ADVICE r5 medium).
+            echo_val = body.get("echo")
+            if echo_val is not None and not isinstance(echo_val, bool):
+                return self._error(400, "echo must be a boolean")
+            so = body.get("stream_options")
+            if so is not None and not isinstance(so, dict):
+                return self._error(400, "stream_options must be an object")
+            if so is not None and not body.get("stream"):
+                return self._error(400, "stream_options requires stream: true")
+            so = so or {}
             reqs = []
             try:
                 for i in range(n_choices):
@@ -402,21 +415,12 @@ def _make_handler(srv: EngineServer):
             # OpenAI `echo` (completions only): prepend the prompt text
             # to every choice. Prompt logprobs are not computed
             # (documented limit, like top-N alternatives).
-            echo_val = body.get("echo")
-            if echo_val is not None and not isinstance(echo_val, bool):
-                return self._error(400, "echo must be a boolean")
             echo_text = ""
             if not chat and echo_val:
                 echo_text = (
                     prompt_text if prompt_text is not None
                     else self._decode_safe(prompt_ids)
                 )
-            so = body.get("stream_options")
-            if so is not None and not isinstance(so, dict):
-                return self._error(400, "stream_options must be an object")
-            if so is not None and not body.get("stream"):
-                return self._error(400, "stream_options requires stream: true")
-            so = so or {}
             if body.get("stream"):
                 self._stream_response(
                     reqs, rid, created, chat, want_logprobs, echo_text, top_n,
@@ -713,6 +717,7 @@ def build_engine_from_args(args, publisher=None) -> tuple[Engine, str]:
         prefix_cache_min=getattr(args, "prefix_cache_min", 16),
         speculate_tokens=getattr(args, "speculate_tokens", 0),
         kv_cache_dtype=getattr(args, "kv_cache_dtype", ""),
+        decode_kernel=getattr(args, "decode_kernel", "ragged"),
     )
     if args.model.startswith("test:"):
         eng = build_test_engine(engine_config=ec)
@@ -894,6 +899,13 @@ def main(argv=None):
         "--speculate-tokens", type=int, default=0,
         help="draft tokens verified per decode step via n-gram prompt "
              "lookup (greedy-exact; 0 disables)",
+    )
+    parser.add_argument(
+        "--decode-kernel", default="ragged",
+        choices=["ragged", "dedicated", "auto"],
+        help="decode-path paged-attention kernel: the shared ragged "
+             "kernel, the dedicated S=1 decode-blocked kernel, or "
+             "auto (picked by decode query length)",
     )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)

@@ -314,6 +314,11 @@ def apply(
     left_aligned: bool = False,  # caller guarantees positions == arange(S)
     return_hidden: bool = False,  # final-norm hidden states instead of logits
     page_table: jnp.ndarray | None = None,  # [B, max_pages] pool page per seq page
+    decode_kernel: str = "ragged",  # paged-kernel flavor for this call:
+    # "ragged" (the shared prefill-tuned kernel), "dedicated" (the
+    # S=1/G+1 decode-blocked kernel, ops/paged_decode_attention), or
+    # "auto" (keyed on S at trace time). Only decode-path callers pass
+    # non-default; prefill always rides the ragged/flash paths.
     ring_mesh=None,  # Mesh with an `sp` axis: cache-less attention runs
     # as ring attention over sequence-sharded blocks (ppermute ring,
     # O((S/sp)^2) scores per device — parallel/ring_attention.py). The
@@ -385,6 +390,11 @@ def apply(
         and config.sliding_window == 0
         and not use_flash
     )
+    use_dedicated_decode = False
+    if use_paged_kernel:
+        from kubeai_tpu.ops.paged_decode_attention import resolve_decode_kernel
+
+        use_dedicated_decode = resolve_decode_kernel(decode_kernel, S) == "dedicated"
 
     paged = page_table is not None
     kv_quant = False
@@ -510,9 +520,16 @@ def apply(
             k_att, v_att = k, v
 
         if use_paged_kernel:
-            from kubeai_tpu.ops.paged_attention import paged_attention_ragged
+            if use_dedicated_decode:
+                from kubeai_tpu.ops.paged_decode_attention import (
+                    paged_decode_attention as paged_attn_fn,
+                )
+            else:
+                from kubeai_tpu.ops.paged_attention import (
+                    paged_attention_ragged as paged_attn_fn,
+                )
 
-            attn_out = paged_attention_ragged(
+            attn_out = paged_attn_fn(
                 q, kv_full, table_l,
                 kv_lengths=positions[:, -1] + 1,  # keys 0..last pos inclusive
                 scale=config.query_scale,
@@ -722,25 +739,28 @@ def prefill_paged_cold(params, config, tokens, pool, page_table, lengths, lora=N
     )
 
 
-def decode_step_paged(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None):
+def decode_step_paged(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None, decode_kernel="ragged"):
     """One paged decode step for [B, 1] tokens at positions *lengths* [B].
     Returns (logits [B, 1, V], pool)."""
     return apply(
         params, config, tokens, lengths[:, None].astype(jnp.int32), pool,
         lora=lora, lora_rows=lora_rows, page_table=page_table,
+        decode_kernel=decode_kernel,
     )
 
 
-def decode_speculative_paged(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None):
+def decode_speculative_paged(params, config, tokens, pool, page_table, lengths, lora=None, lora_rows=None, decode_kernel="ragged"):
     """Speculative paged decode: [B, S] candidate tokens (real next token
     + S-1 drafts) at positions lengths..lengths+S-1. Returns logits for
     ALL S positions ([B, S, V], for draft verification) and the pool.
     Causality makes verification exact: logits at position j depend only
     on inputs 0..j, so a draft mismatch at j invalidates positions > j
-    without contaminating <= j."""
+    without contaminating <= j. *decode_kernel* selects the paged
+    attention flavor (EngineConfig.decode_kernel; see apply())."""
     S = tokens.shape[1]
     pos = lengths[:, None].astype(jnp.int32) + jnp.arange(S, dtype=jnp.int32)[None, :]
     return apply(
         params, config, tokens, pos, pool,
         lora=lora, lora_rows=lora_rows, page_table=page_table,
+        decode_kernel=decode_kernel,
     )

@@ -1,0 +1,305 @@
+"""Dedicated paged-attention kernel for the DECODE hot path (S=1 per
+slot, or G+1 for speculative verification).
+
+Why a second kernel when ops/paged_attention.py already wraps the
+library's ragged kernel: the ragged kernel's grid is tuned for prefill
+(tens-to-hundreds of queries per block, KV streamed in multi-page
+blocks). At S=1 the whole batch contributes max_slots query rows total,
+so the prefill blocking collapses the grid to a handful of programs —
+one reason decode sits at ~10% MFU against a ~4,700 tok/s weight-read
+roofline, and a candidate mechanism for the measured 96-slot cliff
+(BENCH r5: 96 slots = 499 tok/s vs 48 = 1,225 with identical HBM
+totals; see docs/benchmarks.md).
+
+This kernel's blocking is decode-native:
+
+- Grid = (num_kv_heads, slots, pages): parallelism scales with
+  Kv x B — MORE slots mean MORE programs, never wider serial work
+  inside one program. TPU grids iterate the last axis innermost, so
+  each (kv-head, slot)'s pages stream sequentially through VMEM while
+  f32 online-softmax accumulators persist in scratch across the walk.
+- The page-table walk happens in the BlockSpec index map off a
+  scalar-prefetched table: page p of slot b is fetched as pool page
+  table[b, p] — pages stream HBM->VMEM one per grid step with no
+  gathered contiguous copy, same zero-copy property as the ragged
+  kernel.
+- The whole query block (the slot's S tokens x its G grouped query
+  heads) stays resident in VMEM for the entire walk; there is no
+  queries-per-block knob to mistune because decode's query block IS
+  the slot.
+
+Dispatch (`paged_decode_attention`) mirrors paged_attention.py: the
+Pallas kernel on accelerators, a signature-identical jit-safe CPU twin
+elsewhere, so the engine's dedicated-kernel path is CPU-testable
+end-to-end. `interpret=True` runs the actual kernel logic through the
+Pallas interpreter on CPU (semantics tests, microbench smoke).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax.experimental import pallas as pl
+
+_NEG_INF = -1e30
+
+# Decode/speculative query lengths the dedicated kernel accepts; "auto"
+# dispatch falls back to the ragged kernel above this. G+1 for any sane
+# speculation depth lands well inside it.
+MAX_DECODE_QUERY_LEN = 8
+
+
+def resolve_decode_kernel(mode: str, query_len: int) -> str:
+    """Map EngineConfig.decode_kernel to a concrete kernel for a decode
+    dispatch of *query_len* tokens per slot (static at trace time).
+    "auto" keys on query length: the dedicated kernel for S=1 /
+    speculative G+1, the ragged kernel for anything prefill-sized."""
+    if mode == "dedicated":
+        return "dedicated"
+    if mode == "auto":
+        return "dedicated" if query_len <= MAX_DECODE_QUERY_LEN else "ragged"
+    return "ragged"
+
+
+def _decode_kernel(
+    # scalar-prefetch refs
+    table_ref,  # [B, max_pages] int32 pool page per (slot, seq page)
+    lens_ref,  # [B] int32 valid keys incl. the S new tokens (pre-clamped)
+    # blocked tensor refs
+    q_ref,  # [1, S, G, h] this (kv-head, slot)'s query block
+    kv_ref,  # [1, page, 2, h] pool page `table[b, p]`, this kv head's K/V
+    o_ref,  # [1, S, G, h]
+    # scratch (persists across the page walk of one (kv, b))
+    m_ref,  # [S*G, 128] f32 running max (column 0 authoritative)
+    l_ref,  # [S*G, 128] f32 running denominator
+    acc_ref,  # [S*G, h] f32 numerator
+    *,
+    sm_scale,
+    soft_cap,
+    k_scale,
+    v_scale,
+    page_size,
+    num_queries,  # S
+    group,  # G = H // Kv
+):
+    b = pl.program_id(1)
+    p = pl.program_id(2)
+    n_pages = pl.num_programs(2)
+    SG = num_queries * group
+    h = q_ref.shape[3]
+
+    @pl.when(p == 0)
+    def _():
+        m_ref[...] = jnp.full_like(m_ref, _NEG_INF)
+        l_ref[...] = jnp.zeros_like(l_ref)
+        acc_ref[...] = jnp.zeros_like(acc_ref)
+
+    kv_len = lens_ref[b]
+    page_start = p * page_size
+
+    # Pages entirely past the valid span contribute nothing: skip their
+    # (already-fetched) block's math. The LAST page still runs its
+    # epilogue below even when empty.
+    @pl.when(page_start < kv_len)
+    def _():
+        # Query rows stack s-major: row r = s*G + g (reshape of [S, G, h]).
+        q = q_ref[0].reshape(SG, h).astype(jnp.float32) * sm_scale
+        k = kv_ref[0, :, 0, :].astype(jnp.float32)  # [page, h]
+        v = kv_ref[0, :, 1, :].astype(jnp.float32)
+        if k_scale is not None:
+            k = k * k_scale
+        if v_scale is not None:
+            v = v * v_scale
+        s = jax.lax.dot_general(
+            q, k, (((1,), (1,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )  # [SG, page]
+        if soft_cap is not None:
+            s = soft_cap * jnp.tanh(s / soft_cap)
+        # Causality inside the query block: query row r = s_idx*G + g sits
+        # at absolute position kv_len - S + s_idx; key j of this page at
+        # page_start + j. (S=1 reduces to key_pos < kv_len.)
+        q_pos = kv_len - num_queries + (
+            jax.lax.broadcasted_iota(jnp.int32, (SG, page_size), 0) // group
+        )
+        k_pos = page_start + jax.lax.broadcasted_iota(
+            jnp.int32, (SG, page_size), 1
+        )
+        s = jnp.where(k_pos <= q_pos, s, _NEG_INF)
+
+        m_prev = m_ref[:, 0]  # [SG]
+        l_prev = l_ref[:, 0]
+        m_new = jnp.maximum(m_prev, s.max(axis=-1))
+        pexp = jnp.where(s > _NEG_INF / 2, jnp.exp(s - m_new[:, None]), 0.0)
+        alpha = jnp.exp(m_prev - m_new)
+        l_new = l_prev * alpha + pexp.sum(axis=-1)
+        acc_ref[...] = acc_ref[...] * alpha[:, None] + jax.lax.dot_general(
+            pexp, v, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        m_ref[...] = jnp.broadcast_to(m_new[:, None], m_ref.shape)
+        l_ref[...] = jnp.broadcast_to(l_new[:, None], l_ref.shape)
+
+    @pl.when(p == n_pages - 1)
+    def _():
+        l = l_ref[:, 0]
+        o_ref[0] = (
+            acc_ref[...] / jnp.maximum(l, 1e-30)[:, None]
+        ).reshape(num_queries, group, h).astype(o_ref.dtype)
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "sm_scale", "soft_cap", "k_scale", "v_scale", "interpret",
+    ),
+)
+def _decode_kernel_call(
+    q,  # [B, S, H, h]
+    kv_pages,  # [P, page, 2*Kv, h] (K even, V odd on the head axis)
+    page_table,  # [B, max_pages] int32
+    kv_lens,  # [B] int32, pre-clamped to the table span
+    *,
+    sm_scale,
+    soft_cap=None,
+    k_scale=None,
+    v_scale=None,
+    interpret=False,
+):
+    from jax.experimental.pallas import tpu as pltpu
+
+    B, S, H, h = q.shape
+    page = kv_pages.shape[1]
+    Kv = kv_pages.shape[2] // 2
+    G = H // Kv
+    max_pages = page_table.shape[1]
+
+    # No pre-kernel relayout of q OR the pool: BlockSpec index maps are
+    # in units of blocks, so blocking the head axes directly carves out
+    # each program's slice of the NATIVE layouts — kv head kv's query
+    # group is the G-wide block kv of the H axis (head hh = kv*G + g),
+    # and its K/V pair is the 2-wide block kv of the interleaved 2Kv
+    # axis (K even, V odd). A transpose here would copy the multi-GB
+    # pool every layer call.
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=2,
+        grid=(Kv, B, max_pages),
+        in_specs=[
+            pl.BlockSpec((1, S, G, h), lambda kv, b, p, tbl, lens: (b, 0, kv, 0)),
+            pl.BlockSpec(
+                (1, page, 2, h),
+                # Steps past the valid span (mid-generation tables are
+                # mostly half-empty) clamp to the LAST valid page: Pallas
+                # skips the DMA when consecutive grid steps resolve to
+                # the same block, so pages beyond kv_len cost neither
+                # bandwidth nor math (the kernel body gates the math on
+                # page_start < kv_len).
+                lambda kv, b, p, tbl, lens: (
+                    tbl[b, jnp.minimum(p, jnp.maximum(lens[b] - 1, 0) // page)],
+                    0, kv, 0,
+                ),
+            ),
+        ],
+        out_specs=pl.BlockSpec(
+            (1, S, G, h), lambda kv, b, p, tbl, lens: (b, 0, kv, 0)
+        ),
+        scratch_shapes=[
+            pltpu.VMEM((S * G, 128), jnp.float32),
+            pltpu.VMEM((S * G, 128), jnp.float32),
+            pltpu.VMEM((S * G, h), jnp.float32),
+        ],
+    )
+    return pl.pallas_call(
+        functools.partial(
+            _decode_kernel,
+            sm_scale=sm_scale,
+            soft_cap=soft_cap,
+            k_scale=k_scale,
+            v_scale=v_scale,
+            page_size=page,
+            num_queries=S,
+            group=G,
+        ),
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((B, S, H, h), q.dtype),
+        interpret=interpret,
+    )(page_table.astype(jnp.int32), kv_lens.astype(jnp.int32), q, kv_pages)
+
+
+def _cpu_twin(
+    q,  # [B, S, H, h]
+    kv_pages,
+    page_table,
+    kv_lens,
+    *,
+    sm_scale,
+    soft_cap=None,
+    k_scale=None,
+    v_scale=None,
+):
+    """Jit-safe semantics twin of the Pallas decode kernel with the SAME
+    signature (the pattern of paged_attention._cpu_twin): gather the
+    table's pages into a contiguous view and run masked attention with
+    queries at positions kv_len - S + s. Tests pin this twin against the
+    ragged path AND against the kernel in interpret mode."""
+    from kubeai_tpu.ops.attention import attention
+
+    B, S, H, h = q.shape
+    page = kv_pages.shape[1]
+    Kv = kv_pages.shape[2] // 2
+    max_pages = page_table.shape[1]
+    skv = max_pages * page
+    gathered = kv_pages[page_table]  # [B, mp, page, 2Kv, h]
+    k_att = gathered[..., 0::2, :].reshape(B, skv, Kv, h)
+    v_att = gathered[..., 1::2, :].reshape(B, skv, Kv, h)
+    if k_scale is not None:
+        k_att = (k_att.astype(jnp.float32) * k_scale).astype(q.dtype)
+    if v_scale is not None:
+        v_att = (v_att.astype(jnp.float32) * v_scale).astype(q.dtype)
+    pos_q = kv_lens[:, None] - S + jnp.arange(S, dtype=jnp.int32)[None, :]
+    mask = jnp.arange(skv)[None, None, :] <= pos_q[:, :, None]
+    return attention(
+        q, k_att, v_att, mask, scale=sm_scale, softcap=soft_cap or 0.0
+    )
+
+
+def paged_decode_attention(
+    q: jnp.ndarray,  # [B, S, H, h] — S = 1 (decode) or G+1 (speculative)
+    kv_pages: jnp.ndarray,  # [P, page, 2*Kv, h] (K even, V odd)
+    page_table: jnp.ndarray,  # [B, max_pages] int32
+    kv_lengths: jnp.ndarray,  # [B] int32 — valid keys INCLUDING the S new tokens
+    scale: float | None = None,
+    softcap: float = 0.0,
+    k_scale: float | None = None,  # static dequant scales for quantized
+    v_scale: float | None = None,  # (int8/fp8) pools; None = pool is bf16
+    interpret: bool | None = None,  # force Pallas interpret mode (tests)
+) -> jnp.ndarray:
+    """Returns [B, S, H, h] attention output — the drop-in decode-path
+    replacement for paged_attention_ragged (same argument contract,
+    including the finished-slot length clamp and in-VMEM dequant of
+    quantized pools)."""
+    B, S, H, h = q.shape
+    page = kv_pages.shape[1]
+    max_pages = page_table.shape[1]
+    if scale is None:
+        scale = h**-0.5
+    # Overrun guard, identical to the ragged wrapper: a finished slot's
+    # positions may run past the table span (writes went to the trash
+    # page); clamp so the walk never reads past the table width.
+    kv_lens = jnp.minimum(kv_lengths, max_pages * page).astype(jnp.int32)
+    kw = dict(
+        sm_scale=float(scale),
+        soft_cap=float(softcap) if softcap > 0.0 else None,
+        k_scale=None if k_scale is None else float(k_scale),
+        v_scale=None if v_scale is None else float(v_scale),
+    )
+    if interpret is None and jax.default_backend() == "cpu":
+        return _cpu_twin(q, kv_pages, page_table, kv_lens, **kw).astype(q.dtype)
+    out = _decode_kernel_call(
+        q, kv_pages, page_table.astype(jnp.int32), kv_lens,
+        interpret=bool(interpret) if interpret is not None else False,
+        **kw,
+    )
+    return out.astype(q.dtype)

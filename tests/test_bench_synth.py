@@ -3,6 +3,7 @@ identical to the real quantizing loader's output (ADVICE r2: a future
 llama tree change would otherwise silently make the bench build a
 different jitted graph than serving)."""
 
+import json
 import os
 import sys
 
@@ -15,6 +16,83 @@ from bench import synth_int8_params
 from kubeai_tpu.engine.weights import quantize_model_params
 from kubeai_tpu.models import llama
 from kubeai_tpu.models.base import ModelConfig
+
+
+def test_probe_retry_backs_off_before_cpu_fallback(monkeypatch):
+    """VERDICT r5 weak #1: one wedged accelerator init must not send the
+    whole bench to the CPU-fallback headline — the probe retries with
+    growing backoff while the deadline allows."""
+    import time as _time
+    import types
+
+    import bench
+
+    attempts = []
+    sleeps = []
+    monkeypatch.setattr(
+        bench, "probe_device",
+        lambda timeout, platform=None: (
+            attempts.append(timeout), [None, None, "tpu"][len(attempts) - 1]
+        )[1],
+    )
+    monkeypatch.setattr(bench.time, "sleep", lambda s: sleeps.append(s))
+    args = types.SimpleNamespace(probe_timeout=10, probe_retries=3, probe_backoff=5.0)
+    got = bench.probe_device_with_retry(args, deadline=_time.monotonic() + 3600)
+    assert got == "tpu"
+    assert len(attempts) == 3
+    assert sleeps == [5.0, 10.0]  # backoff doubles between attempts
+
+    # Exhausted retries -> None (the orchestrator then takes the clearly
+    # labeled CPU fallback, unchanged).
+    attempts.clear()
+    sleeps.clear()
+    monkeypatch.setattr(bench, "probe_device", lambda timeout, platform=None: None)
+    assert bench.probe_device_with_retry(args, deadline=_time.monotonic() + 3600) is None
+
+    # A nearly-spent deadline stops retrying instead of sleeping past it.
+    sleeps.clear()
+    assert bench.probe_device_with_retry(args, deadline=_time.monotonic() + 60) is None
+    assert sleeps == []
+
+
+def test_worker_emits_headline_before_teardown_failure(monkeypatch, capsys):
+    """ADVICE r5 regression: the measured headline must be emitted
+    BEFORE engine teardown, so a hung/raising stop() can't forfeit an
+    already-measured result."""
+    import types
+
+    import bench
+    from kubeai_tpu.engine.core import Engine
+
+    order = []
+    real_emit = bench.emit
+    monkeypatch.setattr(
+        bench, "emit", lambda v, e=None: (order.append("emit"), real_emit(v, e))[1]
+    )
+
+    def exploding_stop(self):
+        order.append("stop")
+        # Still wind the scheduler thread down (this test shares the
+        # process with the rest of the suite) — the raise is what
+        # exercises the worker's teardown guard.
+        self._running = False
+        self._wake.set()
+        raise RuntimeError("simulated teardown hang")
+
+    monkeypatch.setattr(Engine, "stop", exploding_stop)
+    args = types.SimpleNamespace(
+        preset="tiny", watchdog=0, requests=2, max_tokens=2, speculate=0,
+        greedy=False, slots=0, chunk=0, kv_dtype="", decode_kernel="",
+        request_rate=0, rate_duration=45.0,
+    )
+    bench.run_worker(args)  # must not raise despite the exploding stop
+    assert order == ["emit", "stop"]
+    line = [
+        json.loads(ln) for ln in capsys.readouterr().out.splitlines()
+        if ln.startswith("{")
+    ][-1]
+    assert line["metric"] == "engine_output_tokens_per_sec_per_chip"
+    assert line["value"] > 0  # the measurement survived the teardown failure
 
 
 def test_synth_tree_matches_quantized_loader():

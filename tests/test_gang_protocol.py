@@ -68,12 +68,27 @@ def pair():
 
 def _sync(get_state, want, timeout=30):
     deadline = time.monotonic() + timeout
+    got = None
     while time.monotonic() < deadline:
-        got = np.asarray(jax.device_get(get_state()))
+        try:
+            got = np.asarray(jax.device_get(get_state()))
+        except RuntimeError:
+            # The follower replays with DONATED carries: between a
+            # dispatch (input buffer deleted) and the reassignment, a
+            # device_get here races into "Array has been deleted" —
+            # that's mid-replay, not divergence. Retry until deadline.
+            time.sleep(0.05)
+            continue
         if np.array_equal(got, want):
             return got
         time.sleep(0.05)
-    return np.asarray(jax.device_get(get_state()))
+    # Deadline passed: one final fetch so the assertion that follows
+    # reports the CURRENT device state, not a stale mid-replay snapshot
+    # (or None, if every attempt above raced a donated buffer).
+    try:
+        return np.asarray(jax.device_get(get_state()))
+    except RuntimeError:
+        return got
 
 
 def test_replay_produces_identical_device_state(pair):
@@ -420,6 +435,63 @@ class TestAssemblyCountsProvenRanksOnly:
         fol1.close()
         out["fol"].close()
         pub.close()
+
+
+def test_decode_kernel_flag_rides_broadcast():
+    """The decode-kernel flavor is part of the lockstep contract: rank
+    0's RESOLVED choice must ride every decode broadcast, and a follower
+    whose own config disagrees must compile/execute the broadcast
+    flavor (all ranks must run the same program — a follower silently
+    using its local default would diverge the compiled computations)."""
+    follower_eng = build_test_engine()  # local default: "ragged"
+    pub = GangPublisher(1, port=0, host="127.0.0.1", secret=SECRET)
+    fol = connect_pair(pub)
+    leader = Engine(
+        follower_eng.model_config,
+        follower_eng.params,
+        follower_eng.tokenizer,
+        EngineConfig(
+            max_slots=4, max_seq_len=256, prefill_buckets=(16, 32, 64, 128),
+            decode_kernel="dedicated",
+        ),
+        publisher=pub,
+    )
+    seen: list[dict] = []
+    real_publish = pub.publish
+
+    def spying_publish(op, scalars=None, arrays=None):
+        if op == "decode":
+            seen.append(dict(scalars or {}))
+        real_publish(op, scalars, arrays)
+
+    pub.publish = spying_publish
+    t = threading.Thread(target=follower_eng.run_follower, args=(fol,), daemon=True)
+    t.start()
+    leader.start()
+    try:
+        ids, _, fin = leader.generate(
+            list(range(1, 20)), SamplingParams(temperature=0.0, max_tokens=6),
+            timeout=120,
+        )
+        assert fin.completion_tokens >= 1
+        # Every decode broadcast carried the resolved flavor.
+        assert seen, "no decode op was broadcast"
+        assert all(sc.get("decode_kernel") == "dedicated" for sc in seen), seen
+        # The follower honored the payload over its own config: it
+        # compiled the dedicated flavor while its local resolution (and
+        # local jit) remain ragged.
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline and "dedicated" not in follower_eng._decode_jits:
+            time.sleep(0.05)
+        assert "dedicated" in follower_eng._decode_jits
+        assert follower_eng._decode_kernel == "ragged"
+        # And the replayed device carries converge to the leader's.
+        want = np.asarray(jax.device_get(leader._lengths))
+        np.testing.assert_array_equal(_sync(lambda: follower_eng._lengths, want), want)
+    finally:
+        leader.stop()
+        t.join(timeout=20)
+    assert not t.is_alive(), "follower loop did not exit on stop"
 
 
 def test_penalized_and_biased_generation_replays(pair):

@@ -83,6 +83,19 @@ def test_chat_invalid(body, match):
     bad("/v1/chat/completions", body, match)
 
 
+def test_logit_bias_at_cap_valid():
+    """Exactly LOGIT_BIAS_CAP entries pass (the 301-entry case above is
+    rejected); the cap constant is shared with EngineConfig so a proxy-
+    valid request can't 400 at the engine (pinned end-to-end in
+    test_penalties.py::test_logit_bias_cap_spans_layers)."""
+    from kubeai_tpu.api.openai_types import LOGIT_BIAS_CAP
+
+    ok("/v1/chat/completions", {
+        "model": "m", "messages": [{"role": "user", "content": "x"}],
+        "logit_bias": {str(i): 0 for i in range(LOGIT_BIAS_CAP)},
+    })
+
+
 def test_stream_options_with_stream_valid():
     ok("/v1/chat/completions", {
         "model": "m", "messages": [{"role": "user", "content": "x"}],

@@ -91,6 +91,86 @@ def test_engine_penalties_change_greedy_output():
         eng.stop()
 
 
+def test_just_emitted_token_is_penalized_immediately():
+    """ADVICE r5 regression: penalties must count the token emitted at
+    the PREVIOUS step when choosing the next one (OpenAI/vLLM count the
+    full output so far). The old window read the history before the
+    current input was written, so the just-emitted token's first
+    immediate repeat went unpenalized.
+
+    Deterministic construction: logit_bias +100 makes token 77 the
+    unconditional greedy choice (the companion test pins [77]*6 without
+    penalties); frequency_penalty=200 then outweighs the bias after ONE
+    counted occurrence. Correct (unlagged) counting emits 77 exactly
+    once — the lagged window emitted it twice before the count caught
+    up."""
+    eng = build_test_engine(
+        engine_config=EngineConfig(max_slots=2, max_seq_len=256, prefill_buckets=(16, 32))
+    )
+    eng.start()
+    try:
+        prompt = eng.tokenizer.encode("penalty lag")
+        ids = _greedy_tokens(
+            eng, prompt, 6,
+            logit_bias=((77, 100.0),), frequency_penalty=200.0,
+        )
+        assert ids[0] == 77, ids  # the bias wins the first choice
+        assert ids[1] != 77, ids  # ...and is outweighed IMMEDIATELY after
+        # Once outweighed it stays outweighed (count never decreases).
+        assert ids.count(77) == 1, ids
+    finally:
+        eng.stop()
+
+
+def test_logit_bias_cap_spans_layers():
+    """ADVICE r5: the proxy accepts OpenAI's 300-entry logit_bias cap,
+    so the engine's default cap must match — a proxy-valid request must
+    never 400 downstream at the engine server."""
+    from kubeai_tpu.api.openai_types import LOGIT_BIAS_CAP, body_for_path
+
+    assert EngineConfig().max_logit_bias == LOGIT_BIAS_CAP == 300
+
+    from kubeai_tpu.engine.server import EngineServer
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(max_slots=2, max_seq_len=128, prefill_buckets=(16, 32))
+    )
+    srv = EngineServer(eng, model_name="test:tiny", host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        # Exactly at the cap: passes the proxy-side validator AND the
+        # engine server end-to-end (bias 0.0 everywhere = no-op math).
+        bias = {str(i): 0 for i in range(LOGIT_BIAS_CAP)}
+        body = {"model": "test:tiny", "prompt": "cap test", "max_tokens": 2,
+                "temperature": 0.0, "logit_bias": bias}
+        body_for_path("/v1/completions", dict(body))  # proxy layer accepts
+        out = _post(srv, body)  # engine layer serves (used to 400 at >32)
+        assert out["usage"]["completion_tokens"] >= 1
+
+        # One past the cap: both layers reject, consistently.
+        import json
+        import urllib.error
+        import urllib.request
+
+        over = dict(body, logit_bias={str(i): 0 for i in range(LOGIT_BIAS_CAP + 1)})
+        import pytest as _pytest
+
+        from kubeai_tpu.api.openai_types import ValidationError
+
+        with _pytest.raises(ValidationError):
+            body_for_path("/v1/completions", dict(over))
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{srv.port}/v1/completions",
+            data=json.dumps(over).encode(),
+            headers={"Content-Type": "application/json"},
+        )
+        with _pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(req, timeout=60)
+        assert ei.value.code == 400
+    finally:
+        srv.stop()
+
+
 def test_null_penalties_over_http_are_defaults(tmp_path):
     """OpenAI clients send explicit JSON null for 'number or null'
     fields — must parse as the default, not crash (r5 review catch)."""
@@ -184,6 +264,54 @@ def test_n_choices_over_http():
         assert seen_idx == {0, 1}
         assert usage and usage["completion_tokens"] >= 2
     finally:
+        srv.stop()
+
+
+def test_malformed_echo_stream_options_never_submit():
+    """ADVICE r5 (medium) regression: a 400 on echo/stream_options used
+    to fire AFTER the submit loop, leaving up to n live generations with
+    no consumer (burning slots/KV pages per malformed request). The
+    validations now run before anything is submitted."""
+    import json
+    import urllib.error
+    import urllib.request
+
+    from kubeai_tpu.engine.server import EngineServer
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(max_slots=4, max_seq_len=128, prefill_buckets=(16, 32))
+    )
+    srv = EngineServer(eng, model_name="test:tiny", host="127.0.0.1", port=0)
+    srv.start()
+    submits = []
+    real_submit = eng.submit
+    eng.submit = lambda *a, **kw: (submits.append(1), real_submit(*a, **kw))[1]
+    try:
+        for bad in (
+            {"echo": "yes"},  # non-bool echo
+            {"stream_options": "x"},  # non-object stream_options
+            {"stream_options": {"include_usage": True}},  # without stream
+        ):
+            body = {"model": "test:tiny", "prompt": "leak test",
+                    "max_tokens": 4, "n": 4, **bad}
+            req = urllib.request.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=json.dumps(body).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            try:
+                urllib.request.urlopen(req, timeout=60)
+                raise AssertionError(f"expected 400 for {bad}")
+            except urllib.error.HTTPError as e:
+                assert e.code == 400, (bad, e.code)
+            assert not submits, f"{bad} leaked {len(submits)} live generations"
+        # The engine is untouched: a valid request still round-trips.
+        out = _post(srv, {"model": "test:tiny", "prompt": "still fine",
+                          "max_tokens": 2, "temperature": 0.0})
+        assert out["usage"]["completion_tokens"] >= 1
+        assert len(submits) == 1
+    finally:
+        eng.submit = real_submit
         srv.stop()
 
 

@@ -1,0 +1,50 @@
+"""CI smoke for the decode-kernel autotune sweep
+(benchmarks/profile_engine.py --sweep): tiny shapes on CPU must produce
+the full JSON document — every (kernel, block, slots) row present with
+latency + diagnosis fields — so a TPU run of the identical harness is
+known-good before it burns accelerator time."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def test_sweep_smoke_emits_full_table():
+    from benchmarks.profile_engine import run_sweep
+
+    doc = run_sweep(slots_list=(2, 4), blocks=("default", "2:8"), smoke=True)
+    # JSON-serializable end-to-end (the harness writes this to disk).
+    doc = json.loads(json.dumps(doc))
+    assert doc["metric"] == "paged_decode_attention_sweep"
+    assert doc["degraded"] is True  # CPU run must label itself honestly
+    assert "not TPU numbers" in doc["note"]
+    for key in ("H", "Kv", "head_dim", "page", "seq"):
+        assert key in doc["shapes"]
+
+    rows = doc["results"]
+    # 1 dedicated + 2 ragged blocks, per slot count.
+    assert len(rows) == 2 * (1 + 2)
+    combos = {(r["kernel"], r["block"], r["slots"]) for r in rows}
+    for slots in (2, 4):
+        assert ("dedicated", "slotwise", slots) in combos
+        assert ("ragged", "default", slots) in combos
+        assert ("ragged", "2:8", slots) in combos
+    for r in rows:
+        # Every config measured (CPU reference path must never fail).
+        assert r.get("error") is None, r
+        assert r["latency_ms"] is not None and r["latency_ms"] > 0
+        assert r["toks_per_sec_equiv"] > 0
+        # The diagnosis columns the 96-slot-cliff analysis reads.
+        assert r["grid_programs"] >= 1
+        assert r["q_rows_per_program"] >= 1
+        assert r["kv_mb_walked"] > 0
+
+    # The dedicated kernel's grid must scale with slots (the design
+    # property that distinguishes it from the collapsed ragged grid).
+    ded = {r["slots"]: r["grid_programs"] for r in rows if r["kernel"] == "dedicated"}
+    assert ded[4] == 2 * ded[2]
+
+    # The env knob must not leak out of the sweep.
+    assert "KUBEAI_PAGED_KERNEL_BLOCK" not in os.environ
