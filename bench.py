@@ -81,6 +81,52 @@ def dump_stderr(e: "subprocess.TimeoutExpired", limit: int = 4000) -> None:
         sys.stderr.write(err[-limit:])
 
 
+def _pct(vals: list[float], q: float) -> float | None:
+    if not vals:
+        return None
+    vals = sorted(vals)
+    return round(vals[min(len(vals) - 1, int(len(vals) * q))], 2)
+
+
+def trace_latency_stats(since_wall: float, expected: int = 0) -> dict:
+    """p50/p95/p99 TTFT + TPOT from the engine flight recorder's request
+    timelines (kubeai_tpu/obs): the recorder keeps per-token offsets per
+    request, so the FULL latency distribution is recomputable after the
+    fact instead of only client-side means. *since_wall* bounds the
+    window to the measured phase (warmup traffic is excluded)."""
+    from kubeai_tpu.obs import default_recorder
+
+    since_ms = since_wall * 1000 - 5.0
+    ttfts: list[float] = []
+    tpots: list[float] = []
+    n = 0
+    for tl in default_recorder.snapshot():
+        if tl.get("component") != "engine" or tl.get("outcome") != "ok":
+            continue
+        if tl.get("start_ms", 0.0) < since_ms:
+            continue
+        for ph in tl.get("phases", ()):
+            if ph["name"] == "decode":
+                offs = ph.get("attrs", {}).get("token_offsets_ms") or []
+                if offs:
+                    n += 1
+                    ttfts.append(offs[0])
+                    tpots.extend(b - a for a, b in zip(offs, offs[1:]))
+    if not ttfts:
+        return {}
+    if expected and n < expected:
+        # The ring buffer holds the most recent timelines only — say so
+        # rather than letting a truncated sample read as full coverage.
+        log(f"trace stats cover {n}/{expected} requests (recorder ring bound)")
+    out = {
+        "ttft_ms": {"p50": _pct(ttfts, 0.5), "p95": _pct(ttfts, 0.95), "p99": _pct(ttfts, 0.99)},
+        "latency_source": "flight_recorder",
+    }
+    if tpots:
+        out["tpot_ms"] = {"p50": _pct(tpots, 0.5), "p95": _pct(tpots, 0.95), "p99": _pct(tpots, 0.99)}
+    return out
+
+
 def emit(value: float, extras: dict | None = None) -> None:
     line = {
         "metric": "engine_output_tokens_per_sec_per_chip",
@@ -373,6 +419,7 @@ def run_worker(args) -> None:
 
     log(f"phase=measure {n_requests} reqs x {max_tokens} tokens")
     threads = [threading.Thread(target=run, args=(i,)) for i in range(n_requests)]
+    measure_wall_t0 = time.time()
     t0 = time.monotonic()
     for t in threads:
         t.start()
@@ -387,6 +434,12 @@ def run_worker(args) -> None:
     p50_ttft = sorted(t for t in ttfts if t is not None)[len(ttfts) // 2]
 
     extras = {"preset": preset, "p50_ttft_ms": round(p50_ttft * 1000, 1)}
+    # Percentile TTFT/TPOT from trace data (the flight recorder), not
+    # just the client-side median above.
+    try:
+        extras.update(trace_latency_stats(measure_wall_t0, expected=n_requests))
+    except Exception as e:  # pragma: no cover - stats are best-effort
+        log(f"trace latency stats unavailable: {e}")
     if args.speculate or args.greedy:
         drafted = eng.m_spec_drafted.value() - spec_base[0]
         accepted = eng.m_spec_accepted.value() - spec_base[1]

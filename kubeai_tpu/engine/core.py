@@ -53,6 +53,8 @@ from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.models import llama
 from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.obs import default_recorder
+from kubeai_tpu.obs.trace import RequestTrace, TraceContext
 
 log = logging.getLogger("kubeai_tpu.engine")
 
@@ -179,6 +181,9 @@ class Request:
     # (held-back chars; logprob None).
     cancelled: threading.Event = field(default_factory=threading.Event)
     arrival: float = field(default_factory=time.monotonic)
+    # Lifecycle trace (obs/): stamped by the scheduler loop, assembled
+    # into spans off-thread by the flight recorder.
+    trace: RequestTrace | None = None
 
 
 @dataclass
@@ -256,6 +261,31 @@ class Engine:
         )
         self.m_ttft = default_registry.histogram(
             "kubeai_engine_ttft_seconds", "time to first token"
+        )
+        # Per-phase latency histograms derived from request traces, and
+        # the outcome-labeled terminal accounting (EVERY request ends in
+        # exactly one of ok|error|cancelled — errored/cancelled requests
+        # previously hit no latency metric at all).
+        self.m_requests = default_registry.counter(
+            "kubeai_engine_requests_total",
+            "terminal request events by outcome (ok|error|cancelled)",
+        )
+        self.m_queue_wait = default_registry.histogram(
+            "kubeai_engine_queue_wait_seconds",
+            "submit to prefill dispatch (slot + KV page wait)",
+        )
+        self.m_prefill_s = default_registry.histogram(
+            "kubeai_engine_prefill_seconds",
+            "prefill dispatch to first emitted token",
+        )
+        self.m_tpot = default_registry.histogram(
+            "kubeai_engine_tpot_seconds",
+            "inter-token latency during decode",
+            buckets=(0.001, 0.0025, 0.005, 0.01, 0.025, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5),
+        )
+        self.m_e2e = default_registry.histogram(
+            "kubeai_request_e2e_seconds",
+            "request end-to-end latency by terminal outcome",
         )
         self.m_hbm_used = default_registry.gauge(
             "kubeai_engine_hbm_used_bytes", "accelerator memory in use"
@@ -787,6 +817,10 @@ class Engine:
             if slot is not None:
                 self._slots[i] = None
                 slot.req.out.put(("error", message))
+                self._finish_request(
+                    slot.req, "error",
+                    error=message, completion_tokens=slot.generated,
+                )
                 self._release_slot_pages(i)
         self._n_active = 0
         self._h_active[:] = False
@@ -794,6 +828,7 @@ class Engine:
         self.m_active.set(0)
         for req in self._deferred:
             req.out.put(("error", message))
+            self._finish_request(req, "error", error=message)
         self._deferred.clear()
         while True:
             try:
@@ -801,6 +836,7 @@ class Engine:
             except queue.Empty:
                 break
             req.out.put(("error", message))
+            self._finish_request(req, "error", error=message)
         while True:
             try:
                 *_, rq = self._aux.get_nowait()
@@ -809,10 +845,52 @@ class Engine:
             rq.put(("error", message))
         self.m_queue.set(0)
 
-    def submit(self, prompt_ids: list[int], params: SamplingParams, adapter: str | None = None) -> Request:
+    def _finish_request(self, req: Request, outcome: str, **attrs) -> None:
+        """Terminal accounting for EVERY request that entered submit():
+        the outcome counter, the per-phase histograms (a handful of
+        observes computed from the trace's raw stamps — cheap enough
+        for the scheduler thread), and the flight-recorder handoff
+        (span assembly happens on the recorder's worker thread)."""
+        tr = req.trace
+        if tr is not None and tr.end_mono is not None:
+            return  # already finalized by another terminal path
+        self.m_requests.inc(labels={"outcome": outcome})
+        if tr is None:
+            return
+        tr.finish(outcome, **attrs)
+        end = tr.end_mono
+        t_prefill = tr.first_mark("prefill")
+        # Queue wait ends at prefill dispatch; a request that never made
+        # it to a slot waited its whole life.
+        self.m_queue_wait.observe(
+            (t_prefill if t_prefill is not None else end) - tr.t0_mono
+        )
+        if t_prefill is not None:
+            first_tok = tr.tokens[0] if tr.tokens else end
+            self.m_prefill_s.observe(first_tok - t_prefill)
+        self.m_e2e.observe(end - tr.t0_mono, labels={"outcome": outcome})
+        # Per-token TPOT is O(generated tokens) worth of histogram
+        # observes — that runs on the recorder's worker thread, not here.
+        default_recorder.submit(tr, observe=self._observe_tpot)
+
+    def _observe_tpot(self, tr: RequestTrace) -> None:
+        """Recorder-worker-thread hook: derive inter-token latencies
+        from the raw token stamps (Histogram.observe is thread-safe)."""
+        for a, b in zip(tr.tokens, tr.tokens[1:]):
+            self.m_tpot.observe(b - a)
+
+    def submit(
+        self,
+        prompt_ids: list[int],
+        params: SamplingParams,
+        adapter: str | None = None,
+        trace_ctx: TraceContext | None = None,
+    ) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
         retries another replica on 503). Prompts beyond the largest prefill
-        bucket are chunk-prefilled, up to the slot capacity."""
+        bucket are chunk-prefilled, up to the slot capacity. *trace_ctx*
+        attaches the request to an inbound trace (proxy hop); omitted,
+        a fresh trace is generated — every request gets a timeline."""
         # The prompt plus at least one generated token must fit both the
         # position space and the page pool (minus the trash page).
         max_prompt = min(
@@ -828,6 +906,10 @@ class Engine:
         if not self._running:
             raise RuntimeError("engine is not running")
         req = Request(prompt_ids=prompt_ids, params=params, adapter=adapter)
+        req.trace = RequestTrace(
+            ctx=trace_ctx, component="engine", t0_mono=req.arrival
+        )
+        req.trace.attrs["prompt_tokens"] = len(prompt_ids)
         self._queue.put_nowait(req)
         self.m_queue.set(self.queue_depth())
         self._wake.set()
@@ -1095,6 +1177,14 @@ class Engine:
             self.m_hbm_used.set(used)
             self.m_hbm_limit.set(limit)
 
+    def is_ready(self) -> bool:
+        """Readiness (k8s probe seam): the scheduler loop is alive and
+        accepting submissions. Weights are resident by construction, so
+        a live loop is the whole signal."""
+        return bool(
+            self._running and self._thread is not None and self._thread.is_alive()
+        )
+
     def queue_depth(self) -> int:
         # Deferred requests (admitted off the queue but waiting for KV
         # pages) are still queued work from the autoscaler's viewpoint.
@@ -1336,6 +1426,7 @@ class Engine:
                     break
                 self.m_queue.set(self.queue_depth())
             if req.cancelled.is_set():
+                self._finish_request(req, "cancelled")
                 continue
             if req.adapter and (
                 self._adapters is None or self._adapters.row_for(req.adapter) == 0
@@ -1344,6 +1435,9 @@ class Engine:
                 # unloaded while the request sat in the queue — running
                 # it against the base model would be silently wrong.
                 req.out.put(("error", f"adapter {req.adapter!r} is not loaded"))
+                self._finish_request(
+                    req, "error", error=f"adapter {req.adapter!r} is not loaded"
+                )
                 continue
             plan = self._plan_admission(req, taken)
             if plan is None:
@@ -1390,6 +1484,7 @@ class Engine:
                 for slot_idx, req in items:
                     if self._slots[slot_idx] is None:
                         req.out.put(("error", f"prefill failed: {e}"))
+                        self._finish_request(req, "error", error=f"prefill failed: {e}")
                         # The prefill never wrote this slot's pages: any
                         # plan-time content registration must be undone
                         # so the never-written KV can't be prefix-reused.
@@ -1415,6 +1510,9 @@ class Engine:
                         for slot_idx, req in later_items:
                             if self._slots[slot_idx] is None:
                                 req.out.put(("error", f"prefill failed: {e}"))
+                                self._finish_request(
+                                    req, "error", error=f"prefill failed: {e}"
+                                )
                     raise
         return admitted
 
@@ -1539,6 +1637,10 @@ class Engine:
         ids = req.prompt_ids
         sp = req.params
         seed = self._seed32(sp)
+        if req.trace is not None:
+            req.trace.mark("prefill")
+            req.trace.attrs["reuse_tokens"] = reuse
+        t_disp = time.monotonic()
 
         lora_args = {}
         lora_row = 0
@@ -1589,6 +1691,11 @@ class Engine:
                 )
 
         self._register(slot_idx, req, seed, lora_row, reuse)
+        default_recorder.record_step(
+            kind="prefill_chunked", slot=slot_idx,
+            prompt_tokens=len(ids), reuse_tokens=reuse,
+            dur_ms=round((time.monotonic() - t_disp) * 1000, 3),
+        )
         return (slot_idx, self._slot_epoch[slot_idx], tok, None, lp, t_ids, t_lp)
 
     def _bias_rows(self, sp: SamplingParams) -> tuple[np.ndarray, np.ndarray]:
@@ -1674,6 +1781,10 @@ class Engine:
         lets warmup cover every shape the measure phase hits (round 2's
         pow2 padding compiled new shapes mid-measurement)."""
         n = len(items)
+        t_disp = time.monotonic()
+        for _, req in items:
+            if req.trace is not None:
+                req.trace.mark("prefill")
         n_pad = 1 if n == 1 else max(1, min(self.cfg.prefill_group_cap, self.cfg.max_slots))
 
         tokens = np.zeros((n_pad, bucket), np.int32)
@@ -1743,6 +1854,12 @@ class Engine:
         for j, (slot_idx, req) in enumerate(items):
             self._register(slot_idx, req, seeds[j], int(lora_rows_arr[j]), reuse=0)
             out.append((slot_idx, self._slot_epoch[slot_idx], toks, j, lps, t_ids, t_lp))
+        default_recorder.record_step(
+            kind="prefill_group", bucket=bucket, batch=n,
+            slots=[s for s, _ in items],
+            prompt_tokens=int(sum(len(r.prompt_ids) for _, r in items)),
+            dur_ms=round((time.monotonic() - t_disp) * 1000, 3),
+        )
         return out
 
     def _dispatch_chunk(self):
@@ -1834,6 +1951,8 @@ class Engine:
         lp_d = np.asarray(lp_d)  # [K, B, G]
         lp_c = np.asarray(lp_c)  # [K, B]
         G = drafts.shape[2]
+        n_emitted = 0
+        spec_drafted = spec_accepted = 0
         for k in range(acc.shape[0]):
             for i, slot_obj, epoch in snapshot:
                 a = int(acc[k, i])
@@ -1861,6 +1980,8 @@ class Engine:
                         and slot_obj.req.params.temperature <= 0.0:
                     self.m_spec_drafted.inc(G)
                     self.m_spec_accepted.inc(a)
+                    spec_drafted += G
+                    spec_accepted += a
                 for tok, lp, top in emitted:
                     # Record KV residency for prefix reuse: each step
                     # WROTE its pending (input) token; each emitted token
@@ -1876,6 +1997,24 @@ class Engine:
                     # since dispatch).
                     if self._slots[i] is slot_obj:
                         self._emit_token(i, tok, lp, top)
+                        n_emitted += 1
+        # Flight-recorder step record: what the scheduler dispatched and
+        # what came back (the /debug/engine view — batch composition,
+        # token counts, kernel flavor, pages in use).
+        step: dict = {
+            "kind": "decode_chunk",
+            "steps": int(acc.shape[0]),
+            "slots": [i for i, _, _ in snapshot],
+            "tokens": n_emitted,
+            "kernel": self._decode_kernel,
+            "pages_used": self._pool.used(),
+            "pages_total": self._pool.num_pages - 1,
+            "queue_depth": self.queue_depth(),
+        }
+        if G:
+            step["spec_drafted"] = spec_drafted
+            step["spec_accepted"] = spec_accepted
+        default_recorder.record_step(**step)
 
     def _emit_token(self, slot_idx: int, token_id: int, logprob: float | None = None, top=None):
         """Deliver one generated token to the request; apply stop logic.
@@ -1892,6 +2031,8 @@ class Engine:
 
         slot.generated += 1
         self.m_gen.inc()
+        if req.trace is not None:
+            req.trace.tok()  # one monotonic read + list append
 
         eos = self.tokenizer.eos_id
         if eos is not None and token_id == eos:
@@ -1953,6 +2094,10 @@ class Engine:
             slot.req.out.put(
                 ("done", FinishInfo(reason, slot.prompt_len, slot.generated))
             )
+        self._finish_request(
+            slot.req, "ok" if deliver else "cancelled",
+            finish_reason=reason, completion_tokens=slot.generated,
+        )
 
 
 def build_test_engine(

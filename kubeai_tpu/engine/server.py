@@ -35,6 +35,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from kubeai_tpu.engine.core import Engine
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.obs import extract_context, handle_debug_request
 
 log = logging.getLogger("kubeai_tpu.engine.server")
 
@@ -130,9 +131,27 @@ def _make_handler(srv: EngineServer):
         # ---- routes ----
 
         def do_GET(self):
-            path = self.path.split("?")[0]
-            if path in ("/health", "/healthz", "/readyz"):
+            path, _, query = self.path.partition("?")
+            if path in ("/health", "/healthz"):
                 self._json(200, {"status": "ok", "model": srv.model_name})
+            elif path == "/readyz":
+                # Readiness is distinct from liveness: not-ready until
+                # the engine's scheduler loop is accepting work, so k8s
+                # probes stop routing to pods whose engine is down.
+                if srv.engine.is_ready():
+                    self._json(200, {"status": "ok", "model": srv.model_name})
+                else:
+                    self._json(503, {"status": "engine not ready", "model": srv.model_name})
+            elif path.startswith("/debug/"):
+                resp = handle_debug_request(path, query)
+                if resp is None:
+                    return self._error(404, f"no route {path}")
+                code, ctype, body = resp
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path == "/metrics":
                 try:
                     srv.engine.refresh_memory_stats()
@@ -166,15 +185,19 @@ def _make_handler(srv: EngineServer):
             rid = sanitize_request_id(self.headers.get("X-Request-ID", ""))
             if rid and path.startswith("/v1/"):
                 log.info("request id=%s engine=%s path=%s", rid, srv.model_name, path)
+            # Trace context: the proxy stamps `traceparent` (W3C) on the
+            # hop; absent that, the trace id derives from X-Request-ID
+            # so proxy- and engine-side timelines still join.
+            trace_ctx = extract_context(self.headers, fallback_request_id=rid)
             try:
                 body = json.loads(self._read_body() or b"{}")
             except json.JSONDecodeError as e:
                 return self._error(400, f"invalid JSON: {e}")
             try:
                 if path == "/v1/completions":
-                    self._completions(body, chat=False)
+                    self._completions(body, chat=False, trace_ctx=trace_ctx)
                 elif path == "/v1/chat/completions":
-                    self._completions(body, chat=True)
+                    self._completions(body, chat=True, trace_ctx=trace_ctx)
                 elif path == "/v1/embeddings":
                     self._embeddings(body)
                 elif path == "/v1/load_lora_adapter":
@@ -266,7 +289,7 @@ def _make_handler(srv: EngineServer):
                 return None, None
             return prompt, None
 
-        def _completions(self, body: dict, chat: bool):
+        def _completions(self, body: dict, chat: bool, trace_ctx=None):
             tok = srv.engine.tokenizer
             prompt_ids = None
             if chat:
@@ -402,7 +425,16 @@ def _make_handler(srv: EngineServer):
                     p_i = params
                     if i > 0 and params.seed is not None:
                         p_i = dataclasses.replace(params, seed=params.seed + i)
-                    reqs.append(srv.engine.submit(prompt_ids, p_i, adapter=adapter))
+                    # Each choice is its own engine request: same trace,
+                    # one child span per choice.
+                    r = srv.engine.submit(
+                        prompt_ids, p_i, adapter=adapter, trace_ctx=trace_ctx
+                    )
+                    if r.trace is not None:
+                        r.trace.model = srv.model_name
+                        if n_choices > 1:
+                            r.trace.attrs["choice"] = i
+                    reqs.append(r)
             except ValueError as e:
                 _cancel_all(reqs)
                 return self._error(400, str(e))

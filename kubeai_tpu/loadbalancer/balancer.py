@@ -131,8 +131,11 @@ class LoadBalancer:
         """Returns (addr, done_fn). Blocks until an endpoint exists.
         *exclude*: addresses that already failed this request (retries
         prefer fresh endpoints when any exist)."""
+        import time as _time
+
         lb = req.load_balancing
-        return self.group(req.model_name).get_best_addr(
+        t0 = _time.monotonic()
+        addr, done = self.group(req.model_name).get_best_addr(
             strategy=lb.strategy,
             prefix=req.prefix,
             adapter=req.adapter,
@@ -141,6 +144,17 @@ class LoadBalancer:
             cancelled=cancelled,
             exclude=exclude,
         )
+        # Endpoint-pick span (duck-typed obs.SpanBuilder): this wait IS
+        # the scale-from-zero cold start when no endpoint exists yet.
+        tr = getattr(req, "trace", None)
+        if tr is not None:
+            try:
+                tr.add_span(
+                    "endpoint_pick", t0, strategy=lb.strategy, endpoint=addr
+                )
+            except Exception:  # tracing must never fail routing
+                pass
+        return addr, done
 
     def get_all_addresses(self, model_name: str) -> list[str]:
         return self.group(model_name).get_all_addrs()

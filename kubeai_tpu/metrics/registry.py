@@ -14,12 +14,46 @@ import threading
 from bisect import bisect_left
 
 
+def _escape_label_value(v: str) -> str:
+    """Prometheus exposition-format label escaping: backslash FIRST
+    (escaping it last would corrupt the escapes just written), then
+    quote and newline."""
+    return v.replace("\\", "\\\\").replace('"', '\\"').replace("\n", "\\n")
+
+
+def _unescape_label_value(v: str) -> str:
+    """Single left-to-right pass — the inverse of _escape_label_value.
+    Sequential str.replace calls are NOT an inverse: unescaping \\"
+    before \\\\ turns a value ending in literal backslash-then-quote
+    into the wrong bytes (each replace rescans text the previous one
+    already produced)."""
+    out: list[str] = []
+    i, n = 0, len(v)
+    while i < n:
+        c = v[i]
+        if c == "\\" and i + 1 < n:
+            nxt = v[i + 1]
+            if nxt == "\\":
+                out.append("\\")
+            elif nxt == '"':
+                out.append('"')
+            elif nxt == "n":
+                out.append("\n")
+            else:  # unknown escape: keep verbatim
+                out.append(c)
+                out.append(nxt)
+            i += 2
+            continue
+        out.append(c)
+        i += 1
+    return "".join(out)
+
+
 def _fmt_labels(labels: dict[str, str]) -> str:
     if not labels:
         return ""
     inner = ",".join(
-        f'{k}="{v.replace(chr(92), chr(92) * 2).replace(chr(34), chr(92) + chr(34))}"'
-        for k, v in sorted(labels.items())
+        f'{k}="{_escape_label_value(v)}"' for k, v in sorted(labels.items())
     )
     return "{" + inner + "}"
 
@@ -168,7 +202,12 @@ def parse_prometheus_text(text: str) -> dict[str, list[tuple[dict[str, str], flo
                     if not part:
                         continue
                     k, v = part.split("=", 1)
-                    labels[k] = v.strip('"').replace('\\"', '"').replace("\\\\", "\\")
+                    v = v.strip()
+                    # Strip exactly the delimiting quote pair (.strip('"')
+                    # would also eat quotes that belong to the value).
+                    if len(v) >= 2 and v[0] == '"' and v[-1] == '"':
+                        v = v[1:-1]
+                    labels[k.strip()] = _unescape_label_value(v)
                 out.setdefault(name.strip(), []).append((labels, float(valstr)))
             else:
                 name, valstr = line.rsplit(None, 1)

@@ -1,0 +1,38 @@
+"""Observability: request-lifecycle tracing + engine flight recorder.
+
+Dependency-free stand-in for the reference's OTel wiring
+(ref: internal/manager/otel.go): trace-context propagation over the
+proxy->engine hop, per-request phase timelines in a bounded ring
+buffer, scheduler step records, and a Chrome-trace/Perfetto export —
+all served from /debug endpoints on both HTTP servers.
+"""
+
+from kubeai_tpu.obs.recorder import (
+    DEBUG_PATHS,
+    FlightRecorder,
+    default_recorder,
+    handle_debug_request,
+)
+from kubeai_tpu.obs.trace import (
+    RequestTrace,
+    Span,
+    SpanBuilder,
+    TraceContext,
+    extract_context,
+    parse_traceparent,
+    trace_id_from_request_id,
+)
+
+__all__ = [
+    "DEBUG_PATHS",
+    "FlightRecorder",
+    "default_recorder",
+    "handle_debug_request",
+    "RequestTrace",
+    "Span",
+    "SpanBuilder",
+    "TraceContext",
+    "extract_context",
+    "parse_traceparent",
+    "trace_id_from_request_id",
+]

@@ -33,6 +33,10 @@ class Request:
     body: _Body | None = None
     raw_body: bytes | None = None  # multipart passthrough (model field stripped)
     model_obj: object = None
+    # obs.SpanBuilder attached by the proxy handler (duck-typed so this
+    # module stays import-light); the load balancer annotates its
+    # endpoint-pick span onto it when present.
+    trace: object = None
 
     @property
     def load_balancing(self) -> mt.LoadBalancing:
@@ -50,10 +54,14 @@ def sanitize_request_id(rid: str) -> str:
     """Correlation ids go into HTTP headers and log lines: restrict to a
     safe charset (a newline would fail http.client's header validation
     and allow log forging) and bound the length. Returns "" when nothing
-    safe remains — callers fall back to a generated id."""
-    import re
+    safe remains — callers fall back to a generated id.
 
-    return re.sub(r"[^A-Za-z0-9._\-]", "", str(rid))[:128]
+    Delegates to the canonical rule in obs.trace: the proxy and engine
+    derive trace ids from the SANITIZED request id, so the two rules
+    drifting apart would silently break the cross-hop trace join."""
+    from kubeai_tpu.obs.trace import sanitize_request_id as _canonical
+
+    return _canonical(rid)
 
 
 def split_model_adapter(s: str) -> tuple[str, str]:

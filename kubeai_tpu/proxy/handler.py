@@ -22,6 +22,7 @@ import time
 
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
+from kubeai_tpu.obs import SpanBuilder, extract_context
 from kubeai_tpu.proxy.apiutils import APIError, Request, parse_request
 
 log = logging.getLogger("kubeai_tpu.proxy")
@@ -48,35 +49,67 @@ class ModelProxy:
 
     def handle(self, raw_body: bytes, path: str, headers: dict[str, str], cancelled: threading.Event | None = None):
         """Returns a ProxyResult; raises APIError for client errors."""
-        req = parse_request(self.model_client, raw_body, path, headers)
-        # Honor an inbound correlation id; otherwise use the parsed id.
-        from kubeai_tpu.proxy.apiutils import sanitize_request_id
+        # Trace context first (inbound traceparent, else derived from
+        # X-Request-ID, else generated): even parse failures get a
+        # recorded timeline.
+        tb = SpanBuilder(extract_context(headers), component="proxy")
+        try:
+            with tb.span("parse"):
+                req = parse_request(self.model_client, raw_body, path, headers)
+            # Honor an inbound correlation id; otherwise use the parsed id.
+            from kubeai_tpu.proxy.apiutils import sanitize_request_id
 
-        inbound = sanitize_request_id(
-            next((v for k, v in headers.items() if k.lower() == "x-request-id"), "")
-        )
-        if inbound:
-            req.id = inbound
-        log.info("request id=%s model=%s path=%s", req.id, req.model_name, path)
+            inbound = sanitize_request_id(
+                next((v for k, v in headers.items() if k.lower() == "x-request-id"), "")
+            )
+            if inbound:
+                req.id = inbound
+            # The timeline must be findable by the SAME id the response
+            # echoes (req.id) — with no inbound X-Request-ID,
+            # extract_context had only a placeholder.
+            tb.ctx.request_id = req.id
+            tb.model = req.model_name
+            req.trace = tb
+            log.info(
+                "request id=%s trace=%s model=%s path=%s",
+                req.id, tb.ctx.trace_id, req.model_name, path,
+            )
 
-        labels = {"request_model": req.model_name, "request_type": "http"}
-        self.active.add(1, labels=labels)
-        release = lambda: self.active.add(-1, labels=labels)
+            labels = {"request_model": req.model_name, "request_type": "http"}
+            self.active.add(1, labels=labels)
+            release = lambda: self.active.add(-1, labels=labels)
+        except APIError as e:
+            tb.finish("error", status=e.code, error=e.message)
+            raise
 
         try:
-            self.model_client.scale_at_least_one_replica(req.model_obj)
+            with tb.span("scale_from_zero"):
+                self.model_client.scale_at_least_one_replica(req.model_obj)
             return self._proxy_with_retries(req, path, headers, release, cancelled)
-        except BaseException:
+        except BaseException as e:
             release()
+            tb.finish(
+                "error",
+                status=getattr(e, "code", 0) or 500,
+                error=str(e)[:200],
+            )
             raise
 
     def _proxy_with_retries(self, req: Request, path: str, headers: dict[str, str], release, cancelled):
         body = req.body_bytes()
         t0 = time.monotonic()
+        tb: SpanBuilder | None = req.trace
         # Propagate downstream (dropping any case-variant inbound copy so
-        # the engine never sees a duplicated header).
-        headers = {k: v for k, v in headers.items() if k.lower() != "x-request-id"}
+        # the engine never sees a duplicated header). The traceparent is
+        # REWRITTEN, not forwarded: the engine's spans must parent onto
+        # the proxy's span, not onto the client's.
+        headers = {
+            k: v for k, v in headers.items()
+            if k.lower() not in ("x-request-id", "traceparent")
+        }
         headers["X-Request-ID"] = req.id
+        if tb is not None:
+            headers["traceparent"] = tb.child_traceparent()
         last_err: Exception | str | None = None
         attempts = self.max_retries + 1
         failed_addrs: set[str] = set()
@@ -89,12 +122,18 @@ class ModelProxy:
             except TimeoutError as e:
                 # handle()'s except clause performs the gauge release.
                 raise APIError(503, f"no ready endpoints for {req.model_name}: {e}")
+            t_conn = time.monotonic()
             try:
                 resp, conn = self._connect(addr, path, headers, body)
             except (ConnectionError, OSError, http.client.HTTPException) as e:
                 done()
                 failed_addrs.add(addr)
                 last_err = e
+                if tb is not None:
+                    tb.add_span(
+                        "upstream", t_conn,
+                        endpoint=addr, attempt=attempt + 1, error=str(e)[:200],
+                    )
                 log.info("connection to %s failed (%s); attempt %d", addr, e, attempt + 1)
                 continue
             if resp.status in RETRYABLE_CODES and attempt < attempts - 1:
@@ -104,6 +143,11 @@ class ModelProxy:
                 )
                 last_err = f"upstream status {resp.status}"
                 failed_addrs.add(addr)
+                if tb is not None:
+                    tb.add_span(
+                        "upstream", t_conn,
+                        endpoint=addr, attempt=attempt + 1, status=resp.status,
+                    )
                 try:
                     resp.read()
                 finally:
@@ -118,8 +162,11 @@ class ModelProxy:
             resp_headers = [
                 (k, v) for k, v in resp.getheaders() if k.lower() != "x-request-id"
             ] + [("X-Request-ID", req.id)]
+            if tb is not None:
+                tb.attrs.update(endpoint=addr, status=resp.status, attempts=attempt + 1)
             return ProxyResult(
-                resp.status, resp_headers, self._body_iter(resp, conn, done, release)
+                resp.status, resp_headers,
+                self._body_iter(resp, conn, done, release, tb=tb, t_conn=t_conn, cancelled=cancelled),
             )
         log.info(
             "request id=%s model=%s failed after %d attempts: %s",
@@ -141,9 +188,11 @@ class ModelProxy:
         return conn.getresponse(), conn
 
     @staticmethod
-    def _body_iter(resp, conn, done, release):
+    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None):
         """Stream the upstream body; exactly-once cleanup on exhaustion or
-        generator close (client disconnect)."""
+        generator close (client disconnect). The proxy timeline closes
+        HERE — the upstream span covers connect through last byte, so
+        streaming time is attributed, not just headers latency."""
         try:
             while True:
                 chunk = resp.read(65536)
@@ -154,6 +203,18 @@ class ModelProxy:
             conn.close()
             done()
             release()
+            if tb is not None:
+                if t_conn is not None:
+                    tb.add_span(
+                        "upstream", t_conn,
+                        endpoint=tb.attrs.get("endpoint", ""),
+                        status=resp.status,
+                    )
+                if cancelled is not None and cancelled.is_set():
+                    outcome = "cancelled"
+                else:
+                    outcome = "ok" if resp.status < 400 else "error"
+                tb.finish(outcome, status=resp.status)
 
     @staticmethod
     def _upstream_path(path: str) -> str:

@@ -16,6 +16,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.obs import handle_debug_request
 from kubeai_tpu.proxy.apiutils import (
     APIError,
     parse_label_selector,
@@ -48,6 +49,25 @@ class OpenAIServer:
 
     def stop(self):
         self.httpd.shutdown()
+
+    def readiness(self) -> tuple[bool, dict]:
+        """Readiness for k8s probes, distinct from the always-ok
+        liveness endpoints: this operator pod is ready only when every
+        model that should be warm (min_replicas > 0) has at least one
+        ready endpoint — until then, routing traffic here just queues
+        requests behind cold pods. Models at min_replicas == 0 don't
+        gate readiness (scale-from-zero blocking is their contract)."""
+        cold = []
+        try:
+            for m in self.model_client.list_all_models():
+                if (m.spec.min_replicas or 0) > 0:
+                    if not self.proxy.lb.get_all_addresses(m.meta.name):
+                        cold.append(m.meta.name)
+        except Exception as e:  # store hiccup: fail closed with a reason
+            return False, {"status": "not ready", "error": str(e)[:200]}
+        if cold:
+            return False, {"status": "not ready", "cold_models": sorted(cold)}
+        return True, {"status": "ok"}
 
     def list_models(self, selectors: dict[str, str]) -> list[dict]:
         """Models + adapter-expanded ids (ref: models.go:13-109)."""
@@ -101,9 +121,22 @@ def _make_handler(srv: OpenAIServer):
             self._json(e.code, {"error": {"message": e.message, "type": "invalid_request_error" if e.code < 500 else "internal_error"}}, rid=rid)
 
         def do_GET(self):
-            path = self.path.split("?")[0]
-            if path in ("/healthz", "/readyz", "/health"):
+            path, _, query = self.path.partition("?")
+            if path in ("/healthz", "/health"):
                 self._json(200, {"status": "ok"})
+            elif path == "/readyz":
+                ready, info = srv.readiness()
+                self._json(200 if ready else 503, info)
+            elif path.startswith("/debug/"):
+                resp = handle_debug_request(path, query)
+                if resp is None:
+                    return self._json(404, {"error": {"message": f"no route {path}"}})
+                code, ctype, body = resp
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path == "/metrics":
                 body = default_registry.render().encode()
                 self.send_response(200)

@@ -3,6 +3,7 @@ from kubeai_tpu.metrics.registry import (
     Gauge,
     Histogram,
     Registry,
+    _fmt_labels,
     parse_prometheus_text,
 )
 
@@ -51,3 +52,63 @@ def test_type_conflict_raises():
         assert False
     except TypeError:
         pass
+
+
+# -- exposition-format conformance -------------------------------------------
+
+
+def test_label_unescape_order_roundtrip():
+    """A label value ending in literal backslash-quote used to round-trip
+    wrong: the parser unescaped \\" before \\\\, so each replace rescanned
+    text the previous one produced. Round-trip every nasty value through
+    the exact formatter the registry renders with."""
+    cases = [
+        "a\\",            # trailing backslash
+        'a\\"',           # literal backslash then quote (the ISSUE case)
+        "\\\\",           # two backslashes
+        '\\"',            # backslash-quote alone
+        '"quoted"',       # value delimited by its own quotes
+        "line\nbreak",    # newline must not split the exposition line
+        "mixed\\n\\\"x",  # literal backslash-n and backslash-quote text
+    ]
+    for val in cases:
+        line = f"m{_fmt_labels({'l': val})} 1.0"
+        assert "\n" not in line, f"raw newline leaked for {val!r}"
+        parsed = parse_prometheus_text(line)
+        assert parsed["m"][0][0]["l"] == val, (val, parsed)
+
+
+def test_histogram_le_cumulative_and_inf_bucket():
+    reg = Registry()
+    h = reg.histogram("h", "help", buckets=(0.1, 1.0, 10.0))
+    for v in (0.05, 0.05, 0.5, 5.0, 50.0):
+        h.observe(v, labels={"m": "x"})
+    parsed = parse_prometheus_text(reg.render())
+    by_le = {e[0]["le"]: e[1] for e in parsed["h_bucket"]}
+    # le buckets are CUMULATIVE counts of observations <= bound.
+    assert by_le["0.1"] == 2.0
+    assert by_le["1.0"] == 3.0
+    assert by_le["10.0"] == 4.0
+    assert by_le["+Inf"] == 5.0
+    # +Inf equals _count; _sum matches the observations.
+    assert parsed["h_count"][0][1] == 5.0
+    assert abs(parsed["h_sum"][0][1] - 55.6) < 1e-9
+    # Bucket lines keep the original labels alongside le.
+    assert all(e[0]["m"] == "x" for e in parsed["h_bucket"])
+
+
+def test_full_registry_render_roundtrips_through_parser():
+    reg = Registry()
+    c = reg.counter("kubeai_c_total", "counter help")
+    g = reg.gauge("kubeai_g", "gauge help")
+    h = reg.histogram("kubeai_h_seconds", "histogram help", buckets=(0.5,))
+    c.inc(3, labels={"model": 'we"ird\\'})
+    g.set(-1.5)
+    h.observe(0.25, labels={"outcome": "ok"})
+    h.observe(2.0, labels={"outcome": "ok"})
+    parsed = parse_prometheus_text(reg.render())
+    assert parsed["kubeai_c_total"] == [({"model": 'we"ird\\'}, 3.0)]
+    assert parsed["kubeai_g"] == [({}, -1.5)]
+    buckets = {e[0]["le"]: e[1] for e in parsed["kubeai_h_seconds_bucket"]}
+    assert buckets == {"0.5": 1.0, "+Inf": 2.0}
+    assert parsed["kubeai_h_seconds_count"] == [({"outcome": "ok"}, 2.0)]

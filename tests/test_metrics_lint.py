@@ -1,0 +1,92 @@
+"""Tier-1 lint over the metric surface: every metric registered on the
+default registry must carry non-empty HELP text and the kubeai_ name
+prefix, and every metric name the observability doc mentions must exist
+in code — catching doc/metric drift at test time instead of on a
+dashboard."""
+
+import ast
+import pathlib
+import re
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "kubeai_tpu"
+DOC = REPO / "docs" / "observability.md"
+
+_KINDS = {"counter", "gauge", "histogram"}
+
+
+def _registration_calls():
+    """(file, lineno, name_literal_or_None, help_literal_or_None) for
+    every <registry>.counter/gauge/histogram(...) call in the package.
+    Matches by method name — Registry is the only thing in-tree exposing
+    this trio — so indirect handles (self.registry, reg) are linted too."""
+    out = []
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _KINDS
+                and node.args
+            ):
+                continue
+            # Skip Registry's internal dispatch (_get_or_create calls) and
+            # plain-class constructors; only registration call sites with
+            # a positional name arg are interesting.
+            if isinstance(node.func.value, ast.Name) and node.func.value.id in (
+                "cls", "ast",
+            ):
+                continue
+            name = (
+                node.args[0].value
+                if isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+                else None
+            )
+            help_ = None
+            if len(node.args) > 1 and isinstance(node.args[1], ast.Constant):
+                if isinstance(node.args[1].value, str):
+                    help_ = node.args[1].value
+            for kw in node.keywords:
+                if kw.arg == "help_" and isinstance(kw.value, ast.Constant):
+                    help_ = kw.value.value
+            out.append((path.relative_to(REPO), node.lineno, name, help_))
+    return out
+
+
+def test_registered_metrics_have_help_and_prefix():
+    calls = _registration_calls()
+    assert calls, "no metric registrations found — lint scan broken?"
+    problems = []
+    for path, lineno, name, help_ in calls:
+        if name is not None and not name.startswith("kubeai_"):
+            problems.append(f"{path}:{lineno}: metric {name!r} lacks kubeai_ prefix")
+        if not help_ or not help_.strip():
+            problems.append(
+                f"{path}:{lineno}: metric {name or '<dynamic>'} registered "
+                "without HELP text"
+            )
+    assert not problems, "\n".join(problems)
+
+
+def test_doc_metric_names_exist_in_code():
+    code_names = {
+        name for _, _, name, _ in _registration_calls() if name is not None
+    }
+    # Names registered through constants (e.g. ACTIVE_REQUESTS).
+    from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
+
+    code_names.add(ACTIVE_REQUESTS)
+    doc_names = set(re.findall(r"kubeai_[a-z0-9_]+", DOC.read_text()))
+    # Histogram exposition suffixes may appear in docs; map to base name.
+    missing = []
+    for doc_name in sorted(doc_names):
+        base = re.sub(r"_(bucket|sum|count)$", "", doc_name)
+        if doc_name not in code_names and base not in code_names:
+            missing.append(doc_name)
+    assert not missing, (
+        "docs/observability.md mentions metrics that no code registers: "
+        + ", ".join(missing)
+    )
+    assert len(doc_names) > 10, "doc scan found suspiciously few metrics"

@@ -1,0 +1,253 @@
+"""E2E: request-lifecycle tracing across the full proxy -> engine path.
+
+Drives a real completion through OpenAIServer -> ModelProxy -> LB ->
+EngineServer (a real engine, tiny test model) and asserts the ISSUE's
+acceptance criteria: /debug/requests returns the request's timeline
+with queue/prefill/decode phases whose durations sum to ~the measured
+e2e latency, the Perfetto export is valid trace-event JSON, and the
+per-phase histograms land in /metrics with the request's outcome label.
+"""
+
+import json
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_proxy_integration import (
+    await_pods,
+    forge_ready,
+    mk_model,
+)
+from tests.test_proxy_integration import stack as stack  # fixture reuse  # noqa: F401
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.metrics.registry import parse_prometheus_text
+from kubeai_tpu.obs import default_recorder
+
+
+@pytest.fixture(scope="module")
+def engine_server():
+    from kubeai_tpu.engine.core import build_test_engine
+    from kubeai_tpu.engine.server import EngineServer
+
+    srv = EngineServer(build_test_engine(), "m1", host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+@pytest.fixture()
+def served(stack, engine_server):  # noqa: F811
+    store, rec, lb, mc, api, engines = stack
+    store.create(mt.KIND_MODEL, mk_model("m1", min_replicas=1))
+    pods = await_pods(store, "m1", 1)
+    forge_ready(store, pods[0].meta.name, engine_server)
+    return api, engine_server
+
+
+def _get(port, path, timeout=10):
+    with urllib.request.urlopen(f"http://127.0.0.1:{port}{path}", timeout=timeout) as r:
+        return r.status, json.loads(r.read())
+
+
+def _post_completion(api, body, headers=None, timeout=120):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        return resp.status, json.loads(resp.read()), resp.headers
+
+
+def _await_timeline(request_id, component, timeout=10.0):
+    """Span assembly is off-thread; poll the recorder until the terminal
+    handoff lands."""
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        for tl in default_recorder.snapshot():
+            if tl["request_id"] == request_id and tl["component"] == component:
+                return tl
+        time.sleep(0.05)
+    raise AssertionError(f"no {component} timeline for request {request_id}")
+
+
+def test_debug_requests_timeline_covers_e2e_latency(served):
+    api, eng_srv = served
+    rid = "obs-e2e-1"
+    # First request pays the compile; the measured one runs warm so the
+    # phase/e2e comparison is about steady-state attribution.
+    _post_completion(api, {"model": "m1", "prompt": "warm", "max_tokens": 4,
+                           "temperature": 0}, headers={"X-Request-ID": "obs-warm"})
+    t0 = time.monotonic()
+    status, body, resp_headers = _post_completion(
+        api,
+        {"model": "m1", "prompt": "hello trace", "max_tokens": 8, "temperature": 0},
+        headers={"X-Request-ID": rid},
+    )
+    e2e_ms = (time.monotonic() - t0) * 1000
+    assert status == 200
+    assert resp_headers.get("X-Request-ID") == rid
+
+    tl = _await_timeline(rid, "engine")
+    names = [p["name"] for p in tl["phases"]]
+    assert names == ["queue", "prefill", "decode"], names
+    assert tl["outcome"] == "ok"
+    assert tl["model"] == "m1"
+    # The phases partition the engine timeline...
+    phase_sum = sum(p["duration_ms"] for p in tl["phases"])
+    assert abs(phase_sum - tl["duration_ms"]) < 2.0
+    # ...and the engine timeline accounts for ~all of the client-visible
+    # e2e latency (the proxy adds parse/routing overhead, bounded here).
+    assert phase_sum <= e2e_ms + 2.0
+    assert phase_sum > 0.5 * e2e_ms, (phase_sum, e2e_ms)
+    decode = tl["phases"][2]
+    assert decode["attrs"]["tokens"] == body["usage"]["completion_tokens"]
+
+    # The proxy recorded its own timeline joined on the SAME trace id.
+    ptl = _await_timeline(rid, "proxy")
+    assert ptl["trace_id"] == tl["trace_id"]
+    pnames = [p["name"] for p in ptl["phases"]]
+    assert "parse" in pnames and "endpoint_pick" in pnames and "upstream" in pnames
+    assert ptl["outcome"] == "ok" and ptl["attrs"]["status"] == 200
+
+    # /debug/requests on BOTH servers serves the timeline by id.
+    for port in (api.port, eng_srv.port):
+        status, doc = _get(port, f"/debug/requests?id={rid}")
+        assert status == 200
+        comps = {t["component"] for t in doc["requests"]}
+        assert "engine" in comps
+
+
+def test_traceparent_propagates_to_engine_timeline(served):
+    api, _ = served
+    trace_id = "fe" * 16
+    tp = f"00-{trace_id}-{'cd' * 8}-01"
+    rid = "obs-tp-1"
+    status, _, _ = _post_completion(
+        api,
+        {"model": "m1", "prompt": "traceparent", "max_tokens": 2, "temperature": 0},
+        headers={"traceparent": tp, "X-Request-ID": rid},
+    )
+    assert status == 200
+    tl = _await_timeline(rid, "engine")
+    assert tl["trace_id"] == trace_id
+    ptl = _await_timeline(rid, "proxy")
+    assert ptl["trace_id"] == trace_id
+
+
+def test_perfetto_export_and_engine_steps(served):
+    api, eng_srv = served
+    _post_completion(api, {"model": "m1", "prompt": "steps", "max_tokens": 3,
+                           "temperature": 0})
+    status, doc = _get(eng_srv.port, "/debug/engine?limit=50")
+    assert status == 200
+    kinds = {s["kind"] for s in doc["steps"]}
+    assert "decode_chunk" in kinds
+    chunk = next(s for s in doc["steps"] if s["kind"] == "decode_chunk")
+    for key in ("steps", "slots", "tokens", "kernel", "pages_used", "pages_total"):
+        assert key in chunk, key
+
+    status, trace = _get(eng_srv.port, "/debug/trace?limit=20")
+    assert status == 200
+    events = trace["traceEvents"]
+    assert events
+    for ev in events:
+        assert ev["ph"] in ("X", "M")
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float))
+    assert any(ev["name"] == "decode" for ev in events)
+
+
+def test_phase_histograms_and_outcome_labels(served):
+    api, eng_srv = served
+    base = default_registry.counter("kubeai_engine_requests_total").value(
+        labels={"outcome": "ok"}
+    )
+    status, _, _ = _post_completion(
+        api, {"model": "m1", "prompt": "metrics", "max_tokens": 2, "temperature": 0}
+    )
+    assert status == 200
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline:
+        ok = default_registry.counter("kubeai_engine_requests_total").value(
+            labels={"outcome": "ok"}
+        )
+        if ok > base:
+            break
+        time.sleep(0.05)
+    assert ok > base, "no ok-outcome terminal event recorded"
+    # TPOT observes run on the recorder worker; snapshot() waits for the
+    # assembly queue to drain, so the scrape below is deterministic.
+    default_recorder.snapshot()
+
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{eng_srv.port}/metrics", timeout=10
+    ) as r:
+        parsed = parse_prometheus_text(r.read().decode())
+    for name in (
+        "kubeai_engine_queue_wait_seconds_count",
+        "kubeai_engine_prefill_seconds_count",
+        "kubeai_engine_tpot_seconds_count",
+    ):
+        assert parsed.get(name), f"{name} missing from /metrics"
+        assert sum(v for _, v in parsed[name]) >= 1
+    e2e = parsed.get("kubeai_request_e2e_seconds_count") or []
+    assert any(lbl.get("outcome") == "ok" and v >= 1 for lbl, v in e2e), e2e
+    req_total = parsed.get("kubeai_engine_requests_total") or []
+    assert any(lbl.get("outcome") == "ok" and v >= 1 for lbl, v in req_total)
+
+
+def test_cancelled_requests_hit_outcome_counter(served):
+    _, eng_srv = served
+    from kubeai_tpu.engine.sampling import SamplingParams
+
+    eng = eng_srv.engine
+    c = default_registry.counter("kubeai_engine_requests_total")
+    base = c.value(labels={"outcome": "cancelled"})
+    req = eng.submit([1, 2, 3], SamplingParams(max_tokens=64))
+    req.cancelled.set()
+    deadline = time.monotonic() + 10
+    while time.monotonic() < deadline:
+        if c.value(labels={"outcome": "cancelled"}) > base:
+            break
+        time.sleep(0.05)
+    assert c.value(labels={"outcome": "cancelled"}) > base
+
+
+def test_engine_readyz_reflects_engine_state(served):
+    _, eng_srv = served
+    status, doc = _get(eng_srv.port, "/readyz")
+    assert status == 200 and doc["status"] == "ok"
+
+
+def test_proxy_readyz_tracks_warm_model_endpoints(stack):  # noqa: F811
+    store, rec, lb, mc, api, engines = stack
+
+    def readyz():
+        try:
+            return _get(api.port, "/readyz")[0]
+        except urllib.error.HTTPError as e:
+            return e.code
+
+    # No models: vacuously ready.
+    assert readyz() == 200
+    # A model that SHOULD be warm (min_replicas=1) with no ready endpoint
+    # makes the operator not-ready — k8s keeps routing away until the
+    # pod comes up.
+    store.create(mt.KIND_MODEL, mk_model("cold1", min_replicas=1))
+    pods = await_pods(store, "cold1", 1)
+    assert readyz() == 503
+    from tests.test_proxy_integration import FakeEngine
+
+    eng = FakeEngine()
+    engines.append(eng)
+    forge_ready(store, pods[0].meta.name, eng)
+    deadline = time.monotonic() + 5
+    while time.monotonic() < deadline and readyz() != 200:
+        time.sleep(0.05)
+    assert readyz() == 200

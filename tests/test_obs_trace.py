@@ -1,0 +1,164 @@
+"""Unit tests for the obs/ tracing + flight-recorder subsystem:
+traceparent parsing/propagation, deterministic trace-id derivation,
+off-thread span assembly, ring bounds, and the Chrome-trace export."""
+
+import json
+import time
+
+from kubeai_tpu.obs import (
+    FlightRecorder,
+    RequestTrace,
+    SpanBuilder,
+    extract_context,
+    handle_debug_request,
+    parse_traceparent,
+    trace_id_from_request_id,
+)
+
+
+def test_parse_traceparent_roundtrip():
+    ctx = parse_traceparent("00-" + "ab" * 16 + "-" + "cd" * 8 + "-01")
+    assert ctx is not None
+    assert ctx.trace_id == "ab" * 16
+    assert ctx.span_id == "cd" * 8
+    assert ctx.sampled
+    assert parse_traceparent(ctx.traceparent()).trace_id == ctx.trace_id
+
+
+def test_parse_traceparent_rejects_garbage():
+    for bad in (
+        None, "", "nonsense", "00-short-cdcd-01",
+        "00-" + "0" * 32 + "-" + "cd" * 8 + "-01",  # all-zero trace id
+        "00-" + "ab" * 16 + "-" + "0" * 16 + "-01",  # all-zero span id
+        "ff-" + "ab" * 16 + "-" + "cd" * 8 + "-01",  # reserved version
+    ):
+        assert parse_traceparent(bad) is None, bad
+
+
+def test_extract_context_precedence():
+    tp = "00-" + "ab" * 16 + "-" + "cd" * 8 + "-01"
+    # traceparent wins over X-Request-ID.
+    ctx = extract_context({"traceparent": tp, "X-Request-ID": "rid-1"})
+    assert ctx.trace_id == "ab" * 16
+    assert ctx.request_id == "rid-1"
+    # Without traceparent the trace id derives DETERMINISTICALLY from the
+    # request id — proxy and engine parse headers independently and must
+    # land on the same trace.
+    a = extract_context({"X-Request-ID": "rid-1"})
+    b = extract_context({"x-request-id": "rid-1"})
+    assert a.trace_id == b.trace_id == trace_id_from_request_id("rid-1")
+    assert a.span_id != b.span_id  # span ids are always fresh
+    # Nothing inbound: generated, but usable.
+    c = extract_context({})
+    assert len(c.trace_id) == 32 and len(c.span_id) == 16 and c.request_id
+
+
+def test_child_context_keeps_trace_id():
+    ctx = extract_context({"X-Request-ID": "rid-2"})
+    child = ctx.child()
+    assert child.trace_id == ctx.trace_id
+    assert child.span_id != ctx.span_id
+    assert child.request_id == ctx.request_id
+
+
+def test_request_trace_assembly_phases():
+    rec = FlightRecorder(capacity=8)
+    tr = RequestTrace(component="engine", model="m1")
+    tr.mark("prefill")
+    tr.tok()
+    tr.tok()
+    tr.tok()
+    tr.finish("ok", completion_tokens=3)
+    rec.submit(tr)
+    (tl,) = rec.snapshot()
+    assert tl["component"] == "engine" and tl["model"] == "m1"
+    assert tl["outcome"] == "ok"
+    names = [p["name"] for p in tl["phases"]]
+    assert names == ["queue", "prefill", "decode"]
+    decode = tl["phases"][2]
+    assert decode["attrs"]["tokens"] == 3
+    assert len(decode["attrs"]["token_offsets_ms"]) == 3
+    # Contiguous phases partition the timeline.
+    total = sum(p["duration_ms"] for p in tl["phases"])
+    assert abs(total - tl["duration_ms"]) < 1.0
+
+
+def test_request_trace_never_admitted_has_queue_only():
+    rec = FlightRecorder(capacity=8)
+    tr = RequestTrace()
+    tr.finish("error", error="engine shutting down")
+    rec.submit(tr)
+    (tl,) = rec.snapshot()
+    assert [p["name"] for p in tl["phases"]] == ["queue"]
+    assert tl["outcome"] == "error"
+
+
+def test_ring_buffer_bounds():
+    rec = FlightRecorder(capacity=4, step_capacity=3)
+    for i in range(10):
+        tr = RequestTrace()
+        tr.attrs["i"] = i
+        tr.finish("ok")
+        rec.submit(tr)
+        rec.record_step(kind="decode_chunk", i=i)
+    tls = rec.snapshot()
+    assert len(tls) == 4
+    assert tls[0]["attrs"]["i"] == 9  # most recent first
+    steps = rec.engine_steps()
+    assert len(steps) == 3 and steps[0]["i"] == 9
+
+
+def test_chrome_trace_export_is_valid():
+    rec = FlightRecorder(capacity=8)
+    tr = RequestTrace(component="engine")
+    tr.mark("prefill")
+    tr.tok()
+    tr.finish("ok")
+    rec.submit(tr)
+    rec.record_step(kind="decode_chunk", steps=8, tokens=5, kernel="ragged")
+    doc = json.loads(json.dumps(rec.chrome_trace()))
+    events = doc["traceEvents"]
+    assert events, "no trace events"
+    for ev in events:
+        assert ev["ph"] in ("X", "M")
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        if ev["ph"] == "X":
+            assert isinstance(ev["ts"], (int, float))
+            assert isinstance(ev["dur"], (int, float))
+    names = {e["name"] for e in events}
+    assert "prefill" in names and "decode_chunk" in names
+
+
+def test_debug_endpoints_route_and_filter():
+    rec = FlightRecorder(capacity=8)
+    for rid in ("r1", "r2"):
+        tr = RequestTrace(ctx=extract_context({"X-Request-ID": rid}))
+        tr.finish("ok")
+        rec.submit(tr)
+    rec.snapshot()  # drain assembly
+    code, ctype, body = handle_debug_request("/debug/requests", "", rec)
+    assert code == 200 and ctype == "application/json"
+    assert len(json.loads(body)["requests"]) == 2
+    code, _, body = handle_debug_request("/debug/requests", "id=r1", rec)
+    got = json.loads(body)["requests"]
+    assert len(got) == 1 and got[0]["request_id"] == "r1"
+    code, _, body = handle_debug_request("/debug/engine", "limit=5", rec)
+    assert code == 200 and "steps" in json.loads(body)
+    code, _, body = handle_debug_request("/debug/trace", "", rec)
+    assert code == 200 and "traceEvents" in json.loads(body)
+    assert handle_debug_request("/debug/nope", "", rec) is None
+
+
+def test_span_builder_records_to_recorder():
+    rec = FlightRecorder(capacity=8)
+    tb = SpanBuilder(extract_context({"X-Request-ID": "p1"}), "proxy", model="m1")
+    with tb.span("parse"):
+        pass
+    t0 = time.monotonic()
+    tb.add_span("endpoint_pick", t0, strategy="LeastLoad", endpoint="1.2.3.4:8000")
+    tb.finish("ok", status=200, recorder=rec)
+    tb.finish("error", status=500, recorder=rec)  # idempotent: first wins
+    (tl,) = rec.snapshot()
+    assert tl["outcome"] == "ok" and tl["attrs"]["status"] == 200
+    assert [p["name"] for p in tl["phases"]] == ["parse", "endpoint_pick"]
+    assert tl["phases"][1]["attrs"]["endpoint"] == "1.2.3.4:8000"
