@@ -49,6 +49,7 @@ from kubeai_tpu.engine.sampling import (
     apply_penalties,
     sample,
 )
+from kubeai_tpu.faults import fault
 from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.models import llama
@@ -181,9 +182,18 @@ class Request:
     # (held-back chars; logprob None).
     cancelled: threading.Event = field(default_factory=threading.Event)
     arrival: float = field(default_factory=time.monotonic)
+    # Absolute end-to-end deadline (time.monotonic()); the scheduler
+    # aborts queued AND mid-decode requests past it (slot + pages freed,
+    # outcome=cancelled) instead of decoding for a caller that gave up.
+    deadline: float | None = None
     # Lifecycle trace (obs/): stamped by the scheduler loop, assembled
     # into spans off-thread by the flight recorder.
     trace: RequestTrace | None = None
+    # Terminal-accounting claim (set atomically by _finish_request under
+    # the engine's _in_system_lock): two threads finishing the same
+    # request concurrently — submit()'s shutdown race vs _fail_inflight —
+    # must not double-count metrics or double-decrement _in_system.
+    finished: bool = False
 
 
 @dataclass
@@ -242,6 +252,12 @@ class Engine:
         self._aux: "queue.Queue[tuple]" = queue.Queue()
         self._slots: list[_Slot | None] = [None] * self.cfg.max_slots
         self._n_active = 0
+        # Requests inside the engine (submit() accepted, no terminal
+        # accounting yet). Unlike queue_depth()+active_slots(), this has
+        # no blind window while a request is BETWEEN queue and slot
+        # (mid-admission) — drain's idle check must not race that gap.
+        self._in_system = 0
+        self._in_system_lock = threading.Lock()
         self._running = False
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
@@ -852,8 +868,16 @@ class Engine:
         for the scheduler thread), and the flight-recorder handoff
         (span assembly happens on the recorder's worker thread)."""
         tr = req.trace
+        # Atomic claim: the old `tr.end_mono is not None` check alone was
+        # check-then-act — two racing finishers could both pass it before
+        # either called tr.finish().
+        with self._in_system_lock:
+            if req.finished:
+                return
+            req.finished = True
+            self._in_system -= 1
         if tr is not None and tr.end_mono is not None:
-            return  # already finalized by another terminal path
+            return  # finalized externally (defensive; claim already took it)
         self.m_requests.inc(labels={"outcome": outcome})
         if tr is None:
             return
@@ -885,12 +909,18 @@ class Engine:
         params: SamplingParams,
         adapter: str | None = None,
         trace_ctx: TraceContext | None = None,
+        deadline: float | None = None,
     ) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
-        retries another replica on 503). Prompts beyond the largest prefill
-        bucket are chunk-prefilled, up to the slot capacity. *trace_ctx*
-        attaches the request to an inbound trace (proxy hop); omitted,
-        a fresh trace is generated — every request gets a timeline."""
+        retries another replica, and the server maps it to 429 +
+        Retry-After). Prompts beyond the largest prefill bucket are
+        chunk-prefilled, up to the slot capacity. *trace_ctx* attaches
+        the request to an inbound trace (proxy hop); omitted, a fresh
+        trace is generated — every request gets a timeline. *deadline*
+        (time.monotonic()-based) lets the scheduler abort the request —
+        queued or mid-decode — once the caller's budget is spent."""
+        # Failpoint: chaos tests inject admission errors/delays/hangs.
+        fault("engine.submit")
         # The prompt plus at least one generated token must fit both the
         # position space and the page pool (minus the trash page).
         max_prompt = min(
@@ -905,19 +935,43 @@ class Engine:
             raise ValueError(f"adapter {adapter!r} is not loaded")
         if not self._running:
             raise RuntimeError("engine is not running")
-        req = Request(prompt_ids=prompt_ids, params=params, adapter=adapter)
+        req = Request(
+            prompt_ids=prompt_ids, params=params, adapter=adapter,
+            deadline=deadline,
+        )
         req.trace = RequestTrace(
             ctx=trace_ctx, component="engine", t0_mono=req.arrival
         )
         req.trace.attrs["prompt_tokens"] = len(prompt_ids)
-        self._queue.put_nowait(req)
+        with self._in_system_lock:
+            self._in_system += 1
+        try:
+            self._queue.put_nowait(req)
+        except queue.Full:
+            with self._in_system_lock:
+                self._in_system -= 1
+            raise
+        if not self._running:
+            # Raced a concurrent stop(): _fail_inflight's queue drain may
+            # have run BEFORE our put, which would strand this request
+            # with no terminal event ever arriving. Fail it here —
+            # harmlessly doubled if the drain did see it (consumers take
+            # the first terminal event; _finish_request dedupes).
+            req.out.put(("error", "engine shutting down"))
+            self._finish_request(req, "error", error="engine shutting down")
+            return req
         self.m_queue.set(self.queue_depth())
         self._wake.set()
         return req
 
     def generate(self, prompt_ids: list[int], params: SamplingParams, timeout: float = 300, adapter: str | None = None):
-        """Blocking convenience wrapper: returns (token_ids, text, FinishInfo)."""
-        req = self.submit(prompt_ids, params, adapter=adapter)
+        """Blocking convenience wrapper: returns (token_ids, text, FinishInfo).
+        *timeout* doubles as the scheduler-side deadline, so a timed-out
+        generate() frees its slot/pages instead of decoding on."""
+        req = self.submit(
+            prompt_ids, params, adapter=adapter,
+            deadline=time.monotonic() + timeout,
+        )
         ids: list[int] = []
         chunks: list[str] = []
         deadline = time.monotonic() + timeout
@@ -1190,6 +1244,13 @@ class Engine:
         # pages) are still queued work from the autoscaler's viewpoint.
         return self._queue.qsize() + len(self._deferred)
 
+    def requests_in_system(self) -> int:
+        """Requests accepted by submit() with no terminal event yet —
+        queued, deferred, mid-admission, or decoding. The drain-idle
+        signal (queue_depth()+active_slots() misses the admission gap)."""
+        with self._in_system_lock:
+            return self._in_system
+
     def active_slots(self) -> int:
         return self._n_active
 
@@ -1307,6 +1368,11 @@ class Engine:
         pending = None  # (payload_device_refs, [(slot_idx, _Slot, epoch), ...])
         while self._running:
             try:
+                # Failpoint: chaos tests hang/fail the scheduler here —
+                # an injected error exercises the device-state recovery
+                # path below exactly like a real dispatch failure.
+                fault("engine.step")
+                self._sweep_deadlines()
                 admitted = self._admit_waiting()
                 dispatched = self._dispatch_chunk() if self._n_active > 0 else None
                 # First-token sync AFTER the dispatch: the chunk reads
@@ -1398,6 +1464,29 @@ class Engine:
         self._fail_inflight("engine reset after device error")
         self._init_device_state()
 
+    DEADLINE_MSG = "deadline exceeded"
+
+    def _sweep_deadlines(self) -> None:
+        """Abort active slots whose end-to-end deadline passed: the
+        caller (or the proxy on its behalf) has given up, so decode
+        steps spent on them starve live requests. The slot and its KV
+        pages free immediately; the terminal outcome is `cancelled`
+        (the work was abandoned, not failed). Runs once per scheduler
+        iteration — O(max_slots) host time."""
+        if all(s is None for s in self._slots):
+            return
+        now = time.monotonic()
+        for i, slot in enumerate(self._slots):
+            if slot is None or slot.req.deadline is None:
+                continue
+            if now > slot.req.deadline:
+                slot.req.out.put(("error", self.DEADLINE_MSG))
+                log.info(
+                    "aborting slot %d past deadline (%d tokens generated)",
+                    i, slot.generated,
+                )
+                self._free(i, "stop", deliver=False)
+
     def _admit_waiting(self) -> list:
         """Admit queued requests into free slots: plan pages, dispatch
         prefill calls (all-numpy args riding the execute RPC), and fill
@@ -1427,6 +1516,11 @@ class Engine:
                 self.m_queue.set(self.queue_depth())
             if req.cancelled.is_set():
                 self._finish_request(req, "cancelled")
+                continue
+            if req.deadline is not None and time.monotonic() > req.deadline:
+                # Expired while queued/deferred: never takes a slot at all.
+                req.out.put(("error", self.DEADLINE_MSG))
+                self._finish_request(req, "cancelled", error=self.DEADLINE_MSG)
                 continue
             if req.adapter and (
                 self._adapters is None or self._adapters.row_for(req.adapter) == 0

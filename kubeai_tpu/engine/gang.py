@@ -299,6 +299,12 @@ class GangPublisher:
             )
 
     def publish(self, op: str, scalars: dict | None = None, arrays: dict[str, np.ndarray] | None = None) -> None:
+        # Failpoint: chaos tests sever/stall the gang dispatch stream
+        # here; a FaultError is a ConnectionError, so it exercises the
+        # real GangLost fatal path in the engine.
+        from kubeai_tpu.faults import fault
+
+        fault("gang.publish")
         payload = _encode(op, scalars, arrays)
         with self._lock:
             for conn in self._conns:

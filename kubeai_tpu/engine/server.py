@@ -34,18 +34,40 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeai_tpu.engine.core import Engine
 from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.faults import handle_faults_request
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs import extract_context, handle_debug_request
 
 log = logging.getLogger("kubeai_tpu.engine.server")
 
+# Retry-After hint (seconds) on 429 backpressure responses.
+RETRY_AFTER_HINT = "1"
+
 
 class EngineServer:
-    def __init__(self, engine: Engine, model_name: str, host: str = "0.0.0.0", port: int = 8000):
+    def __init__(
+        self,
+        engine: Engine,
+        model_name: str,
+        host: str = "0.0.0.0",
+        port: int = 8000,
+        drain_grace: float = 30.0,
+    ):
         self.engine = engine
         self.model_name = model_name
         self.adapters: dict[str, str] = {}  # name -> path
         self._adapters_lock = threading.Lock()
+        # Graceful drain: once set, /readyz goes 503 (k8s stops routing),
+        # new inference gets 429 + Retry-After, and in-flight generations
+        # get up to drain_grace seconds to finish before the hard stop
+        # fails whatever remains (via the engine's _fail_inflight).
+        self.draining = threading.Event()
+        self.drain_grace = drain_grace
+        self._stop_lock = threading.Lock()
+        self._stopped = False
+        # Set once stop() completes; the CLI main blocks on it so a
+        # SIGTERM-initiated drain actually exits the process.
+        self.stopped_event = threading.Event()
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
@@ -58,8 +80,55 @@ class EngineServer:
         log.info("engine server for %s on :%d", self.model_name, self.port)
 
     def stop(self):
-        self.httpd.shutdown()
-        self.engine.stop()
+        """Idempotent hard stop. Ordering matters: stop ADMISSION first
+        (draining flag), then the engine — engine.stop() fails in-flight
+        requests, so live stream handlers see terminal events and finish
+        their responses — and shut the HTTP server down LAST, inside a
+        finally so a failing engine.stop() can never leak the serving
+        thread (the old shutdown-then-stop order raced handlers still
+        blocked on event queues that would never produce)."""
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.draining.set()
+        try:
+            self.engine.stop()
+        finally:
+            self.httpd.shutdown()
+            self.stopped_event.set()
+
+    def drain(self, grace: float | None = None) -> None:
+        """SIGTERM path: stop admission, let in-flight generations finish
+        up to the drain budget, then stop() (which fails the rest)."""
+        grace = self.drain_grace if grace is None else grace
+        self.draining.set()
+        log.info(
+            "engine draining: %d active slots, %d queued, grace %.1fs",
+            self.engine.active_slots(), self.engine.queue_depth(), grace,
+        )
+        deadline = time.monotonic() + grace
+        # requests_in_system(), not queue+slots: the latter has a blind
+        # window while a request is mid-admission (popped off the queue,
+        # not yet registered in a slot) that would end the drain early
+        # and hard-fail a request that was milliseconds from decoding.
+        while self.engine.requests_in_system() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        leftover = self.engine.requests_in_system()
+        if leftover:
+            log.warning("drain budget expired with %d requests in flight", leftover)
+        self.stop()
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM (kubelet shutdown) drains instead of killing mid-
+        stream. Main-thread only (signal module constraint); the drain
+        itself runs on a worker thread so the handler returns fast."""
+        import signal
+
+        def _on_term(signum, frame):
+            threading.Thread(target=self.drain, daemon=True).start()
+
+        signal.signal(signal.SIGTERM, _on_term)
 
     _ADAPTER_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,128}$")
 
@@ -113,16 +182,28 @@ def _make_handler(srv: EngineServer):
 
         # ---- helpers ----
 
-        def _json(self, code: int, obj):
+        def _json(self, code: int, obj, headers: dict | None = None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
-        def _error(self, code: int, msg: str, etype: str = "invalid_request_error"):
-            self._json(code, {"error": {"message": msg, "type": etype}})
+        def _error(self, code: int, msg: str, etype: str = "invalid_request_error", headers: dict | None = None):
+            self._json(code, {"error": {"message": msg, "type": etype}}, headers=headers)
+
+        def _saturated(self, msg: str = "engine saturated"):
+            """Backpressure response: 429 + Retry-After + OpenAI-shaped
+            body. A bare 503 invited synchronized retry storms — 429
+            tells SDKs (which all implement jittered backoff for it)
+            this is load, not failure."""
+            return self._error(
+                429, msg + "; retry after backoff", "rate_limit_error",
+                headers={"Retry-After": RETRY_AFTER_HINT},
+            )
 
         def _read_body(self):
             n = int(self.headers.get("Content-Length", 0))
@@ -137,13 +218,17 @@ def _make_handler(srv: EngineServer):
             elif path == "/readyz":
                 # Readiness is distinct from liveness: not-ready until
                 # the engine's scheduler loop is accepting work, so k8s
-                # probes stop routing to pods whose engine is down.
-                if srv.engine.is_ready():
+                # probes stop routing to pods whose engine is down — and
+                # 503 the moment a drain starts, so routing stops BEFORE
+                # the pod disappears.
+                if srv.draining.is_set():
+                    self._json(503, {"status": "draining", "model": srv.model_name})
+                elif srv.engine.is_ready():
                     self._json(200, {"status": "ok", "model": srv.model_name})
                 else:
                     self._json(503, {"status": "engine not ready", "model": srv.model_name})
             elif path.startswith("/debug/"):
-                resp = handle_debug_request(path, query)
+                resp = handle_faults_request(path, query) or handle_debug_request(path, query)
                 if resp is None:
                     return self._error(404, f"no route {path}")
                 code, ctype, body = resp
@@ -189,15 +274,29 @@ def _make_handler(srv: EngineServer):
             # hop; absent that, the trace id derives from X-Request-ID
             # so proxy- and engine-side timelines still join.
             trace_ctx = extract_context(self.headers, fallback_request_id=rid)
+            # Remaining end-to-end budget stamped by the proxy (seconds);
+            # converted to an absolute monotonic deadline HERE so queue
+            # wait counts against it.
+            deadline = None
+            dl_hdr = self.headers.get("X-Request-Deadline", "")
+            if dl_hdr:
+                try:
+                    deadline = time.monotonic() + max(float(dl_hdr), 0.0)
+                except ValueError:
+                    pass  # unparseable deadline = no deadline
             try:
                 body = json.loads(self._read_body() or b"{}")
             except json.JSONDecodeError as e:
                 return self._error(400, f"invalid JSON: {e}")
+            if srv.draining.is_set() and path.startswith("/v1/") and path != "/v1/models":
+                # Drain admission stop: in-flight work finishes, new work
+                # goes elsewhere (the proxy retries another replica).
+                return self._saturated("server is draining")
             try:
                 if path == "/v1/completions":
-                    self._completions(body, chat=False, trace_ctx=trace_ctx)
+                    self._completions(body, chat=False, trace_ctx=trace_ctx, deadline=deadline)
                 elif path == "/v1/chat/completions":
-                    self._completions(body, chat=True, trace_ctx=trace_ctx)
+                    self._completions(body, chat=True, trace_ctx=trace_ctx, deadline=deadline)
                 elif path == "/v1/embeddings":
                     self._embeddings(body)
                 elif path == "/v1/load_lora_adapter":
@@ -289,7 +388,7 @@ def _make_handler(srv: EngineServer):
                 return None, None
             return prompt, None
 
-        def _completions(self, body: dict, chat: bool, trace_ctx=None):
+        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None):
             tok = srv.engine.tokenizer
             prompt_ids = None
             if chat:
@@ -419,6 +518,10 @@ def _make_handler(srv: EngineServer):
             if so is not None and not body.get("stream"):
                 return self._error(400, "stream_options requires stream: true")
             so = so or {}
+            if deadline is not None and time.monotonic() >= deadline:
+                # Budget already spent before admission: refuse rather
+                # than enqueue work whose caller has given up.
+                return self._error(504, "deadline exceeded", "timeout_error")
             reqs = []
             try:
                 for i in range(n_choices):
@@ -428,7 +531,8 @@ def _make_handler(srv: EngineServer):
                     # Each choice is its own engine request: same trace,
                     # one child span per choice.
                     r = srv.engine.submit(
-                        prompt_ids, p_i, adapter=adapter, trace_ctx=trace_ctx
+                        prompt_ids, p_i, adapter=adapter, trace_ctx=trace_ctx,
+                        deadline=deadline,
                     )
                     if r.trace is not None:
                         r.trace.model = srv.model_name
@@ -439,8 +543,16 @@ def _make_handler(srv: EngineServer):
                 _cancel_all(reqs)
                 return self._error(400, str(e))
             except queue.Full:
+                # Saturation mid-loop (n > 1): the already-submitted
+                # sibling choices MUST be cancelled or they decode for a
+                # response that will never be written.
                 _cancel_all(reqs)
-                return self._error(503, "engine saturated", "overloaded_error")
+                return self._saturated()
+            except BaseException:
+                # Any other early exit (engine stopping, injected fault,
+                # handler thread dying): same sibling-leak hazard.
+                _cancel_all(reqs)
+                raise
 
             rid = ("chatcmpl-" if chat else "cmpl-") + uuid.uuid4().hex[:24]
             created = int(time.time())
@@ -459,7 +571,10 @@ def _make_handler(srv: EngineServer):
                     include_usage=bool(so.get("include_usage")),
                 )
             else:
-                self._full_response(reqs, rid, created, chat, want_logprobs, echo_text, top_n)
+                self._full_response(
+                    reqs, rid, created, chat, want_logprobs, echo_text, top_n,
+                    deadline=deadline,
+                )
 
         def _decode_safe(self, ids) -> str:
             try:
@@ -494,15 +609,21 @@ def _make_handler(srv: EngineServer):
                 out.setdefault(self._token_text(tid), lp)
             return out
 
-        def _full_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0):
+        def _full_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0, deadline=None):
             choices = []
             prompt_tokens = 0
             completion_tokens = 0
             for idx, req in enumerate(reqs):
                 chunks, pieces, fin = [], [], None
                 while True:
+                    wait = 600.0
+                    if deadline is not None:
+                        # The handler waits only as long as the budget:
+                        # the scheduler's own sweep frees the slot, but
+                        # the HTTP response must not outwait it.
+                        wait = min(wait, max(deadline - time.monotonic(), 0.0) + 1.0)
                     try:
-                        ev = req.out.get(timeout=600)
+                        ev = req.out.get(timeout=wait)
                     except queue.Empty:
                         _cancel_all(reqs)
                         return self._error(504, "generation timed out", "timeout_error")
@@ -519,6 +640,9 @@ def _make_handler(srv: EngineServer):
                         break
                     else:
                         _cancel_all(reqs)
+                        if ev[1] == Engine.DEADLINE_MSG:
+                            # Scheduler aborted past the request deadline.
+                            return self._error(504, ev[1], "timeout_error")
                         return self._error(500, ev[1], "internal_error")
                 text = "".join(chunks)
                 prompt_tokens = fin.prompt_tokens  # same prompt per choice
@@ -939,6 +1063,12 @@ def main(argv=None):
              "kernel, the dedicated S=1 decode-blocked kernel, or "
              "auto (picked by decode query length)",
     )
+    parser.add_argument(
+        "--drain-grace", type=float,
+        default=float(os.environ.get("KUBEAI_DRAIN_GRACE", "30")),
+        help="seconds SIGTERM lets in-flight generations finish before "
+             "the hard stop (keep below terminationGracePeriodSeconds)",
+    )
     args = parser.parse_args(argv)
     logging.basicConfig(level=logging.INFO)
 
@@ -967,12 +1097,16 @@ def main(argv=None):
         # Gang assembly: block until every follower is wired up before
         # serving (a dispatch before that would strand the followers).
         publisher.accept_all()
-    srv = EngineServer(engine, name, host=args.host, port=args.port)
+    srv = EngineServer(
+        engine, name, host=args.host, port=args.port,
+        drain_grace=args.drain_grace,
+    )
+    srv.install_signal_handlers()
     srv.start()
     log.info("serving %s", name)
     try:
-        while True:
-            time.sleep(3600)
+        while not srv.stopped_event.is_set():
+            srv.stopped_event.wait(3600)
     except KeyboardInterrupt:
         srv.stop()
 

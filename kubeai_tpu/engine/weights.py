@@ -105,6 +105,11 @@ def load_engine_from_path(
     shards (shard_tree), and the Engine allocates global device state.
     Rank 0 additionally passes *publisher* (engine/gang.py) so its
     dispatches fan out to the follower ranks."""
+    # Failpoint: chaos tests make cold starts fail/stall here (the
+    # crashloop-at-weight-load scenario the controller must absorb).
+    from kubeai_tpu.faults import fault
+
+    fault("weights.load")
     if quantization:
         if quantization != "int8":
             raise ValueError(f"unsupported quantization {quantization!r} (supported: int8)")

@@ -1,0 +1,299 @@
+"""Fault-injection failpoints: deterministic chaos without monkeypatching.
+
+A failpoint is a named site in production code — ``fault("proxy.connect")``
+— that is a no-op until a fault is armed on it. Chaos tests (and operators
+debugging a live system) arm faults by name:
+
+- programmatically: ``set_fault("proxy.connect", "error", times=2)``
+- by environment:   ``KUBEAI_FAILPOINTS="proxy.connect=error:2;engine.step=delay:0.05"``
+- over HTTP:        ``GET /debug/faults?set=proxy.connect=error:2`` (both the
+  proxy and engine servers mount the route; ``?clear=NAME`` / ``?clear=all``
+  disarm; a bare GET lists armed faults and hit counts).
+
+Modes (``spec`` grammar: ``mode[:arg][:key=val...]``):
+
+- ``error[:N]``     raise ``FaultError`` on the next N triggers (default:
+  every trigger). ``skip=K`` passes the first K triggers through first —
+  "fail the third call" is ``error:1:skip=2``.
+- ``delay:SECONDS`` sleep before proceeding.
+- ``hang``          block until the fault is cleared (or ``max=SECONDS``
+  elapses). ``clear_fault``/``clear_all`` release hung threads — chaos
+  tests hang a component, assert containment, then release it.
+- ``corrupt``       mangle a ``bytes`` payload passed to ``fault(...,
+  payload=...)`` (bitwise-inverted; length preserved). Non-bytes payloads
+  pass through unchanged.
+
+The registry is intentionally tiny and dependency-free; when nothing is
+armed, a failpoint costs one dict lookup on an empty dict.
+
+Known sites (grep ``fault(`` for ground truth):
+
+    proxy.connect        before each upstream connect attempt (payload: body)
+    balancer.reconcile   per endpoint-reconcile pass
+    engine.submit        request admission into the engine queue
+    engine.step          top of each scheduler-loop iteration
+    gang.publish         before each gang dispatch broadcast
+    weights.load         checkpoint loading
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+
+log = logging.getLogger("kubeai_tpu.faults")
+
+_lock = threading.Lock()
+_active: dict[str, "_Fault"] = {}
+
+
+class FaultError(ConnectionError, RuntimeError):
+    """Raised by an armed ``error`` failpoint. Subclasses ConnectionError
+    so network-shaped sites (proxy.connect, gang.publish) fail exactly
+    like a dead peer — the containment paths under test are the REAL
+    ones, not fault-special-cased branches."""
+
+    def __init__(self, name: str, message: str = ""):
+        super().__init__(message or f"injected fault at {name!r}")
+        self.name = name
+
+
+class _Fault:
+    __slots__ = ("name", "mode", "arg", "times", "skip", "max_s", "hits", "fired", "release")
+
+    def __init__(self, name: str, mode: str, arg: float | None, times: int | None, skip: int, max_s: float | None):
+        self.name = name
+        self.mode = mode
+        self.arg = arg
+        self.times = times  # None = unlimited
+        self.skip = skip
+        self.max_s = max_s
+        self.hits = 0  # triggers observed (incl. skipped)
+        self.fired = 0  # triggers that actually acted
+        self.release = threading.Event()  # set on clear: unhangs waiters
+
+    def describe(self) -> dict:
+        return {
+            "name": self.name,
+            "mode": self.mode,
+            "arg": self.arg,
+            "times": self.times,
+            "skip": self.skip,
+            "hits": self.hits,
+            "fired": self.fired,
+        }
+
+
+def parse_spec(name: str, spec: str) -> _Fault:
+    """``mode[:arg][:key=val...]`` -> _Fault. Raises ValueError on junk
+    (armers should fail loudly — a typo'd chaos schedule that silently
+    injects nothing proves the wrong thing)."""
+    parts = [p.strip() for p in spec.split(":") if p.strip()]
+    if not parts:
+        raise ValueError(f"empty fault spec for {name!r}")
+    mode, rest = parts[0], parts[1:]
+    arg: float | None = None
+    times: int | None = None
+    skip = 0
+    max_s: float | None = None
+    for p in rest:
+        if "=" in p:
+            k, _, v = p.partition("=")
+            if k == "skip":
+                skip = int(v)
+            elif k == "max":
+                max_s = float(v)
+            elif k == "times":
+                times = int(v)
+            else:
+                raise ValueError(f"unknown fault option {k!r} in {spec!r}")
+        else:
+            arg = float(p)
+    if mode == "error":
+        if arg is not None:
+            times = int(arg)
+    elif mode == "delay":
+        if arg is None:
+            raise ValueError(f"delay fault needs seconds: {spec!r}")
+    elif mode == "hang":
+        pass
+    elif mode == "corrupt":
+        if arg is not None:
+            times = int(arg)
+    else:
+        raise ValueError(f"unknown fault mode {mode!r} (error|delay|hang|corrupt)")
+    return _Fault(name, mode, arg, times, skip, max_s)
+
+
+def set_fault(name: str, mode: str, *, times: int | None = None, skip: int = 0,
+              delay: float | None = None, max_s: float | None = None) -> None:
+    """Arm *mode* on failpoint *name* (replacing any armed fault there)."""
+    f = _Fault(name, mode, delay, times, skip, max_s)
+    if mode == "delay" and delay is None:
+        raise ValueError("delay fault needs delay=seconds")
+    if mode not in ("error", "delay", "hang", "corrupt"):
+        raise ValueError(f"unknown fault mode {mode!r}")
+    if mode == "delay":
+        f.arg = delay
+    with _lock:
+        old = _active.get(name)
+        if old is not None:
+            old.release.set()
+        _active[name] = f
+    log.info("fault armed: %s=%s times=%s skip=%s", name, mode, times, skip)
+
+
+def arm_spec(name: str, spec: str) -> None:
+    f = parse_spec(name, spec)
+    with _lock:
+        old = _active.get(name)
+        if old is not None:
+            old.release.set()
+        _active[name] = f
+    log.info("fault armed: %s=%s", name, spec)
+
+
+def clear_fault(name: str) -> bool:
+    """Disarm *name*; releases any thread hung on it. Returns whether a
+    fault was armed."""
+    with _lock:
+        f = _active.pop(name, None)
+    if f is not None:
+        f.release.set()
+        log.info("fault cleared: %s", name)
+    return f is not None
+
+
+def clear_all() -> int:
+    with _lock:
+        faults = list(_active.values())
+        _active.clear()
+    for f in faults:
+        f.release.set()
+    if faults:
+        log.info("all faults cleared (%d)", len(faults))
+    return len(faults)
+
+
+def list_faults() -> list[dict]:
+    with _lock:
+        return [f.describe() for f in _active.values()]
+
+
+def load_env(env: str | None = None) -> int:
+    """Arm faults from ``KUBEAI_FAILPOINTS`` ("name=spec;name=spec").
+    Called once at import; callable again after mutating the env (tests).
+    Returns the number armed."""
+    raw = env if env is not None else os.environ.get("KUBEAI_FAILPOINTS", "")
+    n = 0
+    for entry in raw.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        name, sep, spec = entry.partition("=")
+        if not sep:
+            log.warning("ignoring malformed KUBEAI_FAILPOINTS entry %r", entry)
+            continue
+        try:
+            arm_spec(name.strip(), spec.strip())
+            n += 1
+        except ValueError as e:
+            log.warning("ignoring bad failpoint %r: %s", entry, e)
+    return n
+
+
+def fault(name: str, payload=None):
+    """The failpoint. Returns *payload* (possibly corrupted); raises
+    FaultError / sleeps / hangs per the armed fault. No-op (one dict
+    lookup) when nothing is armed on *name*."""
+    if not _active:  # fast path: nothing armed anywhere
+        return payload
+    with _lock:
+        f = _active.get(name)
+        if f is None:
+            return payload
+        f.hits += 1
+        if f.hits <= f.skip:
+            return payload
+        if f.times is not None and f.fired >= f.times:
+            return payload
+        f.fired += 1
+        mode, arg, max_s, release = f.mode, f.arg, f.max_s, f.release
+    # Act OUTSIDE the lock: a hang/delay must not block other failpoints.
+    if mode == "error":
+        raise FaultError(name)
+    if mode == "delay":
+        time.sleep(float(arg or 0.0))
+        return payload
+    if mode == "hang":
+        release.wait(timeout=max_s)
+        return payload
+    if mode == "corrupt":
+        if isinstance(payload, (bytes, bytearray)):
+            return bytes(b ^ 0xFF for b in payload)
+        return payload
+    return payload
+
+
+def http_arming_enabled() -> bool:
+    """Whether /debug/faults may MUTATE fault state over HTTP. Off by
+    default — unlike the read-only debug surfaces, arming a fault is a
+    remote kill switch (hang the scheduler, corrupt bodies), so it
+    requires the explicit ``KUBEAI_DEBUG_FAULTS=1`` opt-in chaos
+    environments set. Re-read per request so tests can toggle it."""
+    return os.environ.get("KUBEAI_DEBUG_FAULTS", "") in ("1", "true", "yes")
+
+
+def handle_faults_request(path: str, query: str = "") -> tuple[int, str, bytes] | None:
+    """``/debug/faults`` route shared by the proxy and engine HTTP
+    servers. GET-only by design (the debug surface is GET-routed);
+    arming via query params keeps it curl-able:
+
+        GET /debug/faults                      list armed faults
+        GET /debug/faults?set=NAME=SPEC        arm (SPEC grammar above)
+        GET /debug/faults?clear=NAME|all       disarm
+
+    Listing is always available (read-only, like /debug/requests);
+    set/clear require KUBEAI_DEBUG_FAULTS=1 (403 otherwise).
+
+    Returns (status, content-type, body) or None for non-fault paths."""
+    import json
+    from urllib.parse import parse_qs, unquote
+
+    if path != "/debug/faults":
+        return None
+    q = parse_qs(query or "")
+    if (q.get("set") or q.get("clear")) and not http_arming_enabled():
+        return 403, "application/json", json.dumps({
+            "error": {
+                "message": "fault arming over HTTP is disabled; set "
+                           "KUBEAI_DEBUG_FAULTS=1 on this process to enable",
+                "type": "invalid_request_error",
+            }
+        }).encode()
+    errors: list[str] = []
+    for raw in q.get("set", []):
+        name, sep, spec = unquote(raw).partition("=")
+        if not sep:
+            errors.append(f"malformed set={raw!r} (want name=spec)")
+            continue
+        try:
+            arm_spec(name.strip(), spec.strip())
+        except ValueError as e:
+            errors.append(str(e))
+    for name in q.get("clear", []):
+        if name == "all":
+            clear_all()
+        else:
+            clear_fault(name)
+    body = {"faults": list_faults()}
+    if errors:
+        body["errors"] = errors
+    return (400 if errors else 200), "application/json", json.dumps(body).encode()
+
+
+# Arm anything the environment asks for at import time: engine pods and
+# the operator both import this module via their failpoint call sites.
+load_env()

@@ -14,6 +14,7 @@ import threading
 
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.api.core_types import KIND_POD, Pod, pod_is_ready
+from kubeai_tpu.faults import fault
 from kubeai_tpu.loadbalancer.group import Endpoint, EndpointGroup
 from kubeai_tpu.runtime.store import Store
 
@@ -43,9 +44,17 @@ def pod_endpoint(pod: Pod, allow_override: bool) -> Endpoint | None:
 
 
 class LoadBalancer:
-    def __init__(self, store: Store, allow_pod_address_override: bool = False):
+    def __init__(
+        self,
+        store: Store,
+        allow_pod_address_override: bool = False,
+        breaker_threshold: int = 3,
+        breaker_cooldown: float = 10.0,
+    ):
         self.store = store
         self.allow_override = allow_pod_address_override
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
         self._groups: dict[str, EndpointGroup] = {}
         self._groups_lock = threading.Lock()
         self._self_ips: list[str] = []
@@ -78,6 +87,9 @@ class LoadBalancer:
                 log.exception("endpoint reconcile failed")
 
     def _reconcile_model(self, model_name: str, namespace: str = "default"):
+        # Failpoint: chaos tests stall/fail endpoint convergence here
+        # (the watcher loop logs and survives injected errors).
+        fault("balancer.reconcile")
         pods = self.store.list(KIND_POD, namespace, {mt.LABEL_MODEL: model_name})
         observed: dict[str, Endpoint] = {}
         ranks_ready: dict[str, set[int]] = {}
@@ -121,9 +133,26 @@ class LoadBalancer:
         with self._groups_lock:
             g = self._groups.get(model_name)
             if g is None:
-                g = EndpointGroup()
+                g = EndpointGroup(
+                    breaker_threshold=self.breaker_threshold,
+                    breaker_cooldown=self.breaker_cooldown,
+                )
                 self._groups[model_name] = g
             return g
+
+    def report_result(self, model_name: str, addr: str, ok: bool, started_at: float | None = None) -> None:
+        """Passive-health feed: the proxy reports each attempt's outcome
+        so the endpoint breaker ejects consistently-failing endpoints
+        BEFORE the pod watcher notices them dying. *started_at* (attempt
+        connect time, time.monotonic()) lets the breaker discard stale
+        successes from attempts predating an ejection."""
+        self.group(model_name).report_result(addr, ok, started_at=started_at)
+
+    def breaker_snapshot(self) -> dict[str, list[dict]]:
+        """model -> per-endpoint breaker states (/debug/endpoints)."""
+        with self._groups_lock:
+            groups = dict(self._groups)
+        return {name: g.breaker_snapshot() for name, g in sorted(groups.items())}
 
     # -- proxy interface (ref: load_balancer.go:176-202) -------------------
 

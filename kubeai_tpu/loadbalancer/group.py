@@ -48,6 +48,22 @@ _M_LOOKUP_ITER = default_registry.histogram(
     buckets=(1, 4, 16, 64, 256, 1024, 4096),
 )
 
+# Passive endpoint health (circuit breaking): per-endpoint state gauge
+# (0=closed, 1=half_open, 2=open) and an ejection counter — the
+# observable evidence of the eject -> half-open -> close lifecycle.
+BREAKER_CLOSED = "closed"
+BREAKER_OPEN = "open"
+BREAKER_HALF_OPEN = "half_open"
+_STATE_VALUE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+_M_ENDPOINT_STATE = default_registry.gauge(
+    "kubeai_endpoint_state",
+    "circuit-breaker state per endpoint (0=closed, 1=half_open, 2=open)",
+)
+_M_EJECTIONS = default_registry.counter(
+    "kubeai_endpoint_ejections_total",
+    "endpoints ejected by the passive-health circuit breaker",
+)
+
 
 def _record_chwbl_stats(stats: dict) -> None:
     """Initial is recorded for every lookup (the reference records it
@@ -69,10 +85,28 @@ class Endpoint:
     address: str
     adapters: set[str] = field(default_factory=set)
     in_flight: int = 0
+    # Passive-health circuit breaker (fed by the proxy's per-attempt
+    # outcomes via EndpointGroup.report_result):
+    breaker_state: str = BREAKER_CLOSED
+    consecutive_failures: int = 0
+    opened_at: float = 0.0  # clock() when the breaker last opened
+    probe_started: float | None = None  # half-open probe in flight since
 
 
 class EndpointGroup:
-    def __init__(self, chwbl_replication: int = 256):
+    def __init__(
+        self,
+        chwbl_replication: int = 256,
+        breaker_threshold: int = 3,
+        breaker_cooldown: float = 10.0,
+        clock=time.monotonic,
+    ):
+        """*breaker_threshold* consecutive failed attempts eject an
+        endpoint for *breaker_cooldown* seconds; after the cooldown it
+        goes half-open and admits ONE probe request — success closes the
+        breaker, failure re-ejects. ``breaker_threshold <= 0`` disables
+        breaking. *clock* is injectable so tests drive cooldowns with a
+        fake clock instead of sleeps."""
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._endpoints: dict[str, Endpoint] = {}
@@ -80,6 +114,9 @@ class EndpointGroup:
         self._generation = 0
         self._rr_counter = 0
         self._ring = HashRing(replication=chwbl_replication)
+        self.breaker_threshold = breaker_threshold
+        self.breaker_cooldown = breaker_cooldown
+        self._clock = clock
 
     # -- balancing ---------------------------------------------------------
 
@@ -121,12 +158,25 @@ class EndpointGroup:
                     if self._generation != gen:
                         await_change = False
 
-                # Endpoints in *exclude* (already failed this request) are
-                # avoided when an alternative exists — retries should land
-                # somewhere new.
+                # Preference ladder: avoid endpoints that already failed
+                # THIS request (exclude) and endpoints the breaker has
+                # ejected — but fail OPEN rather than deadlock: when every
+                # endpoint is excluded/ejected, a total-outage group still
+                # routes (the alternative is every request blocking until
+                # the cooldown, which turns a blip into an outage).
                 name = self._choose(strategy, prefix, adapter, mean_load_factor, exclude)
                 if name is None and exclude:
                     name = self._choose(strategy, prefix, adapter, mean_load_factor, None)
+                if name is None:
+                    name = self._choose(
+                        strategy, prefix, adapter, mean_load_factor, exclude,
+                        ignore_breaker=True,
+                    )
+                if name is None and exclude:
+                    name = self._choose(
+                        strategy, prefix, adapter, mean_load_factor, None,
+                        ignore_breaker=True,
+                    )
                 if name is None:
                     # No endpoint can serve this request (e.g. adapter not
                     # yet loaded anywhere) — wait for the endpoint set to
@@ -135,6 +185,10 @@ class EndpointGroup:
                     continue
 
                 ep = self._endpoints[name]
+                if ep.breaker_state == BREAKER_HALF_OPEN:
+                    # This request IS the probe: until its outcome is
+                    # reported, other requests skip this endpoint.
+                    ep.probe_started = self._clock()
                 ep.in_flight += 1
                 self._total_in_flight += 1
 
@@ -154,11 +208,29 @@ class EndpointGroup:
         adapter: str,
         mean_load_factor: float,
         exclude: set[str] | None = None,
+        ignore_breaker: bool = False,
     ):
-        # Single source of truth for retry exclusion; None when unused.
-        allowed = (
-            (lambda name: self._endpoints[name].address not in exclude) if exclude else None
+        # Single source of truth for retry exclusion + breaker ejection;
+        # None when neither applies (keeps the CHWBL fast path allocation-
+        # free in the healthy steady state).
+        now = self._clock()
+        breaker_live = (
+            not ignore_breaker
+            and self.breaker_threshold > 0
+            and any(
+                ep.breaker_state != BREAKER_CLOSED
+                for ep in self._endpoints.values()
+            )
         )
+        allowed = None
+        if exclude or breaker_live:
+            def allowed(name):
+                ep = self._endpoints[name]
+                if exclude and ep.address in exclude:
+                    return False
+                if breaker_live and not self._breaker_allows(ep, now):
+                    return False
+                return True
 
         if strategy == PREFIX_HASH:
             stats: dict = {}
@@ -205,6 +277,96 @@ class EndpointGroup:
             return random.choice(candidates) if candidates else None
         raise ValueError(f"unknown load balancing strategy: {strategy!r}")
 
+    # -- passive health / circuit breaking ---------------------------------
+
+    def _set_state(self, ep: Endpoint, state: str) -> None:
+        ep.breaker_state = state
+        _M_ENDPOINT_STATE.set(_STATE_VALUE[state], labels={"endpoint": ep.address})
+
+    def _breaker_allows(self, ep: Endpoint, now: float) -> bool:
+        """Whether the breaker lets a NEW request pick *ep* (lock held).
+        Lazily transitions open -> half_open when the cooldown elapses —
+        there is no timer thread; selection time is when it matters."""
+        if ep.breaker_state == BREAKER_CLOSED:
+            return True
+        if ep.breaker_state == BREAKER_OPEN:
+            if now - ep.opened_at < self.breaker_cooldown:
+                return False
+            self._set_state(ep, BREAKER_HALF_OPEN)
+            ep.probe_started = None
+        # Half-open: one probe at a time. A probe whose outcome never got
+        # reported (caller died) stops blocking after a cooldown.
+        return (
+            ep.probe_started is None
+            or now - ep.probe_started >= self.breaker_cooldown
+        )
+
+    def report_result(self, addr: str, ok: bool, started_at: float | None = None) -> None:
+        """Feed one request-attempt outcome for *addr* (the proxy calls
+        this per attempt — connect errors and 5xx are failures). Drives
+        closed -> open (threshold consecutive failures), half_open ->
+        closed (probe success) and half_open -> open (probe failure).
+
+        *started_at* (same clock as the group's) marks when the attempt
+        began: a SUCCESS from an attempt that started before the breaker
+        last opened is stale evidence — e.g. a long stream that connected
+        minutes ago exhausting cleanly after the endpoint started failing
+        — and must not close a fresh ejection. Failures always count."""
+        with self._cond:
+            ep = next(
+                (e for e in self._endpoints.values() if e.address == addr), None
+            )
+            if ep is None:
+                return
+            now = self._clock()
+            if ok:
+                if (
+                    ep.breaker_state != BREAKER_CLOSED
+                    and started_at is not None
+                    and started_at < ep.opened_at
+                ):
+                    return  # pre-ejection evidence; ignore entirely
+                ep.consecutive_failures = 0
+                if ep.breaker_state != BREAKER_CLOSED:
+                    self._set_state(ep, BREAKER_CLOSED)
+                    ep.probe_started = None
+                return
+            ep.consecutive_failures += 1
+            if ep.breaker_state == BREAKER_HALF_OPEN:
+                # The probe failed: straight back to ejected.
+                self._set_state(ep, BREAKER_OPEN)
+                ep.opened_at = now
+                ep.probe_started = None
+                _M_EJECTIONS.inc(labels={"endpoint": ep.address})
+            elif (
+                ep.breaker_state == BREAKER_CLOSED
+                and self.breaker_threshold > 0
+                and ep.consecutive_failures >= self.breaker_threshold
+            ):
+                self._set_state(ep, BREAKER_OPEN)
+                ep.opened_at = now
+                _M_EJECTIONS.inc(labels={"endpoint": ep.address})
+
+    def breaker_snapshot(self) -> list[dict]:
+        """Per-endpoint breaker view for the /debug/endpoints surface."""
+        with self._lock:
+            now = self._clock()
+            return [
+                {
+                    "name": name,
+                    "address": ep.address,
+                    "state": ep.breaker_state,
+                    "consecutive_failures": ep.consecutive_failures,
+                    "in_flight": ep.in_flight,
+                    "opened_age_s": (
+                        round(now - ep.opened_at, 3)
+                        if ep.breaker_state != BREAKER_CLOSED
+                        else None
+                    ),
+                }
+                for name, ep in sorted(self._endpoints.items())
+            ]
+
     # -- membership --------------------------------------------------------
 
     def reconcile_endpoints(self, observed: dict[str, Endpoint]) -> None:
@@ -225,7 +387,13 @@ class EndpointGroup:
             for name in list(self._endpoints):
                 if name not in observed:
                     self._ring.remove(name)
-                    del self._endpoints[name]
+                    ep = self._endpoints.pop(name)
+                    # A departed endpoint must not show "open" on the
+                    # state gauge forever.
+                    _M_ENDPOINT_STATE.set(
+                        _STATE_VALUE[BREAKER_CLOSED],
+                        labels={"endpoint": ep.address},
+                    )
             if observed:
                 self._generation += 1
                 self._cond.notify_all()

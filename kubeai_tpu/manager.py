@@ -110,6 +110,13 @@ class Manager:
         self.api.start()
         log.info("manager up: api :%d", self.api.port)
 
+    def drain(self, grace: float = 30.0):
+        """Graceful termination (SIGTERM path): stop admitting requests,
+        let in-flight proxied work finish up to *grace* seconds, then
+        tear the rest of the components down."""
+        self.api.drain(grace)
+        self.stop()
+
     def stop(self):
         for m in self.messengers:
             m.stop()
@@ -135,6 +142,12 @@ def main(argv=None):
     parser.add_argument("--kube-api-server", default=None, help="apiserver URL (dev: kubectl proxy)")
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
+    parser.add_argument(
+        "--drain-grace", type=float,
+        default=float(os.environ.get("KUBEAI_DRAIN_GRACE", "30")),
+        help="seconds SIGTERM lets in-flight requests finish before exit "
+             "(keep below the pod's terminationGracePeriodSeconds)",
+    )
     parser.add_argument("--models", default=None, help="YAML file of Model manifests to apply at boot")
     parser.add_argument(
         "--catalog", default=None,
@@ -171,9 +184,26 @@ def main(argv=None):
             )
         apply_catalog(mgr.store, names)
 
+    # SIGTERM (the kubelet's shutdown signal) drains instead of dying
+    # mid-stream: readiness flips 503 first so the Service stops routing
+    # here, then in-flight requests get the grace budget.
+    import signal
+    import threading as _threading
+
+    done = _threading.Event()
+
+    def _on_term(signum, frame):
+        # Handlers must return fast; drain on a worker thread.
+        _threading.Thread(
+            target=lambda: (mgr.drain(args.drain_grace), done.set()),
+            daemon=True,
+        ).start()
+
+    signal.signal(signal.SIGTERM, _on_term)
+
     try:
-        while True:
-            time.sleep(3600)
+        while not done.is_set():
+            done.wait(3600)
     except KeyboardInterrupt:
         mgr.stop()
 

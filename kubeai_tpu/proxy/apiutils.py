@@ -17,10 +17,13 @@ from kubeai_tpu.api.openai_types import _Body, body_for_path
 
 
 class APIError(Exception):
-    def __init__(self, code: int, message: str):
+    def __init__(self, code: int, message: str, headers: dict[str, str] | None = None):
         super().__init__(message)
         self.code = code
         self.message = message
+        # Extra response headers (e.g. Retry-After on 429/503 so clients
+        # back off instead of synchronized-retry-storming the operator).
+        self.headers = headers or {}
 
 
 @dataclass
@@ -37,6 +40,10 @@ class Request:
     # module stays import-light); the load balancer annotates its
     # endpoint-pick span onto it when present.
     trace: object = None
+    # Client-requested end-to-end budget in seconds (body "timeout" field
+    # or X-Request-Timeout header; the proxy budgets it across await /
+    # connect / stream and forwards the remainder to the engine).
+    timeout: float | None = None
 
     @property
     def load_balancing(self) -> mt.LoadBalancing:
@@ -123,6 +130,25 @@ def parse_multipart_model(raw_body: bytes, content_type: str) -> tuple[str, byte
     return model_value, new_body
 
 
+# End-to-end deadline bounds: a sub-millisecond budget can't cover one
+# RTT, and an unbounded one defeats the point of deadlines.
+MIN_REQUEST_TIMEOUT = 0.001
+MAX_REQUEST_TIMEOUT = 3600.0
+
+
+def parse_request_timeout(value, source: str) -> float:
+    """Validate a client-supplied end-to-end timeout (seconds)."""
+    try:
+        t = float(value)
+    except (TypeError, ValueError):
+        raise APIError(400, f"{source} must be a number of seconds")
+    if not (t == t) or t in (float("inf"), float("-inf")):
+        raise APIError(400, f"{source} must be finite")
+    if t < MIN_REQUEST_TIMEOUT:
+        raise APIError(400, f"{source} must be >= {MIN_REQUEST_TIMEOUT}s")
+    return min(t, MAX_REQUEST_TIMEOUT)
+
+
 def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, str]) -> Request:
     """Decode + validate + rewrite; parity: ParseRequest
     (ref: apiutils/request.go:64-107). JSON bodies are rewritten (adapter
@@ -134,6 +160,18 @@ def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, s
     content_type = next(
         (v for k, v in headers.items() if k.lower() == "content-type"), ""
     )
+    # End-to-end budget: the X-Request-Timeout header wins over the body
+    # "timeout" field (a gateway in front of us can clamp every request
+    # without parsing bodies).
+    timeout_hdr = next(
+        (v for k, v in headers.items() if k.lower() == "x-request-timeout"), ""
+    )
+    timeout = (
+        parse_request_timeout(timeout_hdr, "X-Request-Timeout")
+        if timeout_hdr
+        else None
+    )
+
     if content_type.lower().startswith("multipart/form-data"):
         requested, new_body = parse_multipart_model(raw_body, content_type)
         model_name, adapter = split_model_adapter(requested)
@@ -146,12 +184,20 @@ def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, s
             selectors=selectors,
             raw_body=new_body,
             model_obj=model,
+            timeout=timeout,
         )
 
     try:
         data = json.loads(raw_body or b"{}")
     except json.JSONDecodeError as e:
         raise APIError(400, f"invalid JSON body: {e}")
+    # "timeout" is proxy-consumed, not an OpenAI field: strip it before
+    # validation/forwarding (the engine learns the budget via the
+    # X-Request-Deadline header the proxy stamps per attempt).
+    if isinstance(data, dict) and "timeout" in data:
+        field_timeout = parse_request_timeout(data.pop("timeout"), "timeout")
+        if timeout is None:
+            timeout = field_timeout
     try:
         body = body_for_path(path, data)
     except LookupError as e:
@@ -175,6 +221,7 @@ def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, s
         selectors=selectors,
         body=body,
         model_obj=model,
+        timeout=timeout,
     )
     if model.spec.load_balancing.strategy == mt.PREFIX_HASH_STRATEGY:
         req.prefix = body.prefix(model.spec.load_balancing.prefix_hash.prefix_char_length)

@@ -20,6 +20,7 @@ import logging
 import threading
 import time
 
+from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
 from kubeai_tpu.obs import SpanBuilder, extract_context
@@ -28,6 +29,10 @@ from kubeai_tpu.proxy.apiutils import APIError, Request, parse_request
 log = logging.getLogger("kubeai_tpu.proxy")
 
 RETRYABLE_CODES = {500, 502, 503, 504}
+# Retry-After hint (seconds) on backpressure responses: long enough to
+# de-synchronize client retries, short enough that scale-up capacity
+# gets traffic promptly.
+RETRY_AFTER_HINT = "1"
 
 
 class ProxyResult:
@@ -38,11 +43,21 @@ class ProxyResult:
 
 
 class ModelProxy:
-    def __init__(self, model_client, load_balancer, max_retries: int = 3, await_timeout: float = 600.0):
+    def __init__(
+        self,
+        model_client,
+        load_balancer,
+        max_retries: int = 3,
+        await_timeout: float = 600.0,
+        connect_timeout: float = 600.0,
+    ):
         self.model_client = model_client
         self.lb = load_balancer
         self.max_retries = max_retries
         self.await_timeout = await_timeout
+        # Per-connection socket timeout (was hard-coded 600 s); a client
+        # deadline tightens it further per attempt.
+        self.connect_timeout = connect_timeout
         self.active = default_registry.gauge(
             ACTIVE_REQUESTS, "requests currently being served per model"
         )
@@ -98,6 +113,13 @@ class ModelProxy:
     def _proxy_with_retries(self, req: Request, path: str, headers: dict[str, str], release, cancelled):
         body = req.body_bytes()
         t0 = time.monotonic()
+        # End-to-end deadline: one budget spanning endpoint await, every
+        # connect attempt, and the stream. None = no client deadline.
+        deadline = None if req.timeout is None else t0 + req.timeout
+
+        def remaining() -> float | None:
+            return None if deadline is None else deadline - time.monotonic()
+
         tb: SpanBuilder | None = req.trace
         # Propagate downstream (dropping any case-variant inbound copy so
         # the engine never sees a duplicated header). The traceparent is
@@ -105,7 +127,7 @@ class ModelProxy:
         # the proxy's span, not onto the client's.
         headers = {
             k: v for k, v in headers.items()
-            if k.lower() not in ("x-request-id", "traceparent")
+            if k.lower() not in ("x-request-id", "traceparent", "x-request-deadline")
         }
         headers["X-Request-ID"] = req.id
         if tb is not None:
@@ -114,19 +136,41 @@ class ModelProxy:
         attempts = self.max_retries + 1
         failed_addrs: set[str] = set()
         for attempt in range(attempts):
+            rem = remaining()
+            if rem is not None and rem <= 0:
+                raise APIError(
+                    504, f"deadline exceeded after {req.timeout:.3f}s "
+                    f"(attempt {attempt + 1}; last error: {last_err})"
+                )
+            await_t = self.await_timeout if rem is None else min(self.await_timeout, rem)
             try:
                 addr, done = self.lb.await_best_address(
-                    req, timeout=self.await_timeout, cancelled=cancelled,
+                    req, timeout=await_t, cancelled=cancelled,
                     exclude=failed_addrs or None,
                 )
             except TimeoutError as e:
                 # handle()'s except clause performs the gauge release.
-                raise APIError(503, f"no ready endpoints for {req.model_name}: {e}")
+                if rem is not None and remaining() <= 0:
+                    raise APIError(
+                        504,
+                        f"deadline exceeded awaiting endpoints for {req.model_name}",
+                    )
+                raise APIError(
+                    503, f"no ready endpoints for {req.model_name}: {e}",
+                    headers={"Retry-After": RETRY_AFTER_HINT},
+                )
             t_conn = time.monotonic()
+            # Forward the REMAINING budget (recomputed per attempt): the
+            # engine aborts queued/mid-decode work whose deadline passed
+            # instead of burning TPU time for a caller that gave up.
+            rem = remaining()
+            if rem is not None:
+                headers["X-Request-Deadline"] = f"{max(rem, 0.001):.3f}"
             try:
-                resp, conn = self._connect(addr, path, headers, body)
+                resp, conn = self._connect(addr, path, headers, body, timeout=rem)
             except (ConnectionError, OSError, http.client.HTTPException) as e:
                 done()
+                self.lb.report_result(req.model_name, addr, ok=False)
                 failed_addrs.add(addr)
                 last_err = e
                 if tb is not None:
@@ -136,12 +180,20 @@ class ModelProxy:
                     )
                 log.info("connection to %s failed (%s); attempt %d", addr, e, attempt + 1)
                 continue
-            if resp.status in RETRYABLE_CODES and attempt < attempts - 1:
+            # 429 (queue full / draining) fails over like a 5xx — another
+            # replica may have capacity — but does NOT feed the breaker:
+            # a saturated endpoint is alive and healthy, just busy. On
+            # exhaustion the client gets the upstream's own 429 +
+            # Retry-After.
+            if (
+                resp.status in RETRYABLE_CODES or resp.status == 429
+            ) and attempt < attempts - 1:
                 log.info(
                     "retrying %s after upstream %d (attempt %d)",
                     req.model_name, resp.status, attempt + 1,
                 )
-                last_err = f"upstream status {resp.status}"
+                if resp.status != 429:
+                    self.lb.report_result(req.model_name, addr, ok=False)
                 failed_addrs.add(addr)
                 if tb is not None:
                     tb.add_span(
@@ -149,7 +201,16 @@ class ModelProxy:
                         endpoint=addr, attempt=attempt + 1, status=resp.status,
                     )
                 try:
-                    resp.read()
+                    # Keep the upstream's own error: retry exhaustion must
+                    # surface WHY the last attempt failed, not a generic
+                    # "unavailable" (clients act on engine error bodies).
+                    err_body = resp.read()
+                    last_err = (
+                        f"upstream status {resp.status}: "
+                        f"{err_body[:300].decode('utf-8', 'replace')}"
+                    )
+                except Exception:
+                    last_err = f"upstream status {resp.status}"
                 finally:
                     conn.close()
                     done()
@@ -164,9 +225,26 @@ class ModelProxy:
             ] + [("X-Request-ID", req.id)]
             if tb is not None:
                 tb.attrs.update(endpoint=addr, status=resp.status, attempts=attempt + 1)
+            if resp.status >= 500:
+                # Terminal 5xx (final attempt or non-retried): one failure
+                # report; the body iter reports nothing further.
+                self.lb.report_result(req.model_name, addr, ok=False)
+                report = None
+            else:
+                # Success is reported at body EXHAUSTION: an endpoint that
+                # returns 200 headers then dies mid-stream is failing, and
+                # a half-open probe must not close the breaker until the
+                # response actually completed. The attempt's start time
+                # rides along so a success from a stream that began before
+                # a later ejection cannot close the fresh breaker.
+                def report(ok, _model=req.model_name, _addr=addr, _t=t_conn):
+                    self.lb.report_result(_model, _addr, ok=ok, started_at=_t)
             return ProxyResult(
                 resp.status, resp_headers,
-                self._body_iter(resp, conn, done, release, tb=tb, t_conn=t_conn, cancelled=cancelled),
+                self._body_iter(
+                    resp, conn, done, release, tb=tb, t_conn=t_conn,
+                    cancelled=cancelled, report=report,
+                ),
             )
         log.info(
             "request id=%s model=%s failed after %d attempts: %s",
@@ -174,9 +252,15 @@ class ModelProxy:
         )
         raise APIError(502, f"upstream unavailable after {attempts} attempts: {last_err}")
 
-    def _connect(self, addr: str, path: str, headers: dict[str, str], body: bytes):
+    def _connect(self, addr: str, path: str, headers: dict[str, str], body: bytes, timeout: float | None = None):
+        # Failpoint: chaos tests inject connect errors/delays/hangs (and
+        # body corruption) here without monkeypatching http.client.
+        body = fault("proxy.connect", payload=body)
+        sock_t = self.connect_timeout if timeout is None else max(
+            min(self.connect_timeout, timeout), 0.001
+        )
         host, _, port = addr.partition(":")
-        conn = http.client.HTTPConnection(host, int(port or 80), timeout=600)
+        conn = http.client.HTTPConnection(host, int(port or 80), timeout=sock_t)
         # Strip hop-by-hop headers; body was rewritten (adapter names).
         fwd = {
             k: v
@@ -188,17 +272,44 @@ class ModelProxy:
         return conn.getresponse(), conn
 
     @staticmethod
-    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None):
+    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None):
         """Stream the upstream body; exactly-once cleanup on exhaustion or
         generator close (client disconnect). The proxy timeline closes
         HERE — the upstream span covers connect through last byte, so
-        streaming time is attributed, not just headers latency."""
+        streaming time is attributed, not just headers latency.
+
+        *report* (breaker feed) fires at most once: ok=True on clean
+        exhaustion, ok=False when the UPSTREAM read dies mid-stream.
+        Client disconnects (generator close) report nothing — they say
+        nothing about endpoint health."""
         try:
             while True:
-                chunk = resp.read(65536)
+                try:
+                    chunk = resp.read(65536)
+                except Exception:
+                    # Endpoint died mid-stream: passive health must see it
+                    # (this is exactly the "dead endpoint keeps receiving
+                    # fresh requests" window the breaker closes).
+                    if report is not None:
+                        report(False)
+                        report = None
+                    raise
                 if not chunk:
                     break
                 yield chunk
+            # http.client's bounded read() returns b"" on early EOF
+            # instead of raising (CPython compat choice) — without this
+            # check a Content-Length body truncated by endpoint death
+            # would be forwarded as a complete, valid-looking response.
+            expected = getattr(resp, "length", None)
+            if expected not in (None, 0):
+                if report is not None:
+                    report(False)
+                    report = None
+                raise http.client.IncompleteRead(b"", expected)
+            if report is not None:
+                report(True)
+                report = None
         finally:
             conn.close()
             done()

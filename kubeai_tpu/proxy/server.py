@@ -14,7 +14,10 @@ import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+import time
+
 from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.faults import handle_faults_request
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs import handle_debug_request
 from kubeai_tpu.proxy.apiutils import (
@@ -41,6 +44,15 @@ class OpenAIServer:
         self.httpd = ThreadingHTTPServer((host, port), _make_handler(self))
         self.port = self.httpd.server_port
         self._thread: threading.Thread | None = None
+        # Graceful drain state (mirror of EngineServer's): once draining,
+        # /readyz goes 503 (LBs stop routing here), new inference is
+        # rejected with Retry-After, and in-flight proxied streams get a
+        # budget to finish before stop().
+        self.draining = threading.Event()
+        self._inflight = 0
+        self._inflight_lock = threading.Lock()
+        self._stop_lock = threading.Lock()
+        self._stopped = False
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -48,7 +60,36 @@ class OpenAIServer:
         log.info("openai server on :%d", self.port)
 
     def stop(self):
+        # Idempotent: drain() calls stop(), and process teardown may too.
+        with self._stop_lock:
+            if self._stopped:
+                return
+            self._stopped = True
+        self.draining.set()
         self.httpd.shutdown()
+
+    def inflight(self) -> int:
+        with self._inflight_lock:
+            return self._inflight
+
+    def _track(self, delta: int) -> None:
+        with self._inflight_lock:
+            self._inflight += delta
+
+    def drain(self, grace: float = 30.0) -> None:
+        """Graceful shutdown: flip readiness, reject new inference with
+        503 + Retry-After, let in-flight proxied requests finish for up
+        to *grace* seconds, then stop the server (severing whatever is
+        left — clients see a closed stream, not a hang)."""
+        self.draining.set()
+        log.info("proxy draining: %d in flight, grace %.1fs", self.inflight(), grace)
+        deadline = time.monotonic() + grace
+        while self.inflight() > 0 and time.monotonic() < deadline:
+            time.sleep(0.05)
+        leftover = self.inflight()
+        if leftover:
+            log.warning("proxy drain budget expired with %d in flight", leftover)
+        self.stop()
 
     def readiness(self) -> tuple[bool, dict]:
         """Readiness for k8s probes, distinct from the always-ok
@@ -57,6 +98,8 @@ class OpenAIServer:
         ready endpoint — until then, routing traffic here just queues
         requests behind cold pods. Models at min_replicas == 0 don't
         gate readiness (scale-from-zero blocking is their contract)."""
+        if self.draining.is_set():
+            return False, {"status": "draining"}
         cold = []
         try:
             for m in self.model_client.list_all_models():
@@ -107,18 +150,31 @@ def _make_handler(srv: OpenAIServer):
         def log_message(self, fmt, *args):
             log.debug(fmt, *args)
 
-        def _json(self, code: int, obj, rid: str = ""):
+        def _json(self, code: int, obj, rid: str = "", headers: dict | None = None):
             body = json.dumps(obj).encode()
             self.send_response(code)
             self.send_header("Content-Type", "application/json")
             self.send_header("Content-Length", str(len(body)))
             if rid:
                 self.send_header("X-Request-ID", rid)
+            for k, v in (headers or {}).items():
+                self.send_header(k, v)
             self.end_headers()
             self.wfile.write(body)
 
         def _api_error(self, e: APIError, rid: str = ""):
-            self._json(e.code, {"error": {"message": e.message, "type": "invalid_request_error" if e.code < 500 else "internal_error"}}, rid=rid)
+            if e.code == 429:
+                etype = "rate_limit_error"
+            elif e.code < 500:
+                etype = "invalid_request_error"
+            elif e.code == 504:
+                etype = "timeout_error"
+            else:
+                etype = "internal_error"
+            self._json(
+                e.code, {"error": {"message": e.message, "type": etype}},
+                rid=rid, headers=e.headers,
+            )
 
         def do_GET(self):
             path, _, query = self.path.partition("?")
@@ -127,8 +183,11 @@ def _make_handler(srv: OpenAIServer):
             elif path == "/readyz":
                 ready, info = srv.readiness()
                 self._json(200 if ready else 503, info)
+            elif path == "/debug/endpoints":
+                # Passive-health visibility: per-model breaker states.
+                self._json(200, {"models": srv.proxy.lb.breaker_snapshot()})
             elif path.startswith("/debug/"):
-                resp = handle_debug_request(path, query)
+                resp = handle_faults_request(path, query) or handle_debug_request(path, query)
                 if resp is None:
                     return self._json(404, {"error": {"message": f"no route {path}"}})
                 code, ctype, body = resp
@@ -157,8 +216,23 @@ def _make_handler(srv: OpenAIServer):
             path = self.path.split("?")[0]
             if path not in INFERENCE_PATHS:
                 return self._json(404, {"error": {"message": f"no route {path}"}})
+            # Read the body BEFORE any early return: on a keep-alive
+            # connection, unread body bytes would be parsed as the next
+            # request line.
             n = int(self.headers.get("Content-Length", 0))
             raw = self.rfile.read(n)
+            if srv.draining.is_set():
+                # Drain admission stop (mirror of the engine's): clients
+                # retry elsewhere after Retry-After instead of hammering
+                # a pod that is about to disappear.
+                from kubeai_tpu.proxy.handler import RETRY_AFTER_HINT
+
+                return self._api_error(
+                    APIError(
+                        503, "server is draining",
+                        headers={"Retry-After": RETRY_AFTER_HINT},
+                    ),
+                )
             cancelled = threading.Event()
             # Fix the correlation id HERE so even proxy-originated error
             # responses (400/404/502) echo it — sanitized, since it goes
@@ -168,28 +242,42 @@ def _make_handler(srv: OpenAIServer):
                 k: v for k, v in self.headers.items() if k.lower() != "x-request-id"
             }
             headers["X-Request-ID"] = rid
+            srv._track(1)
             try:
-                result = srv.proxy.handle(raw, path, headers, cancelled)
-            except APIError as e:
-                return self._api_error(e, rid=rid)
-            except Exception as e:  # pragma: no cover
-                log.exception("proxy failure")
-                return self._json(500, {"error": {"message": str(e)}}, rid=rid)
+                try:
+                    result = srv.proxy.handle(raw, path, headers, cancelled)
+                except APIError as e:
+                    return self._api_error(e, rid=rid)
+                except Exception as e:  # pragma: no cover
+                    log.exception("proxy failure")
+                    return self._json(500, {"error": {"message": str(e)}}, rid=rid)
 
-            self.send_response(result.status)
-            passthrough = {"content-type", "cache-control", "x-request-id"}
-            for k, v in result.headers:
-                if k.lower() in passthrough:
-                    self.send_header(k, v)
-            self.send_header("Transfer-Encoding", "chunked")
-            self.end_headers()
-            try:
-                for chunk in result.body_iter:
-                    self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
-                    self.wfile.flush()
-                self.wfile.write(b"0\r\n\r\n")
-            except (BrokenPipeError, ConnectionResetError):
-                cancelled.set()
-                result.body_iter.close()
+                self.send_response(result.status)
+                passthrough = {
+                    "content-type", "cache-control", "x-request-id", "retry-after",
+                }
+                for k, v in result.headers:
+                    if k.lower() in passthrough:
+                        self.send_header(k, v)
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                try:
+                    for chunk in result.body_iter:
+                        self.wfile.write(f"{len(chunk):x}\r\n".encode() + chunk + b"\r\n")
+                        self.wfile.flush()
+                    self.wfile.write(b"0\r\n\r\n")
+                except (BrokenPipeError, ConnectionResetError):
+                    cancelled.set()
+                    result.body_iter.close()
+                except Exception:
+                    # Upstream died mid-stream (body_iter raised): the
+                    # chunked response is unterminated — close the
+                    # connection so the client sees truncation, not a
+                    # valid-looking short body.
+                    log.exception("upstream stream failed mid-body")
+                    cancelled.set()
+                    self.close_connection = True
+            finally:
+                srv._track(-1)
 
     return Handler

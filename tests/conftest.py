@@ -22,6 +22,16 @@ if "xla_force_host_platform_device_count" not in flags:
         flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
+# Thread-dump-on-timeout: tier-1 runs under `timeout -k 10 870`, which
+# kills a wedged run SILENTLY. Schedule a faulthandler dump of every
+# thread's stack shortly before that deadline so a future hang produces
+# a diagnosis instead of nothing. exit=False: diagnostic only — the
+# driver's timeout still owns the kill.
+import faulthandler  # noqa: E402
+
+faulthandler.enable()
+faulthandler.dump_traceback_later(timeout=840, exit=False)
+
 import pytest  # noqa: E402
 
 # The CPU backend's oneDNN fastmath path computes f32 matmuls at ~bf16

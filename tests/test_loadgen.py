@@ -69,13 +69,18 @@ class _CountingServer:
                     b"data: [DONE]\n\n",
                 ]
                 body = b"".join(chunks)
+                # Decrement BEFORE writing the body: the client releases
+                # its concurrency slot as soon as it reads the response,
+                # which can happen before this (preempted) thread would
+                # run a post-write decrement — the stale +1 then counts
+                # against the NEXT request and flakes max_concurrent.
+                with outer._lock:
+                    outer._active -= 1
                 self.send_response(200)
                 self.send_header("Content-Type", "text/event-stream")
                 self.send_header("Content-Length", str(len(body)))
                 self.end_headers()
                 self.wfile.write(body)
-                with outer._lock:
-                    outer._active -= 1
 
         self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
         self.url = f"http://127.0.0.1:{self.httpd.server_port}"
