@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny dryrun loadgen-demo native clean charts images images-check
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny dryrun loadgen-demo native clean charts images images-check fleet-snapshot
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -18,6 +18,15 @@ bench:
 
 bench-tiny:
 	$(PY) bench.py --tiny
+
+OPERATOR_URL ?= http://localhost:8000
+fleet-snapshot: ## dump /debug/fleet + /debug/autoscaler + /debug/slo (runbook capture)
+	@# Usage: make fleet-snapshot [OPERATOR_URL=http://host:8000] — prints
+	@# one JSON document; redirect to a file for incident timelines.
+	$(PY) -c "import json, urllib.request; \
+	base = '$(OPERATOR_URL)'; \
+	get = lambda p: json.load(urllib.request.urlopen(base + p, timeout=10)); \
+	print(json.dumps({p: get(p) for p in ('/debug/fleet', '/debug/autoscaler', '/debug/slo')}, indent=1))"
 
 dryrun:  ## multi-chip sharding dryrun on 8 virtual CPU devices
 	$(PY) __graft_entry__.py 8

@@ -399,6 +399,7 @@ def run_worker(args) -> None:
 
     results = [None] * n_requests
     ttfts = [None] * n_requests
+    e2es = [None] * n_requests
 
     def run(i):
         req = eng.submit(prompts[i], sp)
@@ -413,6 +414,7 @@ def run_worker(args) -> None:
                     n_toks += 1
             elif ev[0] == "done":
                 results[i] = ev[1]
+                e2es[i] = time.monotonic() - t_submit
                 return
             else:
                 raise RuntimeError(ev[1])
@@ -429,9 +431,13 @@ def run_worker(args) -> None:
     if timer is not None:
         timer.cancel()  # measurement complete; teardown must not race bail()
 
-    total_out = sum(r.completion_tokens for r in results)
+    # None entries = requests whose worker thread errored; the headline
+    # and percentiles cover survivors, the slo block below counts the
+    # failures against the objectives.
+    total_out = sum(r.completion_tokens for r in results if r is not None)
     toks_per_sec = total_out / elapsed
-    p50_ttft = sorted(t for t in ttfts if t is not None)[len(ttfts) // 2]
+    ok_ttfts = sorted(t for t in ttfts if t is not None)
+    p50_ttft = ok_ttfts[len(ok_ttfts) // 2] if ok_ttfts else 0.0
 
     extras = {"preset": preset, "p50_ttft_ms": round(p50_ttft * 1000, 1)}
     # Percentile TTFT/TPOT from trace data (the flight recorder), not
@@ -440,6 +446,30 @@ def run_worker(args) -> None:
         extras.update(trace_latency_stats(measure_wall_t0, expected=n_requests))
     except Exception as e:  # pragma: no cover - stats are best-effort
         log(f"trace latency stats unavailable: {e}")
+    # SLO-attainment block (objective, attainment, burn rate) so stored
+    # BENCH_r*.json snapshots track SLOs, not just throughput. NOTE: the
+    # saturated phase's TTFT is mostly queueing — the block is honest
+    # about that regime, and the rate-controlled phase below carries the
+    # within-capacity view. Requests with no sample (errored workers)
+    # count AGAINST the latency objectives, matching the server-side
+    # SLO monitor's rule.
+    try:
+        from kubeai_tpu.obs.slo import attainment_block, error_rate_block
+
+        n_failed = sum(1 for r in results if r is None)
+        extras["slo"] = {
+            "ttft": attainment_block(
+                [t for t in ttfts if t is not None], args.slo_ttft, 0.95,
+                failures=sum(1 for t in ttfts if t is None),
+            ),
+            "e2e": attainment_block(
+                [t for t in e2es if t is not None], args.slo_e2e, 0.99,
+                failures=n_failed,
+            ),
+            "error_rate": error_rate_block(n_failed, n_requests),
+        }
+    except Exception as e:  # pragma: no cover - block is best-effort
+        log(f"slo block unavailable: {e}")
     if args.speculate or args.greedy:
         drafted = eng.m_spec_drafted.value() - spec_base[0]
         accepted = eng.m_spec_accepted.value() - spec_base[1]
@@ -735,6 +765,10 @@ def run_orchestrated(args) -> int:
             cmd += ["--request-rate", str(args.request_rate)]
         if args.rate_duration != 45.0:
             cmd += ["--rate-duration", str(args.rate_duration)]
+        if args.slo_ttft != 2.0:
+            cmd += ["--slo-ttft", str(args.slo_ttft)]
+        if args.slo_e2e != 30.0:
+            cmd += ["--slo-e2e", str(args.slo_e2e)]
         log(f"phase=run preset={preset} budget={budget}s")
         try:
             out = subprocess.run(
@@ -834,6 +868,14 @@ def main():
     parser.add_argument(
         "--rate-duration", type=float, default=45.0,
         help="rate-controlled phase duration (s)",
+    )
+    parser.add_argument(
+        "--slo-ttft", type=float, default=2.0,
+        help="TTFT SLO objective (s) for the emitted slo block",
+    )
+    parser.add_argument(
+        "--slo-e2e", type=float, default=30.0,
+        help="end-to-end latency SLO objective (s) for the emitted slo block",
     )
     parser.add_argument(
         "--watchdog", type=int, default=None,

@@ -27,11 +27,19 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import random
 import statistics
+import sys
 import threading
 import time
 import urllib.request
+
+# Allow `python benchmarks/loadgen.py` from anywhere: the shared SLO
+# helpers live in the package (kubeai_tpu.obs.slo — no jax imports).
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.obs.slo import attainment_block, error_rate_block
 
 
 class ThreadStats:
@@ -166,6 +174,10 @@ def run_benchmark(
     seed: int = 0,
     temperature: float = 0.7,
     prefix_pad_chars: int = 0,
+    slo_ttft_s: float = 2.0,
+    slo_e2e_s: float = 30.0,
+    slo_target: float = 0.95,
+    slo_e2e_target: float = 0.99,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy)."""
@@ -230,6 +242,21 @@ def run_benchmark(
         "tpot_ms": round(
             statistics.mean(dt / n for s in stats for dt, n in s.turn_decode) * 1000, 1
         ) if any(s.turn_decode for s in stats) else None,
+        # SLO attainment over this run (objective, attainment, burn
+        # rate) — the client-side view BENCH snapshots track over time.
+        # Targets match bench.py and SLOMonitor's defaults (0.95 ttft /
+        # 0.99 e2e) so burn rates are comparable across the tools.
+        # Failed turns produced no latency sample: they count AGAINST
+        # the latency objectives (same rule as the server-side monitor).
+        "slo": {
+            "ttft": attainment_block(
+                ttfts, slo_ttft_s, slo_target, failures=failures
+            ),
+            "e2e": attainment_block(
+                lats, slo_e2e_s, slo_e2e_target, failures=failures
+            ),
+            "error_rate": error_rate_block(failures, n_requests + failures),
+        },
     }
 
 
@@ -256,6 +283,23 @@ def main():
         help="max conversations in flight (0 = unbounded)",
     )
     parser.add_argument("--seed", type=int, default=0)
+    parser.add_argument(
+        "--slo-ttft-ms", type=float, default=2000.0,
+        help="TTFT SLO objective (ms) for the emitted slo block",
+    )
+    parser.add_argument(
+        "--slo-e2e-ms", type=float, default=30000.0,
+        help="per-turn latency SLO objective (ms) for the emitted slo block",
+    )
+    parser.add_argument(
+        "--slo-target", type=float, default=0.95,
+        help="attainment target for the TTFT objective",
+    )
+    parser.add_argument(
+        "--slo-e2e-target", type=float, default=0.99,
+        help="attainment target for the per-turn latency objective "
+             "(matches bench.py / the SLO monitor default)",
+    )
     args = parser.parse_args()
 
     dataset = load_sharegpt(args.dataset) if args.dataset else None
@@ -265,6 +309,10 @@ def main():
         max_tokens=args.max_tokens, dataset=dataset,
         request_rate=args.request_rate, max_concurrency=args.max_concurrency,
         seed=args.seed,
+        slo_ttft_s=args.slo_ttft_ms / 1000.0,
+        slo_e2e_s=args.slo_e2e_ms / 1000.0,
+        slo_target=args.slo_target,
+        slo_e2e_target=args.slo_e2e_target,
     )
     print(json.dumps(summary, indent=1))
 

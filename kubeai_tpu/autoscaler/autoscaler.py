@@ -14,9 +14,11 @@ unproxied work (cold starts, saturation) also drives scaling.
 from __future__ import annotations
 
 import logging
+import math
 import threading
 import time
 import urllib.request
+from collections import deque
 from dataclasses import dataclass, field
 
 from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
@@ -27,6 +29,51 @@ log = logging.getLogger("kubeai_tpu.autoscaler")
 
 KIND_STATE = "AutoscalerState"
 ENGINE_QUEUE_METRIC = "kubeai_engine_queue_depth"
+
+# Capacity-observability surface: every tick's decision math is recorded
+# (DecisionLog -> GET /debug/autoscaler) AND exported as metrics, so
+# "why did the autoscaler do that" is answerable after the fact.
+M_DESIRED = default_registry.gauge(
+    "kubeai_autoscaler_desired_replicas",
+    "replicas the last tick computed per model (ceil(window avg / target), pre-clamp)",
+)
+M_SIGNAL = default_registry.gauge(
+    "kubeai_autoscaler_signal",
+    "raw autoscaling signal per model by source (proxy = summed active gauge, "
+    "engine = fleet queue+active scrape, combined = max of both)",
+)
+M_SCRAPE_FAILURES = default_registry.counter(
+    "kubeai_autoscaler_scrape_failures_total",
+    "failed telemetry scrapes by scope (peer = operator replica, engine = engine pod)",
+)
+M_TICK = default_registry.histogram(
+    "kubeai_autoscaler_tick_seconds",
+    "wall time of one autoscaler tick (scrapes + decisions + state save)",
+)
+
+
+class DecisionLog:
+    """Bounded ring of per-model scaling decision records, served at
+    GET /debug/autoscaler on the operator."""
+
+    def __init__(self, capacity: int = 512):
+        self._lock = threading.Lock()
+        self._records: deque[dict] = deque(maxlen=capacity)
+
+    def append(self, record: dict) -> None:
+        with self._lock:
+            self._records.append(record)
+
+    def snapshot(self, limit: int | None = None, model: str | None = None) -> list[dict]:
+        """Most-recent-first records, optionally filtered by model.
+        None/zero/negative *limit* means unbounded (the ring cap is the
+        real bound) — a negative must not slice from the tail."""
+        with self._lock:
+            out = list(self._records)
+        out.reverse()
+        if model:
+            out = [r for r in out if r.get("model") == model]
+        return out[:limit] if limit is not None and limit > 0 else out
 
 
 @dataclass
@@ -63,8 +110,12 @@ def scrape_engine_load(addr: str, timeout: float = 3.0) -> float:
 def engine_queue_scraper(lb, timeout: float = 2.0, max_workers: int = 8):
     """Build the autoscaler's engine-load callback over the load balancer's
     endpoint view. Pods are scraped concurrently so dead endpoints cost one
-    timeout per tick, not one per pod; unreachable pods contribute zero."""
-    from concurrent.futures import ThreadPoolExecutor
+    timeout per tick, not one per pod; unreachable pods contribute zero.
+    Uses the process-wide long-lived scrape executor (building a fresh
+    ThreadPoolExecutor per model per tick paid thread spawn/teardown on
+    every scaling decision). Prefer wiring a FleetCollector (fleet.py),
+    which shares one scrape per endpoint with the /debug/fleet plane."""
+    from kubeai_tpu.autoscaler.fleet import shared_scrape_executor
 
     def scrape(model_name: str) -> float:
         addrs = lb.get_all_addresses(model_name)
@@ -75,10 +126,10 @@ def engine_queue_scraper(lb, timeout: float = 2.0, max_workers: int = 8):
             try:
                 return scrape_engine_load(addr, timeout=timeout)
             except Exception:
+                M_SCRAPE_FAILURES.inc(labels={"scope": "engine"})
                 return 0.0
 
-        with ThreadPoolExecutor(max_workers=min(max_workers, len(addrs))) as ex:
-            return float(sum(ex.map(one, addrs)))
+        return float(sum(shared_scrape_executor(max_workers).map(one, addrs)))
 
     return scrape
 
@@ -106,6 +157,9 @@ class Autoscaler:
         state_name: str = "kubeai-autoscaler-state",
         namespace: str = "default",
         engine_queue_scrape=None,
+        fleet=None,
+        decision_capacity: int = 512,
+        clock=time.time,
     ):
         self.store = store
         self.model_client = model_client
@@ -116,7 +170,19 @@ class Autoscaler:
         self.fixed_addrs = fixed_self_metric_addrs or []
         self.state_name = state_name
         self.namespace = namespace
+        # Engine-load signal: a FleetCollector (one scrape per endpoint
+        # per tick, shared with /debug/fleet) or the legacy per-model
+        # scrape closure. When only the collector is given, expose its
+        # scrape through the legacy attribute too so existing callers
+        # keep working.
+        self.fleet = fleet
+        if engine_queue_scrape is None and fleet is not None:
+            engine_queue_scrape = fleet.scrape_model
         self.engine_queue_scrape = engine_queue_scrape
+        # Per-tick decision audit (GET /debug/autoscaler); *clock* is the
+        # wall-clock source for record timestamps, injectable in tests.
+        self.decisions = DecisionLog(decision_capacity)
+        self._clock = clock
         self._averages: dict[str, SimpleMovingAverage] = {}
         self._running = False
         self._thread: threading.Thread | None = None
@@ -174,11 +240,19 @@ class Autoscaler:
                 log.exception("autoscaler tick failed")
 
     def tick(self):
+        t0 = time.monotonic()
         models = self.model_client.list_all_models()
-        actives = self.aggregate_metrics()
-        for model in models:
-            if model.spec.autoscaling_disabled:
-                continue
+        actives, peer_failures = self._aggregate_metrics_detailed()
+        enabled = [m for m in models if not m.spec.autoscaling_disabled]
+        fleet_view = None
+        if self.fleet is not None:
+            # ONE scrape per endpoint for the whole tick; the same
+            # snapshot backs /debug/fleet until the next tick. ALL
+            # models (autoscaling-disabled included): the fleet view is
+            # observability, and a partial cache would force the debug
+            # plane to re-scrape on every GET.
+            fleet_view = self.fleet.collect([m.meta.name for m in models])
+        for model in enabled:
             name = model.meta.name
             avg = self._averages.get(name)
             if avg is None:
@@ -190,29 +264,87 @@ class Autoscaler:
             # would double-count saturation. max() covers the case the
             # gauge can't see: traffic reaching engines without passing
             # any operator replica.
-            signal = actives.get(name, 0.0)
-            if self.engine_queue_scrape is not None:
-                signal = max(signal, self.engine_queue_scrape(name))
+            proxy_signal = actives.get(name, 0.0)
+            engine_signal = None
+            engine_failures: list[str] = []
+            if fleet_view is not None:
+                view = fleet_view.get(name)
+                if view is not None:
+                    engine_signal = view["aggregate"]["load"]
+                    engine_failures = [
+                        e["address"] for e in view["endpoints"] if not e["ok"]
+                    ]
+            elif self.engine_queue_scrape is not None:
+                engine_signal = self.engine_queue_scrape(name)
+            signal = (
+                max(proxy_signal, engine_signal)
+                if engine_signal is not None
+                else proxy_signal
+            )
             avg.next(signal)
             mean = avg.calculate()
-            import math
-
-            desired = math.ceil(mean / max(model.spec.target_requests, 1))
-            self.model_client.scale(name, desired)
+            target = max(model.spec.target_requests, 1)
+            desired = math.ceil(mean / target)
+            outcome = self.model_client.scale(name, desired)
+            if not isinstance(outcome, dict):
+                # A subclassed/stubbed client that doesn't return the
+                # decision detail still gets an audit record.
+                outcome = {}
+            record = {
+                "t": self._clock(),
+                "model": name,
+                "signal": {
+                    "proxy": round(proxy_signal, 3),
+                    "engine": (
+                        round(engine_signal, 3) if engine_signal is not None else None
+                    ),
+                    "combined": round(signal, 3),
+                },
+                "window_avg": round(mean, 3),
+                "target_requests": target,
+                "desired": desired,
+                "clamped": outcome.get("clamped"),
+                "current": outcome.get("current"),
+                "applied": outcome.get("applied"),
+                "applied_replicas": outcome.get("replicas"),
+                "reason": outcome.get("reason"),
+                "consecutive_scale_downs": outcome.get("consecutive_scale_downs"),
+                "required_consecutive": outcome.get("required_consecutive"),
+                "scrape_failures": {
+                    "peers": peer_failures,
+                    "engines": engine_failures,
+                },
+            }
+            self.decisions.append(record)
+            labels = {"model": name}
+            M_DESIRED.set(desired, labels=labels)
+            M_SIGNAL.set(proxy_signal, labels={**labels, "source": "proxy"})
+            if engine_signal is not None:
+                M_SIGNAL.set(engine_signal, labels={**labels, "source": "engine"})
+            M_SIGNAL.set(signal, labels={**labels, "source": "combined"})
         self._save_state()
+        M_TICK.observe(time.monotonic() - t0)
 
     def aggregate_metrics(self) -> dict[str, float]:
         """Sum active requests across every operator replica
         (ref: aggregateAllMetrics, metrics.go:15-34)."""
+        return self._aggregate_metrics_detailed()[0]
+
+    def _aggregate_metrics_detailed(self) -> tuple[dict[str, float], list[str]]:
+        """Peer-scrape totals PLUS the addresses that failed this tick —
+        the per-peer failure attribution the decision audit records."""
         addrs = self.fixed_addrs or self.lb.get_self_ips()
         totals: dict[str, float] = {}
+        failures: list[str] = []
         if not addrs:
             # Single-process mode: read our own registry directly.
-            return parse_scraped_text(default_registry.render())
+            return parse_scraped_text(default_registry.render()), failures
         for addr in addrs:
             try:
                 for model, v in scrape_metrics(addr).items():
                     totals[model] = totals.get(model, 0.0) + v
             except Exception as e:
                 log.warning("scrape %s failed: %s", addr, e)
-        return totals
+                failures.append(addr)
+                M_SCRAPE_FAILURES.inc(labels={"scope": "peer"})
+        return totals, failures

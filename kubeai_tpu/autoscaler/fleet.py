@@ -1,0 +1,348 @@
+"""Fleet scrape collector: one /metrics scrape per engine endpoint per
+tick, shared by the autoscaler's engine-load signal and the operator's
+``GET /debug/fleet`` plane.
+
+Before this module the autoscaler scraped every engine endpoint per
+model per tick through a throwaway ThreadPoolExecutor, and anyone who
+wanted a fleet view had to scrape the same pods again. The collector
+owns ONE long-lived executor (also used by the legacy
+``engine_queue_scraper`` closure), fetches each endpoint exactly once
+per collect, derives per-endpoint tokens/sec from the generated-token
+counter deltas between collects, merges the load balancer's circuit-
+breaker state, and publishes per-model aggregate gauges plus a
+capacity-headroom estimate.
+"""
+
+from __future__ import annotations
+
+import queue
+import threading
+import time
+
+from kubeai_tpu.metrics.registry import default_registry, parse_prometheus_text
+
+# Engine-side gauges/counters the collector reads off each endpoint's
+# /metrics page (all exported by kubeai_tpu/engine/core.py).
+_QUEUE = "kubeai_engine_queue_depth"
+_ACTIVE = "kubeai_engine_active_slots"
+_SLOTS_TOTAL = "kubeai_engine_slots_total"
+_PAGES_USED = "kubeai_engine_kv_pages_used"
+_PAGES_CACHED = "kubeai_engine_kv_pages_cached"
+_PAGES_TOTAL = "kubeai_engine_kv_pages_total"
+_GEN_TOKENS = "kubeai_engine_generated_tokens_total"
+_HBM_USED = "kubeai_engine_hbm_used_bytes"
+_HBM_LIMIT = "kubeai_engine_hbm_limit_bytes"
+
+M_FLEET_ACTIVE = default_registry.gauge(
+    "kubeai_fleet_active_slots",
+    "decode slots in use across the model's endpoints (fleet scrape sum)",
+)
+M_FLEET_QUEUE = default_registry.gauge(
+    "kubeai_fleet_queue_depth",
+    "requests queued inside engines across the model's endpoints",
+)
+M_FLEET_FREE_PAGES = default_registry.gauge(
+    "kubeai_fleet_free_pages",
+    "unreferenced KV pool pages across the model's endpoints",
+)
+M_FLEET_TPS = default_registry.gauge(
+    "kubeai_fleet_tokens_per_second",
+    "fleet decode throughput per model (generated-token counter deltas between collects)",
+)
+M_FLEET_HEADROOM = default_registry.gauge(
+    "kubeai_fleet_headroom_requests",
+    "estimated additional concurrent requests the model's fleet can absorb "
+    "(free slots bounded by free KV pages at the observed pages-per-request)",
+)
+# Same metric the autoscaler's peer scrape increments (scope label keeps
+# the sources apart); registering here is idempotent get-or-create.
+M_SCRAPE_FAILURES = default_registry.counter(
+    "kubeai_autoscaler_scrape_failures_total",
+    "failed telemetry scrapes by scope (peer = operator replica, engine = engine pod)",
+)
+
+class DaemonScrapePool:
+    """map()-style executor whose workers are DAEMON threads. A
+    stdlib ThreadPoolExecutor's workers are non-daemon (joined at
+    interpreter exit), which for a process-long scrape pool both blocks
+    shutdown behind an in-flight scrape timeout and reads as a thread
+    leak to the chaos suite's auditor."""
+
+    def __init__(self, max_workers: int = 8, thread_name_prefix: str = "kubeai-scrape"):
+        self._q: "queue.Queue[tuple]" = queue.Queue()
+        self._prefix = thread_name_prefix
+        self._n_workers = 0
+        self._grow_lock = threading.Lock()
+        self.grow_to(max_workers)
+
+    def grow_to(self, max_workers: int) -> None:
+        """Ensure at least *max_workers* workers exist (workers never
+        die, so this only ever adds). The shared singleton honors the
+        LARGEST size any caller asked for instead of silently pinning
+        the first caller's."""
+        with self._grow_lock:
+            for i in range(self._n_workers, max_workers):
+                threading.Thread(
+                    target=self._worker, name=f"{self._prefix}-{i}", daemon=True
+                ).start()
+            self._n_workers = max(self._n_workers, max_workers)
+
+    def _worker(self) -> None:
+        while True:
+            fn, arg, out, idx, done = self._q.get()
+            try:
+                out[idx] = (True, fn(arg))
+            except BaseException as e:  # re-raised on the caller's thread
+                out[idx] = (False, e)
+            finally:
+                done.release()
+
+    def map(self, fn, iterable) -> list:
+        """Eager, order-preserving map (the lazy-iterator subtlety of
+        Executor.map is not needed by any scrape caller)."""
+        items = list(iterable)
+        out: list = [None] * len(items)
+        done = threading.Semaphore(0)
+        for i, item in enumerate(items):
+            self._q.put((fn, item, out, i, done))
+        for _ in items:
+            done.acquire()
+        results = []
+        for ok, val in out:
+            if not ok:
+                raise val
+            results.append(val)
+        return results
+
+
+_EXECUTOR: DaemonScrapePool | None = None
+_EXECUTOR_LOCK = threading.Lock()
+
+
+def shared_scrape_executor(max_workers: int = 8) -> DaemonScrapePool:
+    """The ONE long-lived scrape executor. The old engine_queue_scraper
+    built (and tore down) a fresh ThreadPoolExecutor per model per tick;
+    every scraping path now shares this pool."""
+    global _EXECUTOR
+    with _EXECUTOR_LOCK:
+        if _EXECUTOR is None:
+            _EXECUTOR = DaemonScrapePool(
+                max_workers=max_workers, thread_name_prefix="kubeai-scrape"
+            )
+        else:
+            _EXECUTOR.grow_to(max_workers)
+        return _EXECUTOR
+
+
+def _default_fetch(addr: str, timeout: float) -> str:
+    import urllib.request
+
+    url = addr if addr.startswith("http") else f"http://{addr}/metrics"
+    with urllib.request.urlopen(url, timeout=timeout) as resp:
+        return resp.read().decode()
+
+
+class FleetCollector:
+    """Scrapes every endpoint of the given models once per ``collect()``
+    and retains the snapshot so the debug plane reuses the autoscaler's
+    tick scrape instead of re-fetching. *fetch* and *clock* are
+    injectable for tests."""
+
+    def __init__(self, lb, timeout: float = 2.0, max_workers: int = 8, clock=time.monotonic, fetch=None, default_max_age: float = 15.0):
+        self.lb = lb
+        self.timeout = timeout
+        self.max_workers = max_workers
+        # /debug/fleet serves the cached snapshot while younger than
+        # this; the manager sets it from the autoscaler interval so the
+        # tick's scrape is the steady-state source and dashboard polling
+        # can't re-scrape the fleet between ticks.
+        self.default_max_age = default_max_age
+        self._clock = clock
+        self._fetch = fetch or (lambda addr: _default_fetch(addr, self.timeout))
+        self._lock = threading.Lock()
+        # Serializes whole collects (single-flight): concurrent
+        # /debug/fleet GETs on a stale cache must not each launch a
+        # fleet scrape, and overlapping collects would write sub-
+        # interval token deltas into the tokens/sec derivation.
+        self._collect_lock = threading.Lock()
+        self._last: dict[str, dict] = {}
+        self._last_at: float | None = None
+        # addr -> (generated_tokens_total, t) for tokens/sec derivation.
+        self._prev_tokens: dict[str, tuple[float, float]] = {}
+        # addr -> full parsed /metrics page from the last collect — the
+        # SLO monitor's remote source (engine histograms live in engine
+        # processes; the operator only sees them through these scrapes).
+        self._last_pages: dict[str, dict] = {}
+        # addr -> last time a collect targeted it. Endpoints leave the
+        # fleet silently (scale-down, pod replacement gets a fresh
+        # port), so per-addr state must age out or weeks of pod churn
+        # grow these dicts — and the SLO monitor's per-tick page scan —
+        # without bound.
+        self._addr_seen: dict[str, float] = {}
+        self.addr_ttl = 600.0
+
+    # -- scraping ----------------------------------------------------------
+
+    def _scrape_one(self, model: str, addr: str) -> dict:
+        now = self._clock()
+        with self._lock:
+            self._addr_seen[addr] = now
+        try:
+            parsed = parse_prometheus_text(self._fetch(addr))
+        except Exception as e:
+            M_SCRAPE_FAILURES.inc(labels={"scope": "engine"})
+            with self._lock:
+                self._last_pages.pop(addr, None)
+            return {"address": addr, "ok": False, "error": str(e)[:200]}
+        with self._lock:
+            self._last_pages[addr] = parsed
+
+        def val(name: str) -> float:
+            return sum(v for _, v in parsed.get(name, []))
+
+        tokens_total = val(_GEN_TOKENS)
+        prev = self._prev_tokens.get(addr)
+        tps = 0.0
+        if prev is not None and now > prev[1] and tokens_total >= prev[0]:
+            tps = (tokens_total - prev[0]) / (now - prev[1])
+        self._prev_tokens[addr] = (tokens_total, now)
+        return {
+            "address": addr,
+            "ok": True,
+            "queue_depth": val(_QUEUE),
+            "active_slots": val(_ACTIVE),
+            "slots_total": val(_SLOTS_TOTAL),
+            "pages_used": val(_PAGES_USED),
+            "pages_cached": val(_PAGES_CACHED),
+            "pages_total": val(_PAGES_TOTAL),
+            "tokens_per_second": round(tps, 3),
+            "hbm_used_bytes": val(_HBM_USED),
+            "hbm_limit_bytes": val(_HBM_LIMIT),
+        }
+
+    @staticmethod
+    def _aggregate(endpoints: list[dict]) -> dict:
+        ok = [e for e in endpoints if e["ok"]]
+        agg = {
+            k: round(sum(e[k] for e in ok), 3)
+            for k in (
+                "queue_depth", "active_slots", "slots_total", "pages_used",
+                "pages_cached", "pages_total", "tokens_per_second",
+            )
+        }
+        agg["endpoints"] = len(ok)
+        agg["failed_endpoints"] = len(endpoints) - len(ok)
+        agg["free_pages"] = max(agg["pages_total"] - agg["pages_used"], 0.0)
+        # Headroom estimate: free slots, bounded by how many more
+        # sequences the free KV pages can back at the fleet's observed
+        # pages-per-active-request. With no live requests the page bound
+        # is unknowable — free slots alone is the estimate.
+        free_slots = max(agg["slots_total"] - agg["active_slots"], 0.0)
+        if agg["active_slots"] > 0 and agg["pages_used"] > 0:
+            pages_per_req = agg["pages_used"] / agg["active_slots"]
+            agg["headroom_requests"] = round(
+                min(free_slots, agg["free_pages"] / pages_per_req), 1
+            )
+        else:
+            agg["headroom_requests"] = free_slots
+        # The autoscaler's engine-load signal: queued + active work.
+        agg["load"] = agg["queue_depth"] + agg["active_slots"]
+        return agg
+
+    def collect(self, models: list[str]) -> dict[str, dict]:
+        """One scrape per endpoint of *models*; returns (and caches)
+        model -> {"endpoints": [...], "aggregate": {...}}. Collects are
+        serialized — see _collect_lock."""
+        with self._collect_lock:
+            return self._collect(models)
+
+    def _collect(self, models: list[str]) -> dict[str, dict]:
+        jobs = [
+            (model, addr)
+            for model in models
+            for addr in self.lb.get_all_addresses(model)
+        ]
+        ex = shared_scrape_executor(self.max_workers)
+        scraped = list(ex.map(lambda j: (j[0], self._scrape_one(*j)), jobs))
+        breaker: dict[str, dict[str, str]] = {}
+        snap_fn = getattr(self.lb, "breaker_snapshot", None)
+        if callable(snap_fn):
+            for model, eps in snap_fn().items():
+                breaker[model] = {e["address"]: e["state"] for e in eps}
+        views: dict[str, dict] = {}
+        for model in models:
+            eps = [rec for m, rec in scraped if m == model]
+            for e in eps:
+                e["breaker_state"] = breaker.get(model, {}).get(e["address"])
+            agg = self._aggregate(eps)
+            views[model] = {"endpoints": eps, "aggregate": agg}
+            labels = {"model": model}
+            M_FLEET_ACTIVE.set(agg["active_slots"], labels=labels)
+            M_FLEET_QUEUE.set(agg["queue_depth"], labels=labels)
+            M_FLEET_FREE_PAGES.set(agg["free_pages"], labels=labels)
+            M_FLEET_TPS.set(agg["tokens_per_second"], labels=labels)
+            M_FLEET_HEADROOM.set(agg["headroom_requests"], labels=labels)
+        with self._lock:
+            self._last = views
+            self._last_at = self._clock()
+            # Age out per-addr state for endpoints no collect has
+            # targeted within the TTL (they left the fleet).
+            cutoff = self._last_at - self.addr_ttl
+            for addr in [a for a, t in self._addr_seen.items() if t < cutoff]:
+                self._addr_seen.pop(addr, None)
+                self._prev_tokens.pop(addr, None)
+                self._last_pages.pop(addr, None)
+        return views
+
+    # -- consumers ---------------------------------------------------------
+
+    def scrape_model(self, model: str) -> float:
+        """Legacy engine_queue_scrape-shaped entry point: the model's
+        queued + active engine work (fresh scrape)."""
+        view = self.collect([model]).get(model)
+        return float(view["aggregate"]["load"]) if view else 0.0
+
+    def parsed_pages(self) -> list[dict]:
+        """The last collect's fully parsed /metrics pages (one per
+        reachable endpoint) — the SLOMonitor's remote metric source.
+        Cumulative engine counters reset when a pod restarts; the
+        monitor clamps negative window deltas to zero, so a restart
+        reads as a brief dip in window volume, not as garbage."""
+        with self._lock:
+            return list(self._last_pages.values())
+
+    def debug_view(self, models: list[str], max_age: float | None = None) -> dict:
+        """The /debug/fleet payload. Reuses the last collect when it is
+        fresher than *max_age* (default: the collector's configured age,
+        sized to the autoscaler interval so the leader's tick scrape is
+        the steady-state source); re-collects otherwise. On non-leader
+        replicas (no tick warming the cache) the GET itself refreshes —
+        still bounded to one fleet scrape per *max_age* per replica,
+        however fast dashboards poll."""
+        max_age = self.default_max_age if max_age is None else max_age
+
+        def fresh_snapshot():
+            with self._lock:
+                last, last_at = self._last, self._last_at
+            now = self._clock()
+            if (
+                last_at is not None
+                and now - last_at <= max_age
+                and set(models) <= set(last)
+            ):
+                return now, last_at, last
+            return now, last_at, None
+
+        now, last_at, last = fresh_snapshot()
+        if last is None:
+            with self._collect_lock:
+                # Single-flight: a concurrent caller may have refreshed
+                # while we waited for the lock — re-check before scraping.
+                now, last_at, last = fresh_snapshot()
+                if last is None:
+                    last = self._collect(models)
+                    last_at = now
+        return {
+            "age_seconds": round(max(now - last_at, 0.0), 3),
+            "models": {m: last[m] for m in models if m in last},
+        }

@@ -34,6 +34,7 @@ import logging
 import queue
 import threading
 import time
+from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from functools import partial
@@ -276,7 +277,9 @@ class Engine:
             "kubeai_engine_prefill_tokens_total", "prompt tokens prefilled"
         )
         self.m_ttft = default_registry.histogram(
-            "kubeai_engine_ttft_seconds", "time to first token"
+            "kubeai_engine_ttft_seconds",
+            "submit to first emitted token (true TTFT: queue wait + prefill "
+            "+ first-token round-trip)",
         )
         # Per-phase latency histograms derived from request traces, and
         # the outcome-labeled terminal accounting (EVERY request ends in
@@ -302,26 +305,92 @@ class Engine:
         self.m_e2e = default_registry.histogram(
             "kubeai_request_e2e_seconds",
             "request end-to-end latency by terminal outcome",
+            # Extends past the default buckets: e2e latencies of long
+            # generations land in the tens of seconds, and the SLO
+            # monitor can only resolve objectives to a bucket bound
+            # (the default 30s e2e objective needs a 30s bucket).
+            buckets=(0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120),
         )
-        self.m_hbm_used = default_registry.gauge(
-            "kubeai_engine_hbm_used_bytes", "accelerator memory in use"
+        # Occupancy metrics are CALLBACK gauges: evaluated when /metrics
+        # is scraped, so they can never go stale between the scheduler
+        # events that used to .set() them. The fns are captured in
+        # locals so stop() can unbind exactly them — the process-global
+        # registry must not pin a dead engine's KV pool in memory — and
+        # a newer engine's rebinding is never clobbered.
+        hbm_used_fn = lambda: float(self._hbm_stats()[0])  # noqa: E731
+        hbm_limit_fn = lambda: float(self._hbm_stats()[1])  # noqa: E731
+        pages_used_fn = lambda: float(self._pool.used())  # noqa: E731
+        pages_cached_fn = lambda: float(self._pool.cached_pages())  # noqa: E731
+        pages_total_fn = lambda: float(self._pool.num_pages - 1)  # noqa: E731
+        self.m_hbm_used = default_registry.callback_gauge(
+            "kubeai_engine_hbm_used_bytes", "accelerator memory in use",
+            hbm_used_fn,
         )
-        self.m_hbm_limit = default_registry.gauge(
-            "kubeai_engine_hbm_limit_bytes", "accelerator memory capacity"
+        self.m_hbm_limit = default_registry.callback_gauge(
+            "kubeai_engine_hbm_limit_bytes", "accelerator memory capacity",
+            hbm_limit_fn,
         )
         self.m_prefix_cached = default_registry.counter(
             "kubeai_engine_prefix_cached_tokens_total",
             "prompt tokens skipped via shared-prefix page reuse",
         )
-        self.m_pages_used = default_registry.gauge(
-            "kubeai_engine_kv_pages_used", "KV pool pages referenced by live slots"
+        self.m_pages_used = default_registry.callback_gauge(
+            "kubeai_engine_kv_pages_used",
+            "KV pool pages referenced by live slots",
+            pages_used_fn,
         )
-        self.m_pages_cached = default_registry.gauge(
-            "kubeai_engine_kv_pages_cached", "free KV pages retaining reusable prefixes"
+        self.m_pages_cached = default_registry.callback_gauge(
+            "kubeai_engine_kv_pages_cached",
+            "free KV pages retaining reusable prefixes",
+            pages_cached_fn,
         )
-        self.m_pages_total = default_registry.gauge(
-            "kubeai_engine_kv_pages_total", "allocatable KV pool pages"
+        self.m_pages_total = default_registry.callback_gauge(
+            "kubeai_engine_kv_pages_total",
+            "allocatable KV pool pages",
+            pages_total_fn,
         )
+        self._gauge_callbacks = [
+            (self.m_hbm_used, hbm_used_fn),
+            (self.m_hbm_limit, hbm_limit_fn),
+            (self.m_pages_used, pages_used_fn),
+            (self.m_pages_cached, pages_cached_fn),
+            (self.m_pages_total, pages_total_fn),
+        ]
+        # Saturation / goodput instrumentation derived from the scheduler
+        # loop (capacity observability: where is this replica's compute
+        # going — real tokens, padding, or idle slots).
+        self.m_slots_total = default_registry.gauge(
+            "kubeai_engine_slots_total", "configured decode slot capacity"
+        )
+        self.m_slots_total.set(self.cfg.max_slots)
+        self.m_step = default_registry.histogram(
+            "kubeai_engine_step_seconds",
+            "scheduler step wall time by phase. decode_chunk = chunk TURNAROUND "
+            "(dispatch to results consumed — the pipelined loop overlaps host "
+            "work, admissions, and the next dispatch inside it; the pure host "
+            "wait is the step record's fetch_wait_ms); prefill_* = dispatch call",
+        )
+        self.m_slot_steps = default_registry.counter(
+            "kubeai_engine_slot_steps_total",
+            "fused decode slot-steps by state; batch utilization = "
+            "active / (active + idle)",
+        )
+        self.m_pad_prefill = default_registry.counter(
+            "kubeai_engine_prefill_padded_tokens_total",
+            "prompt positions computed as bucket/batch padding (prefill waste; "
+            "compare against kubeai_engine_prefill_tokens_total)",
+        )
+        self.m_tok_rate = default_registry.gauge(
+            "kubeai_engine_tokens_per_second",
+            "decode goodput over the most recent chunks (0 when idle)",
+        )
+        self.m_recompiles = default_registry.counter(
+            "kubeai_engine_jit_recompiles_total",
+            "jitted step-function compilations observed (warmup compiles "
+            "included; growth after warmup means shape churn)",
+        )
+        self._jit_entries_seen = 0
+        self._rate_window: deque[tuple[float, int]] = deque()
         self.m_spec_drafted = default_registry.counter(
             "kubeai_engine_speculative_drafted_total", "draft tokens proposed"
         )
@@ -451,9 +520,6 @@ class Engine:
         # _register — ONE computation, because the page reservation must
         # exactly cover the slot's decode budget.
         self._slot_budget: list[int] = [0] * B
-        self.m_pages_total.set(P - 1)
-        self.m_pages_used.set(0)
-        self.m_pages_cached.set(0)
         # Prefix bookkeeping: per slot, the token ids whose KV has been
         # written to the slot's pages (generated-token pages are content-
         # registered from this at free time), and an epoch guarding
@@ -802,6 +868,10 @@ class Engine:
             self._running = True
             return
         self._running = True
+        # (Re)bind the occupancy callbacks: a stop() unbinds them, and
+        # the most recently started engine should own the gauges.
+        for gauge, fn in self._gauge_callbacks:
+            gauge.set_callback(fn)
         self._thread = threading.Thread(target=self._loop, name="engine-loop", daemon=True)
         self._thread.start()
 
@@ -825,6 +895,11 @@ class Engine:
             self._publisher.close()  # sends the followers "stop"
         # Fail anything still in flight so callers never hang on shutdown.
         self._fail_inflight("engine shutting down")
+        # Unbind this engine's callback gauges (only where it is still
+        # the current owner): the process-global registry must not pin
+        # the stopped engine's KV pool and jit caches for process life.
+        for gauge, fn in self._gauge_callbacks:
+            gauge.clear_callback(fn)
 
     def _fail_inflight(self, message: str) -> None:
         """Error out every slotted and queued request and reset counters
@@ -1215,21 +1290,48 @@ class Engine:
     def loaded_adapters(self) -> list[str]:
         return self._adapters.names() if self._adapters else []
 
-    def refresh_memory_stats(self) -> None:
-        """Update the HBM gauges (an autoscaling signal the reference never
-        had — its metrics stop at proxy-side in-flight counts; SURVEY.md §7
-        step 5 calls for engine-side HBM/queue gauges). Summed over this
-        process's addressable devices — remote devices of a multi-host
-        slice can't report stats (each worker publishes its own)."""
+    def _hbm_stats(self) -> tuple[int, int]:
+        """(used, limit) bytes over this process's addressable devices
+        (an autoscaling signal the reference never had — its metrics stop
+        at proxy-side in-flight counts). Remote devices of a multi-host
+        slice can't report stats (each worker publishes its own). Feeds
+        the HBM callback gauges at /metrics collect time."""
         used = limit = 0
         for dev in jax.local_devices():
             stats = getattr(dev, "memory_stats", lambda: None)()
             if stats:
                 used += stats.get("bytes_in_use", 0)
                 limit += stats.get("bytes_limit", 0)
-        if limit:
-            self.m_hbm_used.set(used)
-            self.m_hbm_limit.set(limit)
+        return used, limit
+
+    def _jit_cache_entries(self) -> int:
+        """Total compiled executables across the step functions (jax's
+        per-function lowering cache). Growth = a compilation happened."""
+        fns = list(self._decode_jits.values()) + [
+            self._prefill_batch_jit,
+            self._prefill_chunk_jit,
+            getattr(self, "_embed_jit", None),
+        ]
+        total = 0
+        for fn in fns:
+            size = getattr(fn, "_cache_size", None)
+            if callable(size):
+                try:
+                    total += size()
+                except Exception:  # pragma: no cover - jax API drift guard
+                    pass
+        return total
+
+    def _update_recompile_counter(self) -> None:
+        """Scheduler-loop poll: surface compilations (warmup AND shape-
+        churn recompiles) as a counter — steady growth after warmup is
+        the classic silent TPU latency killer."""
+        n = self._jit_cache_entries()
+        if n > self._jit_entries_seen:
+            self.m_recompiles.inc(n - self._jit_entries_seen)
+            self._jit_entries_seen = n
+        elif n < self._jit_entries_seen:
+            self._jit_entries_seen = n  # caches dropped (recovery rebuild)
 
     def is_ready(self) -> bool:
         """Readiness (k8s probe seam): the scheduler loop is alive and
@@ -1383,10 +1485,16 @@ class Engine:
                 if pending is not None:
                     self._process_chunk(*pending)
                 pending = dispatched
+                self._update_recompile_counter()
                 if (
                     pending is None and not admitted and self._n_active == 0
                     and self._aux.empty()
                 ):
+                    # Idle: the goodput gauge must read 0, not the last
+                    # busy chunk's rate.
+                    if self._rate_window:
+                        self._rate_window.clear()
+                        self.m_tok_rate.set(0.0)
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
             except GangDesync as e:
@@ -1643,10 +1751,6 @@ class Engine:
             return (0, 0)
         return self._adapters.row_sig(adapter)
 
-    def _update_page_gauges(self) -> None:
-        self.m_pages_used.set(self._pool.used())
-        self.m_pages_cached.set(self._pool.cached_pages())
-
     def _plan_admission(self, req: Request, taken: set[int]) -> tuple[int, int] | None:
         """Reserve a slot + KV pages for *req*: claim resident shared-
         prefix pages (cross-slot reuse), allocate private pages covering
@@ -1697,7 +1801,6 @@ class Engine:
         reuse = len(claimed) * ps
         if reuse:
             self.m_prefix_cached.inc(reuse)
-        self._update_page_gauges()
         return slot_idx, reuse
 
     def _release_slot_pages(self, slot_idx: int, register: bool = False) -> None:
@@ -1714,7 +1817,6 @@ class Engine:
         self._pool.release(row)
         self._slot_pages[slot_idx] = []
         self._page_table[slot_idx, :] = 0
-        self._update_page_gauges()
 
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
@@ -1746,10 +1848,12 @@ class Engine:
         max_bucket = max(self.cfg.prefill_buckets)
         bias_ids, bias_vals = self._bias_rows(sp)
         tok = lp = None
+        pad_tokens = 0
         for start in range(reuse, len(ids), max_bucket):
             chunk = ids[start : start + max_bucket]
             is_last = start + max_bucket >= len(ids)
             bucket = max_bucket if not is_last else self._bucket(len(chunk))
+            pad_tokens += bucket - len(chunk)
             chunk_padded = np.zeros((1, bucket), np.int32)
             chunk_padded[0, : len(chunk)] = chunk
             with self._lockstep(
@@ -1785,10 +1889,15 @@ class Engine:
                 )
 
         self._register(slot_idx, req, seed, lora_row, reuse)
+        dur = time.monotonic() - t_disp
+        self.m_step.observe(dur, labels={"phase": "prefill_chunked"})
+        if pad_tokens:
+            self.m_pad_prefill.inc(pad_tokens)
         default_recorder.record_step(
             kind="prefill_chunked", slot=slot_idx,
             prompt_tokens=len(ids), reuse_tokens=reuse,
-            dur_ms=round((time.monotonic() - t_disp) * 1000, 3),
+            pad_tokens=pad_tokens,
+            dur_ms=round(dur * 1000, 3),
         )
         return (slot_idx, self._slot_epoch[slot_idx], tok, None, lp, t_ids, t_lp)
 
@@ -1833,7 +1942,6 @@ class Engine:
         self._n_active += 1
         self.m_active.set(self._n_active)
         self.m_prefill.inc(len(ids) - reuse)  # actual prefill work done
-        self.m_ttft.observe(time.monotonic() - req.arrival)
 
         # Prefix-cache bookkeeping: the slot now holds exactly the prompt's
         # KV (positions beyond it are stale and unreachable by the mask).
@@ -1948,11 +2056,20 @@ class Engine:
         for j, (slot_idx, req) in enumerate(items):
             self._register(slot_idx, req, seeds[j], int(lora_rows_arr[j]), reuse=0)
             out.append((slot_idx, self._slot_epoch[slot_idx], toks, j, lps, t_ids, t_lp))
+        dur = time.monotonic() - t_disp
+        real_tokens = int(sum(len(r.prompt_ids) for _, r in items))
+        # Padding waste: the compiled [n_pad, bucket] shape vs the real
+        # prompt tokens (bucket tail pad + duplicated batch-pad rows).
+        pad_tokens = n_pad * bucket - real_tokens
+        self.m_step.observe(dur, labels={"phase": "prefill_group"})
+        if pad_tokens > 0:
+            self.m_pad_prefill.inc(pad_tokens)
         default_recorder.record_step(
             kind="prefill_group", bucket=bucket, batch=n,
             slots=[s for s, _ in items],
-            prompt_tokens=int(sum(len(r.prompt_ids) for _, r in items)),
-            dur_ms=round((time.monotonic() - t_disp) * 1000, 3),
+            prompt_tokens=real_tokens,
+            pad_tokens=pad_tokens,
+            dur_ms=round(dur * 1000, 3),
         )
         return out
 
@@ -2022,9 +2139,9 @@ class Engine:
         snapshot = [
             (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        return (d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq), snapshot
+        return (d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq), snapshot, time.monotonic()
 
-    def _process_chunk(self, payload, snapshot):
+    def _process_chunk(self, payload, snapshot, t_disp=None):
         # The top-N alternative arrays are fetched only when some slot in
         # this chunk's snapshot asked for logprobs: the device compute is
         # part of the static graph either way, but the host transfer
@@ -2032,6 +2149,7 @@ class Engine:
         any_top = any(
             s_obj.req.params.logprobs for _, s_obj, _ in snapshot
         )
+        t_fetch = time.monotonic()  # host wait starts here (device_get blocks)
         if any_top:
             drafts, corr, acc, lp_d, lp_c, t_ids, t_lp = jax.device_get(payload)
             t_ids = np.asarray(t_ids)  # [K, B, G+1, N] top-N alternative ids
@@ -2039,12 +2157,26 @@ class Engine:
         else:
             drafts, corr, acc, lp_d, lp_c = jax.device_get(payload[:5])
             t_ids = t_lp = None
+        fetch_wait = time.monotonic() - t_fetch
         drafts = np.asarray(drafts)  # [K, B, G]
         corr = np.asarray(corr)  # [K, B]
         acc = np.asarray(acc)  # [K, B]
         lp_d = np.asarray(lp_d)  # [K, B, G]
         lp_c = np.asarray(lp_c)  # [K, B]
         G = drafts.shape[2]
+        # Saturation accounting BEFORE emission: this chunk ran K fused
+        # steps over the full [B] batch with only the snapshot's slots
+        # doing useful work, and the step's wall time (dispatch ->
+        # results fetched) is known the moment the device_get returns.
+        # Emission below delivers terminal events — a client unblocked
+        # by one must already see these observations.
+        K_steps = int(acc.shape[0])
+        dur = (time.monotonic() - t_disp) if t_disp is not None else 0.0
+        self.m_step.observe(dur, labels={"phase": "decode_chunk"})
+        self.m_slot_steps.inc(K_steps * len(snapshot), labels={"state": "active"})
+        idle = K_steps * (self.cfg.max_slots - len(snapshot))
+        if idle:
+            self.m_slot_steps.inc(idle, labels={"state": "idle"})
         n_emitted = 0
         spec_drafted = spec_accepted = 0
         for k in range(acc.shape[0]):
@@ -2092,18 +2224,33 @@ class Engine:
                     if self._slots[i] is slot_obj:
                         self._emit_token(i, tok, lp, top)
                         n_emitted += 1
+        # Goodput gauge: emitted tokens over a sliding ~10s of chunks.
+        now = time.monotonic()
+        self._rate_window.append((now, n_emitted))
+        cutoff = now - 10.0
+        while len(self._rate_window) > 1 and self._rate_window[0][0] < cutoff:
+            self._rate_window.popleft()
+        span = now - self._rate_window[0][0]
+        if span > 0:
+            self.m_tok_rate.set(
+                round(sum(n for _, n in self._rate_window) / span, 3)
+            )
         # Flight-recorder step record: what the scheduler dispatched and
         # what came back (the /debug/engine view — batch composition,
         # token counts, kernel flavor, pages in use).
         step: dict = {
             "kind": "decode_chunk",
-            "steps": int(acc.shape[0]),
+            "steps": K_steps,
             "slots": [i for i, _, _ in snapshot],
             "tokens": n_emitted,
             "kernel": self._decode_kernel,
             "pages_used": self._pool.used(),
             "pages_total": self._pool.num_pages - 1,
             "queue_depth": self.queue_depth(),
+            "dur_ms": round(dur * 1000, 3),
+            # Pure host block inside device_get — dur_ms minus this is
+            # the loop work the pipelining successfully overlapped.
+            "fetch_wait_ms": round(fetch_wait * 1000, 3),
         }
         if G:
             step["spec_drafted"] = spec_drafted
@@ -2125,6 +2272,12 @@ class Engine:
 
         slot.generated += 1
         self.m_gen.inc()
+        if slot.generated == 1:
+            # True TTFT: the request's first token reached emission.
+            # (Observed here, not at slot admission — admission can be
+            # fast while prefill + the first-token sync are not, and
+            # the SLO monitor reads this histogram.)
+            self.m_ttft.observe(time.monotonic() - req.arrival)
         if req.trace is not None:
             req.trace.tok()  # one monotonic read + list append
 

@@ -238,10 +238,9 @@ def _make_handler(srv: EngineServer):
                 self.end_headers()
                 self.wfile.write(body)
             elif path == "/metrics":
-                try:
-                    srv.engine.refresh_memory_stats()
-                except Exception:
-                    pass  # platform without memory_stats
+                # Occupancy gauges (KV pages, HBM) are callback gauges —
+                # render() evaluates them at collect time, nothing to
+                # refresh first.
                 body = default_registry.render().encode()
                 self.send_response(200)
                 self.send_header("Content-Type", "text/plain; version=0.0.4")
@@ -968,10 +967,6 @@ def run_follower(args, hosts: list[str]) -> None:
                 ).encode()
                 ctype = "application/json"
             elif path == "/metrics":
-                try:
-                    engine.refresh_memory_stats()
-                except Exception:
-                    pass
                 body = default_registry.render().encode()
                 ctype = "text/plain; version=0.0.4"
             else:

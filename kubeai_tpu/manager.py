@@ -17,8 +17,10 @@ import os
 import time
 import uuid
 
-from kubeai_tpu.autoscaler.autoscaler import Autoscaler, engine_queue_scraper
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.fleet import FleetCollector
 from kubeai_tpu.autoscaler.leader import Election
+from kubeai_tpu.obs.slo import SLOMonitor
 from kubeai_tpu.config.system import System, load_system_config
 from kubeai_tpu.controller.adapters import AdapterReconciler
 from kubeai_tpu.controller.cache import CacheReconciler
@@ -71,6 +73,14 @@ class Manager:
             cache_reconciler=self.cache_reconciler,
             adapter_reconciler=self.adapter_reconciler,
         )
+        # One scrape per engine endpoint per autoscaler tick, shared by
+        # the scaling signal and the /debug/fleet plane; the debug cache
+        # stays valid for 1.5 ticks so dashboard polling between ticks
+        # never re-scrapes the fleet.
+        self.fleet = FleetCollector(
+            self.lb,
+            default_max_age=1.5 * self.system.autoscaling.interval_seconds,
+        )
         self.autoscaler = Autoscaler(
             self.store,
             self.model_client,
@@ -81,10 +91,26 @@ class Manager:
             fixed_self_metric_addrs=self.system.fixed_self_metric_addrs,
             state_name=self.system.autoscaling.state_config_map_name,
             namespace=namespace,
-            engine_queue_scrape=engine_queue_scraper(self.lb),
+            fleet=self.fleet,
+        )
+        # The engine histograms the latency objectives need live in
+        # engine processes — the fleet collector's scrapes are how this
+        # operator-side monitor sees them (local registry alone would
+        # report vacuous green in any split deployment).
+        self.slo = SLOMonitor(
+            interval_seconds=self.system.autoscaling.interval_seconds,
+            remote_pages=self.fleet.parsed_pages,
+            # Only the lease holder's autoscaler keeps the fleet
+            # scrapes warm, so only it can compute real SLO numbers;
+            # non-leaders must not export vacuously green gauges.
+            election=self.election,
         )
         self.proxy = ModelProxy(self.model_client, self.lb)
         self.api = OpenAIServer(self.proxy, self.model_client, host=host, port=port)
+        self.api.decision_log = self.autoscaler.decisions
+        self.api.fleet = self.fleet
+        self.api.slo = self.slo
+        self.api.election = self.election
         self.messengers = [
             Messenger(
                 stream.requests_url,
@@ -103,6 +129,7 @@ class Manager:
         self.reconciler.start()
         self.election.start()
         self.autoscaler.start()
+        self.slo.start()
         if self.local_runtime:
             self.local_runtime.start()
         for m in self.messengers:
@@ -123,6 +150,7 @@ class Manager:
         self.api.stop()
         if self.local_runtime:
             self.local_runtime.stop()
+        self.slo.stop()
         self.autoscaler.stop()
         self.election.stop()
         self.reconciler.stop()

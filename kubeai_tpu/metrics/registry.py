@@ -70,6 +70,19 @@ class _Metric:
     def _key(self, labels: dict[str, str] | None):
         return tuple(sorted((labels or {}).items()))
 
+    def remove(self, labels: dict[str, str] | None = None) -> None:
+        """Drop one labeled series (no-op if absent) — for owners whose
+        series must DISAPPEAR rather than freeze at a stale value (e.g.
+        a demoted leader's SLO gauges)."""
+        with self._lock:
+            self._values.pop(self._key(labels), None)
+
+    def snapshot(self) -> dict[tuple[tuple[str, str], ...], float]:
+        """Point-in-time copy of every labeled series — the seam the SLO
+        monitor differences across its rolling window."""
+        with self._lock:
+            return dict(self._values)
+
     def collect(self) -> list[str]:
         with self._lock:
             lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
@@ -108,6 +121,46 @@ class Gauge(_Metric):
             return self._values.get(self._key(labels), 0.0)
 
 
+class CallbackGauge(_Metric):
+    """Gauge whose value is computed by a callback at COLLECT time, so
+    occupancy metrics (KV pages, HBM) can never go stale between the
+    events that used to ``.set()`` them. Re-registering the same name
+    rebinds the callback — latest owner wins, mirroring how repeated
+    ``Gauge.set()`` callers behave when tests build several engines in
+    one process."""
+
+    kind = "gauge"
+
+    def __init__(self, name: str, help_: str = "", fn=None):
+        super().__init__(name, help_)
+        self._fn = fn
+
+    def set_callback(self, fn) -> None:
+        with self._lock:
+            self._fn = fn
+
+    def clear_callback(self, fn) -> None:
+        """Unbind *fn* IF it is still the current callback — the seam a
+        dying owner uses so the process-global registry stops pinning
+        it, without clobbering a newer owner's rebinding."""
+        with self._lock:
+            if self._fn is fn:
+                self._fn = None
+
+    def value(self) -> float:
+        with self._lock:
+            fn = self._fn
+        return float(fn()) if fn is not None else 0.0
+
+    def collect(self) -> list[str]:
+        lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} {self.kind}"]
+        try:
+            lines.append(f"{self.name} {self.value()}")
+        except Exception:
+            pass  # a dying callback must never break the whole /metrics page
+        return lines
+
+
 class Histogram(_Metric):
     kind = "histogram"
 
@@ -128,6 +181,12 @@ class Histogram(_Metric):
             entry[0][idx] += 1
             entry[1] += value
             entry[2] += 1
+
+    def snapshot(self) -> dict[tuple, tuple[list[int], float, int]]:
+        """Point-in-time copy: key -> (per-bucket counts with the +Inf
+        slot last — NON-cumulative, unlike the exposition —, sum, count)."""
+        with self._lock:
+            return {k: (list(c), s, n) for k, (c, s, n) in self._obs.items()}
 
     def collect(self) -> list[str]:
         with self._lock:
@@ -156,8 +215,22 @@ class Registry:
     def gauge(self, name: str, help_: str = "") -> Gauge:
         return self._get_or_create(name, help_, Gauge)
 
+    def callback_gauge(self, name: str, help_: str = "", fn=None) -> CallbackGauge:
+        g = self._get_or_create(
+            name, help_, CallbackGauge, lambda: CallbackGauge(name, help_, fn)
+        )
+        if fn is not None:
+            g.set_callback(fn)
+        return g
+
     def histogram(self, name: str, help_: str = "", buckets=Histogram.DEFAULT_BUCKETS) -> Histogram:
         return self._get_or_create(name, help_, Histogram, lambda: Histogram(name, help_, buckets))
+
+    def get(self, name: str) -> _Metric | None:
+        """Registered metric by name (None when absent) — read-only
+        introspection for derived consumers (the SLO monitor)."""
+        with self._lock:
+            return self._metrics.get(name)
 
     def _get_or_create(self, name, help_, cls, factory=None):
         with self._lock:

@@ -13,6 +13,12 @@ from kubeai_tpu.obs.recorder import (
     default_recorder,
     handle_debug_request,
 )
+from kubeai_tpu.obs.slo import (
+    SLObjective,
+    SLOMonitor,
+    attainment_block,
+    error_rate_block,
+)
 from kubeai_tpu.obs.trace import (
     RequestTrace,
     Span,
@@ -28,6 +34,10 @@ __all__ = [
     "FlightRecorder",
     "default_recorder",
     "handle_debug_request",
+    "SLObjective",
+    "SLOMonitor",
+    "attainment_block",
+    "error_rate_block",
     "RequestTrace",
     "Span",
     "SpanBuilder",

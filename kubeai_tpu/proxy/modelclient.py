@@ -54,20 +54,37 @@ class ModelClient:
         except NotFound:
             pass
 
-    def scale(self, model_name: str, desired: int) -> None:
+    def scale(self, model_name: str, desired: int) -> dict:
         """Autoscaler-driven scale (ref: scale.go:43-100): scale-up applies
         immediately; scale-down only after N consecutive decisions; always
-        clamped to [minReplicas, maxReplicas]."""
+        clamped to [minReplicas, maxReplicas]. Returns the decision detail
+        the autoscaler's audit log records — desired vs clamped, the
+        replica count before/after, and applied-or-skipped with a reason
+        (existing callers that ignore the return value are unaffected)."""
+
+        def decision(applied: bool, reason: str, clamped=None, current=None, replicas=None, n=None, required=None) -> dict:
+            return {
+                "desired": desired,
+                "clamped": clamped,
+                "current": current,
+                "replicas": replicas if replicas is not None else current,
+                "applied": applied,
+                "reason": reason,
+                "consecutive_scale_downs": n,
+                "required_consecutive": required,
+            }
+
         try:
             model = self.store.get(mt.KIND_MODEL, model_name, self.namespace)
         except NotFound:
-            return
+            return decision(False, "model_not_found")
         s = model.spec
         clamped = max(desired, s.min_replicas)
         if s.max_replicas is not None:
             clamped = min(clamped, s.max_replicas)
         current = s.replicas or 0
 
+        n = required = None
         if clamped < current:
             # Check-then-increment (ref: scale.go:56-66): the scale-down
             # fires on the (required+1)th consecutive decision and keeps
@@ -77,12 +94,18 @@ class ModelClient:
                 required = self._required_consecutive(model)
                 if n < required:
                     self._consecutive_scale_downs[model_name] = n + 1
-                    return
+                    return decision(
+                        False, "scale_down_deferred",
+                        clamped=clamped, current=current,
+                        n=n + 1, required=required,
+                    )
         else:
             with self._lock:
                 self._consecutive_scale_downs[model_name] = 0
             if clamped == current:
-                return
+                return decision(
+                    False, "no_change", clamped=clamped, current=current
+                )
 
         def mutate(m):
             m.spec.replicas = clamped
@@ -90,4 +113,10 @@ class ModelClient:
         try:
             self.store.mutate(mt.KIND_MODEL, model_name, mutate, self.namespace)
         except NotFound:
-            pass
+            return decision(False, "model_not_found", clamped=clamped, current=current)
+        return decision(
+            True,
+            "scaled_down" if clamped < current else "scaled_up",
+            clamped=clamped, current=current, replicas=clamped,
+            n=n, required=required,
+        )

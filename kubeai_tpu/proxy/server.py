@@ -13,6 +13,7 @@ import logging
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
 
 import time
 
@@ -53,6 +54,18 @@ class OpenAIServer:
         self._inflight_lock = threading.Lock()
         self._stop_lock = threading.Lock()
         self._stopped = False
+        # Capacity-observability attachments (wired by the manager):
+        # the autoscaler's DecisionLog (/debug/autoscaler), the fleet
+        # scrape collector (/debug/fleet), and the SLOMonitor
+        # (/debug/slo). Any left None 404s its route.
+        self.decision_log = None
+        self.fleet = None
+        self.slo = None
+        # Leader election handle: the autoscaler only ticks on the
+        # lease holder, so /debug/autoscaler marks follower replicas'
+        # (empty) logs as inactive instead of reading like "the
+        # autoscaler never ran".
+        self.election = None
 
     def start(self):
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -186,6 +199,54 @@ def _make_handler(srv: OpenAIServer):
             elif path == "/debug/endpoints":
                 # Passive-health visibility: per-model breaker states.
                 self._json(200, {"models": srv.proxy.lb.breaker_snapshot()})
+            elif path == "/debug/autoscaler":
+                # Scaling decision audit: why the autoscaler did what it
+                # did, one record per tick per model.
+                if srv.decision_log is None:
+                    return self._json(
+                        404, {"error": {"message": "no autoscaler attached"}}
+                    )
+                q = parse_qs(query or "")
+                try:
+                    limit = int(q["limit"][0])
+                except (KeyError, ValueError, IndexError):
+                    limit = 100
+                if limit <= 0:  # 0/negative: not "everything", the default page
+                    limit = 100
+                model = (q.get("model") or [None])[0]
+                self._json(
+                    200,
+                    {
+                        # False = this replica's autoscaler is leader-
+                        # gated and idle; the lease holder has the log.
+                        "active": (
+                            srv.election is None
+                            or srv.election.is_leader.is_set()
+                        ),
+                        "decisions": srv.decision_log.snapshot(
+                            limit=limit, model=model
+                        ),
+                    },
+                )
+            elif path == "/debug/fleet":
+                # Fleet saturation: per-endpoint scrapes + per-model
+                # aggregates/headroom, reusing the autoscaler tick's
+                # scrape when fresh.
+                if srv.fleet is None:
+                    return self._json(
+                        404, {"error": {"message": "no fleet collector attached"}}
+                    )
+                try:
+                    models = [m.meta.name for m in srv.model_client.list_all_models()]
+                    self._json(200, srv.fleet.debug_view(models))
+                except Exception as e:
+                    self._json(500, {"error": {"message": str(e)[:300]}})
+            elif path == "/debug/slo":
+                if srv.slo is None:
+                    return self._json(
+                        404, {"error": {"message": "no SLO monitor attached"}}
+                    )
+                self._json(200, srv.slo.report())
             elif path.startswith("/debug/"):
                 resp = handle_faults_request(path, query) or handle_debug_request(path, query)
                 if resp is None:

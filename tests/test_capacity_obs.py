@@ -1,0 +1,910 @@
+"""Capacity observability plane: autoscaler decision audit
+(/debug/autoscaler), the shared fleet scrape collector (/debug/fleet),
+the SLO monitor (/debug/slo), callback gauges, and the engine's
+saturation/goodput metrics."""
+
+from __future__ import annotations
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler, M_SCRAPE_FAILURES
+from kubeai_tpu.autoscaler.fleet import FleetCollector
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.metrics.registry import Registry
+from kubeai_tpu.obs.slo import (
+    SLObjective,
+    SLOMonitor,
+    attainment_block,
+    burn_rate,
+    error_rate_block,
+)
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.runtime.store import Store
+from tests.test_autoscaler import AlwaysLeader, FakeLB, FakeMetricsPeer, mk_model
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+def mk_audited_autoscaler(store, peers, window=1, required=1, clock=None, fleet=None):
+    mc = ModelClient(store, required_consecutive_scale_downs=lambda m: required)
+    asc = Autoscaler(
+        store, mc, FakeLB(), AlwaysLeader,
+        interval_seconds=0.05,
+        average_window_count=window,
+        fixed_self_metric_addrs=peers or [],
+        clock=clock or FakeClock(),
+        fleet=fleet,
+    )
+    return asc, mc
+
+
+def active_text(model: str, n: float) -> str:
+    return f'kubeai_inference_requests_active{{request_model="{model}"}} {n}\n'
+
+
+# ---------------------------------------------------------------------------
+# Decision audit
+
+
+class TestDecisionAudit:
+    def test_load_ramp_one_record_per_tick_matching_store(self):
+        """The acceptance criterion: after a simulated load ramp, one
+        decision record per tick per model whose applied replica count
+        matches the model store."""
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("m1", target_requests=2))
+        store.create(mt.KIND_MODEL, mk_model("m2", target_requests=1))
+        peer = FakeMetricsPeer("")
+        clock = FakeClock()
+        try:
+            asc, _ = mk_audited_autoscaler(store, [peer.addr], clock=clock)
+            ramp = [2.0, 6.0, 10.0]
+            for step, n in enumerate(ramp):
+                peer.text = active_text("m1", n) + active_text("m2", n)
+                clock.advance(10)
+                asc.tick()
+                for name, target in (("m1", 2), ("m2", 1)):
+                    recs = asc.decisions.snapshot(model=name)
+                    assert len(recs) == step + 1, "one record per tick per model"
+                    rec = recs[0]  # most recent first
+                    in_store = store.get(mt.KIND_MODEL, name).spec.replicas
+                    assert rec["applied_replicas"] == in_store
+                    assert rec["signal"]["proxy"] == n
+                    assert rec["signal"]["combined"] == n
+                    assert rec["desired"] == -(-int(n) // target)  # ceil
+                    assert rec["t"] == clock.t
+                    assert rec["scrape_failures"] == {"peers": [], "engines": []}
+            # The ramp scaled up every tick: reasons say so.
+            assert all(
+                r["reason"] == "scaled_up" for r in asc.decisions.snapshot(model="m1")
+            )
+        finally:
+            peer.stop()
+
+    def test_clamp_to_max_recorded(self):
+        store = Store()
+        store.create(
+            mt.KIND_MODEL, mk_model("m1", target_requests=1, max_replicas=2)
+        )
+        peer = FakeMetricsPeer(active_text("m1", 10))
+        try:
+            asc, _ = mk_audited_autoscaler(store, [peer.addr])
+            asc.tick()
+            rec = asc.decisions.snapshot(model="m1")[0]
+            assert rec["desired"] == 10
+            assert rec["clamped"] == 2
+            assert rec["applied"] is True
+            assert rec["applied_replicas"] == 2
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 2
+        finally:
+            peer.stop()
+
+    def test_scale_down_deferred_reason_and_counts(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("m1", replicas=2))
+        peer = FakeMetricsPeer("")  # zero signal -> scale-down decision
+        try:
+            asc, _ = mk_audited_autoscaler(store, [peer.addr], required=2)
+            asc.tick()
+            rec = asc.decisions.snapshot(model="m1")[0]
+            assert rec["applied"] is False
+            assert rec["reason"] == "scale_down_deferred"
+            assert rec["consecutive_scale_downs"] == 1
+            assert rec["required_consecutive"] == 2
+            assert rec["applied_replicas"] == 2  # store untouched
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 2
+            asc.tick()
+            asc.tick()  # third consecutive decision fires
+            rec = asc.decisions.snapshot(model="m1")[0]
+            assert rec["applied"] is True and rec["reason"] == "scaled_down"
+            assert rec["applied_replicas"] == 0
+            assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 0
+        finally:
+            peer.stop()
+
+    def test_peer_scrape_failure_recorded_and_counted(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("m1"))
+        peer = FakeMetricsPeer(active_text("m1", 4))
+        dead = "127.0.0.1:1"
+        before = M_SCRAPE_FAILURES.value(labels={"scope": "peer"})
+        try:
+            asc, _ = mk_audited_autoscaler(store, [peer.addr, dead])
+            asc.tick()
+            rec = asc.decisions.snapshot(model="m1")[0]
+            assert rec["scrape_failures"]["peers"] == [dead]
+            assert M_SCRAPE_FAILURES.value(labels={"scope": "peer"}) == before + 1
+            # The good peer's signal still drove the decision.
+            assert rec["signal"]["proxy"] == 4.0
+        finally:
+            peer.stop()
+
+    def test_tick_metrics_exported(self):
+        from kubeai_tpu.autoscaler.autoscaler import M_DESIRED, M_SIGNAL, M_TICK
+
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("mx", target_requests=2))
+        peer = FakeMetricsPeer(active_text("mx", 6))
+        ticks_before = sum(n for _, (_, _, n) in M_TICK.snapshot().items())
+        try:
+            asc, _ = mk_audited_autoscaler(store, [peer.addr])
+            asc.engine_queue_scrape = lambda name: 2.0
+            asc.tick()
+            assert M_DESIRED.value(labels={"model": "mx"}) == 3
+            assert M_SIGNAL.value(labels={"model": "mx", "source": "proxy"}) == 6.0
+            assert M_SIGNAL.value(labels={"model": "mx", "source": "engine"}) == 2.0
+            assert M_SIGNAL.value(labels={"model": "mx", "source": "combined"}) == 6.0
+            assert sum(n for _, (_, _, n) in M_TICK.snapshot().items()) == ticks_before + 1
+            rec = asc.decisions.snapshot(model="mx")[0]
+            assert rec["signal"] == {"proxy": 6.0, "engine": 2.0, "combined": 6.0}
+        finally:
+            peer.stop()
+
+    def test_decision_log_bounded(self):
+        from kubeai_tpu.autoscaler.autoscaler import DecisionLog
+
+        log = DecisionLog(capacity=4)
+        for i in range(10):
+            log.append({"model": "m", "i": i})
+        recs = log.snapshot()
+        assert len(recs) == 4
+        assert recs[0]["i"] == 9  # most recent first
+        assert log.snapshot(limit=2)[1]["i"] == 8
+
+
+# ---------------------------------------------------------------------------
+# Fleet collector
+
+
+ENGINE_TEXT = """\
+kubeai_engine_queue_depth {q}
+kubeai_engine_active_slots {a}
+kubeai_engine_slots_total {st}
+kubeai_engine_kv_pages_used {pu}
+kubeai_engine_kv_pages_cached 1
+kubeai_engine_kv_pages_total {pt}
+kubeai_engine_generated_tokens_total {gt}
+"""
+
+
+class StubLB:
+    def __init__(self, addrs_by_model, breaker=None):
+        self.addrs = addrs_by_model
+        self.breaker = breaker or {}
+
+    def get_all_addresses(self, model):
+        return list(self.addrs.get(model, []))
+
+    def breaker_snapshot(self):
+        return self.breaker
+
+
+class TestFleetCollector:
+    def mk(self, texts: dict[str, str], clock=None):
+        lb = StubLB({"m1": list(texts)})
+
+        def fetch(addr):
+            body = texts[addr]
+            if body is None:
+                raise ConnectionError("dead endpoint")
+            return body
+
+        return FleetCollector(lb, clock=clock or FakeClock(), fetch=fetch)
+
+    def test_aggregate_equals_endpoint_sums(self):
+        texts = {
+            "a:1": ENGINE_TEXT.format(q=3, a=2, st=8, pu=10, pt=100, gt=500),
+            "b:1": ENGINE_TEXT.format(q=1, a=4, st=8, pu=30, pt=100, gt=900),
+        }
+        col = self.mk(texts)
+        view = col.collect(["m1"])["m1"]
+        agg = view["aggregate"]
+        for key in ("queue_depth", "active_slots", "pages_used", "pages_total"):
+            assert agg[key] == sum(e[key] for e in view["endpoints"])
+        assert agg["queue_depth"] == 4 and agg["active_slots"] == 6
+        assert agg["free_pages"] == 160
+        assert agg["load"] == 10
+        # Headroom: 10 free slots, pages_per_req = 40/6 -> pages allow
+        # 160/(40/6) = 24 more; slots bind at 10.
+        assert agg["headroom_requests"] == 10
+
+    def test_headroom_page_bound(self):
+        texts = {"a:1": ENGINE_TEXT.format(q=0, a=2, st=8, pu=40, pt=50, gt=0)}
+        col = self.mk(texts)
+        agg = col.collect(["m1"])["m1"]["aggregate"]
+        # 6 free slots but only 10 free pages at 20 pages/request -> 0.5.
+        assert agg["headroom_requests"] == 0.5
+
+    def test_tokens_per_second_from_counter_delta(self):
+        clock = FakeClock()
+        texts = {"a:1": ENGINE_TEXT.format(q=0, a=1, st=8, pu=5, pt=100, gt=100)}
+        col = self.mk(texts, clock=clock)
+        col.collect(["m1"])
+        texts["a:1"] = ENGINE_TEXT.format(q=0, a=1, st=8, pu=5, pt=100, gt=400)
+        clock.advance(10)
+        agg = col.collect(["m1"])["m1"]["aggregate"]
+        assert agg["tokens_per_second"] == 30.0
+
+    def test_dead_endpoint_reported_not_fatal(self):
+        before = M_SCRAPE_FAILURES.value(labels={"scope": "engine"})
+        texts = {
+            "a:1": ENGINE_TEXT.format(q=2, a=1, st=8, pu=5, pt=100, gt=0),
+            "dead:1": None,
+        }
+        col = self.mk(texts)
+        view = col.collect(["m1"])["m1"]
+        bad = [e for e in view["endpoints"] if not e["ok"]]
+        assert [e["address"] for e in bad] == ["dead:1"]
+        assert view["aggregate"]["failed_endpoints"] == 1
+        assert view["aggregate"]["load"] == 3  # healthy endpoint still counted
+        assert M_SCRAPE_FAILURES.value(labels={"scope": "engine"}) == before + 1
+
+    def test_breaker_state_merged(self):
+        texts = {"a:1": ENGINE_TEXT.format(q=0, a=0, st=8, pu=0, pt=100, gt=0)}
+        lb = StubLB(
+            {"m1": ["a:1"]},
+            breaker={"m1": [{"address": "a:1", "state": "open"}]},
+        )
+        col = FleetCollector(lb, clock=FakeClock(), fetch=lambda addr: texts[addr])
+        view = col.collect(["m1"])["m1"]
+        assert view["endpoints"][0]["breaker_state"] == "open"
+
+    def test_departed_endpoint_state_pruned_after_ttl(self):
+        """Pod churn must not grow per-addr state (tokens baselines,
+        parsed SLO pages) without bound: entries age out once no collect
+        targets the address within the TTL."""
+        clock = FakeClock()
+        texts = {
+            "a:1": ENGINE_TEXT.format(q=0, a=0, st=8, pu=0, pt=100, gt=5),
+            "b:1": ENGINE_TEXT.format(q=0, a=0, st=8, pu=0, pt=100, gt=5),
+        }
+        lb = StubLB({"m1": ["a:1"]})
+        col = FleetCollector(lb, clock=clock, fetch=lambda addr: texts[addr])
+        col.collect(["m1"])
+        assert "a:1" in col._prev_tokens and len(col.parsed_pages()) == 1
+        lb.addrs["m1"] = ["b:1"]  # pod replaced; old addr gone silently
+        clock.advance(col.addr_ttl + 1)
+        col.collect(["m1"])
+        assert "a:1" not in col._prev_tokens
+        assert "a:1" not in col._last_pages
+        assert len(col.parsed_pages()) == 1  # only the live endpoint
+
+    def test_fleet_gauges_set(self):
+        from kubeai_tpu.autoscaler.fleet import M_FLEET_ACTIVE, M_FLEET_TPS
+
+        texts = {"a:1": ENGINE_TEXT.format(q=1, a=5, st=8, pu=5, pt=100, gt=0)}
+        col = self.mk(texts)
+        col.collect(["m1"])
+        assert M_FLEET_ACTIVE.value(labels={"model": "m1"}) == 5.0
+        assert M_FLEET_TPS.value(labels={"model": "m1"}) == 0.0
+
+    def test_tick_cache_covers_disabled_models_no_debug_rescrape(self):
+        """/debug/fleet between ticks must serve the tick's cached
+        scrape — including autoscaling-disabled models — instead of
+        re-scraping every engine endpoint on the HTTP handler thread."""
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("m1", target_requests=1))
+        store.create(mt.KIND_MODEL, mk_model("m2", autoscaling_disabled=True))
+        texts = {
+            "a:1": ENGINE_TEXT.format(q=1, a=1, st=8, pu=5, pt=100, gt=0),
+            "b:1": ENGINE_TEXT.format(q=2, a=0, st=8, pu=0, pt=100, gt=0),
+        }
+        fetches = []
+
+        def fetch(addr):
+            fetches.append(addr)
+            return texts[addr]
+
+        lb = StubLB({"m1": ["a:1"], "m2": ["b:1"]})
+        clock = FakeClock()
+        col = FleetCollector(lb, clock=clock, fetch=fetch, default_max_age=15.0)
+        asc, _ = mk_audited_autoscaler(store, peers=["127.0.0.1:1"], fleet=col)
+        asc.tick()
+        assert sorted(fetches) == ["a:1", "b:1"]  # disabled model scraped too
+        clock.advance(9)  # less than a 10s tick later, dashboard polls
+        view = col.debug_view(["m1", "m2"])
+        assert fetches == sorted(fetches) and len(fetches) == 2  # cache hit
+        assert view["models"]["m2"]["aggregate"]["queue_depth"] == 2
+        clock.advance(10)  # cache older than max_age -> re-collect
+        col.debug_view(["m1", "m2"])
+        assert len(fetches) == 4
+
+    def test_debug_view_single_flight_on_stale_cache(self):
+        """Concurrent /debug/fleet GETs hitting a stale cache must
+        coalesce into ONE fleet scrape, not one each."""
+        import threading
+
+        fetches = []
+        gate = threading.Event()
+
+        def fetch(addr):
+            fetches.append(addr)
+            gate.wait(2)  # hold the first collect open
+            return ENGINE_TEXT.format(q=0, a=0, st=8, pu=0, pt=100, gt=0)
+
+        lb = StubLB({"m1": ["a:1"]})
+        col = FleetCollector(lb, clock=FakeClock(), fetch=fetch)
+        results = []
+        threads = [
+            threading.Thread(
+                target=lambda: results.append(col.debug_view(["m1"]))
+            )
+            for _ in range(4)
+        ]
+        for t in threads:
+            t.start()
+        gate.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert len(results) == 4
+        assert len(fetches) == 1, f"{len(fetches)} scrapes for 4 concurrent GETs"
+
+    def test_shared_pool_grows_to_largest_request(self):
+        from kubeai_tpu.autoscaler.fleet import shared_scrape_executor
+
+        ex = shared_scrape_executor(2)
+        n_before = ex._n_workers
+        ex2 = shared_scrape_executor(n_before + 3)
+        assert ex2 is ex
+        assert ex._n_workers == n_before + 3
+        assert shared_scrape_executor(1)._n_workers == n_before + 3  # never shrinks
+
+    def test_autoscaler_consumes_fleet_signal(self):
+        """The collector IS the engine-side signal: one collect per tick
+        feeds both the decision and the cached /debug/fleet view."""
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("m1", target_requests=1))
+        texts = {"a:1": ENGINE_TEXT.format(q=3, a=2, st=8, pu=5, pt=100, gt=0)}
+        lb = StubLB({"m1": ["a:1"]})
+        col = FleetCollector(lb, clock=FakeClock(), fetch=lambda addr: texts[addr])
+        asc, _ = mk_audited_autoscaler(store, peers=None, fleet=col)
+        # No peers configured -> proxy signal comes from our own
+        # registry; the engine-side fleet signal (5) must dominate.
+        asc.fixed_addrs = ["127.0.0.1:1"]  # dead peer: proxy signal 0
+        asc.tick()
+        rec = asc.decisions.snapshot(model="m1")[0]
+        assert rec["signal"]["engine"] == 5.0
+        assert rec["signal"]["combined"] == 5.0
+        assert store.get(mt.KIND_MODEL, "m1").spec.replicas == 5
+        # The tick's collect is cached for the debug plane (no re-fetch).
+        view = col.debug_view(["m1"], max_age=1e9)
+        assert view["models"]["m1"]["aggregate"]["load"] == 5.0
+
+
+# ---------------------------------------------------------------------------
+# /debug/{fleet,autoscaler,slo} over HTTP (operator server e2e)
+
+
+class TestOperatorDebugEndpoints:
+    @pytest.fixture()
+    def api(self):
+        import types
+
+        from kubeai_tpu.proxy.server import OpenAIServer
+
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_model("m1", target_requests=1))
+        mc = ModelClient(store)
+        peers = [
+            FakeMetricsPeer(ENGINE_TEXT.format(q=2, a=1, st=4, pu=3, pt=50, gt=10)),
+            FakeMetricsPeer(ENGINE_TEXT.format(q=1, a=3, st=4, pu=7, pt=50, gt=20)),
+        ]
+        lb = StubLB({"m1": [p.addr for p in peers]})
+        srv = OpenAIServer(
+            types.SimpleNamespace(lb=lb), mc, host="127.0.0.1", port=0
+        )
+        fleet = FleetCollector(lb)
+        asc = Autoscaler(
+            store, ModelClient(store), lb, AlwaysLeader,
+            fixed_self_metric_addrs=["127.0.0.1:1"],  # dead peer
+            average_window_count=1, fleet=fleet,
+        )
+        slo = SLOMonitor(interval_seconds=3600)
+        slo.tick()
+        srv.fleet = fleet
+        srv.decision_log = asc.decisions
+        srv.slo = slo
+        srv.start()
+        yield srv, asc, peers, store
+        srv.stop()
+        for p in peers:
+            p.stop()
+
+    def get(self, srv, path):
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}{path}", timeout=10
+        ) as resp:
+            return json.loads(resp.read())
+
+    def test_fleet_aggregate_equals_per_endpoint_scrapes(self, api):
+        srv, asc, peers, _ = api
+        asc.tick()  # warms the collector cache
+        doc = self.get(srv, "/debug/fleet")
+        view = doc["models"]["m1"]
+        assert len(view["endpoints"]) == 2
+        for key in ("queue_depth", "active_slots", "pages_used", "slots_total"):
+            assert view["aggregate"][key] == sum(e[key] for e in view["endpoints"])
+        assert view["aggregate"]["queue_depth"] == 3
+        assert view["aggregate"]["active_slots"] == 4
+
+    def test_autoscaler_audit_served(self, api):
+        srv, asc, _, store = api
+        asc.tick()
+        asc.tick()
+        doc = self.get(srv, "/debug/autoscaler?limit=1&model=m1")
+        assert len(doc["decisions"]) == 1
+        rec = doc["decisions"][0]
+        assert rec["model"] == "m1"
+        assert rec["applied_replicas"] == store.get(mt.KIND_MODEL, "m1").spec.replicas
+        assert rec["scrape_failures"]["peers"] == ["127.0.0.1:1"]
+        assert rec["signal"]["engine"] == 7.0  # (2+1) + (1+3)
+
+    def test_slo_report_served(self, api):
+        srv, *_ = api
+        doc = self.get(srv, "/debug/slo")
+        names = [o["name"] for o in doc["objectives"]]
+        assert names == ["ttft", "e2e", "error_rate"]
+
+    def test_unwired_routes_404(self):
+        import types
+
+        from kubeai_tpu.proxy.server import OpenAIServer
+
+        srv = OpenAIServer(
+            types.SimpleNamespace(lb=StubLB({})), ModelClient(Store()),
+            host="127.0.0.1", port=0,
+        )
+        srv.start()
+        try:
+            for path in ("/debug/autoscaler", "/debug/fleet", "/debug/slo"):
+                with pytest.raises(urllib.error.HTTPError) as e:
+                    self.get(srv, path)
+                assert e.value.code == 404
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# SLO monitor
+
+
+class TestSLOMonitor:
+    def mk(self, clock, window=100.0):
+        reg = Registry()
+        hist = reg.histogram("kubeai_test_latency_seconds", "test latency")
+        ctr = reg.counter("kubeai_test_requests_total", "test outcomes")
+        objectives = [
+            SLObjective(
+                name="lat", kind="latency", metric="kubeai_test_latency_seconds",
+                threshold_s=0.5, target=0.9,
+            ),
+            SLObjective(
+                name="err", kind="error", metric="kubeai_test_requests_total",
+                target=0.99,
+            ),
+        ]
+        mon = SLOMonitor(
+            objectives=objectives, registry=reg,
+            window_seconds=window, clock=clock,
+        )
+        return mon, hist, ctr
+
+    def test_attainment_and_burn_over_window(self):
+        clock = FakeClock()
+        mon, hist, ctr = self.mk(clock)
+        mon.tick()  # baseline
+        for _ in range(9):
+            hist.observe(0.1)
+        hist.observe(5.0)  # one violation
+        for _ in range(99):
+            ctr.inc(labels={"outcome": "ok"})
+        ctr.inc(labels={"outcome": "error"})
+        clock.advance(10)
+        mon.tick()
+        rep = {o["name"]: o for o in mon.report()["objectives"]}
+        assert rep["lat"]["requests"] == 10
+        assert rep["lat"]["attainment"] == 0.9
+        assert rep["lat"]["burn_rate"] == pytest.approx(1.0)
+        assert rep["lat"]["effective_threshold_s"] == 0.5  # exact bucket
+        assert rep["err"]["requests"] == 100
+        assert rep["err"]["attainment"] == 0.99
+        assert rep["err"]["burn_rate"] == pytest.approx(1.0)
+
+    def test_threshold_rounds_to_bucket(self):
+        clock = FakeClock()
+        mon, hist, _ = self.mk(clock)
+        mon.objectives[0] = SLObjective(
+            name="lat", kind="latency", metric="kubeai_test_latency_seconds",
+            threshold_s=0.3, target=0.9,  # between the 0.25 and 0.5 buckets
+        )
+        mon.tick()
+        hist.observe(0.4)  # inside the effective 0.5 bucket
+        clock.advance(1)
+        mon.tick()
+        rep = {o["name"]: o for o in mon.report()["objectives"]}
+        assert rep["lat"]["effective_threshold_s"] == 0.5
+        assert rep["lat"]["attainment"] == 1.0
+
+    def test_window_eviction_forgets_old_violations(self):
+        clock = FakeClock()
+        mon, hist, _ = self.mk(clock, window=50.0)
+        mon.tick()
+        hist.observe(9.0)  # violation now...
+        clock.advance(10)
+        mon.tick()
+        assert {o["name"]: o for o in mon.report()["objectives"]}["lat"][
+            "attainment"
+        ] == 0.0
+        # ...rolls out of the window with clean traffic after it.
+        for _ in range(6):
+            clock.advance(10)
+            mon.tick()
+        rep = {o["name"]: o for o in mon.report()["objectives"]}
+        assert rep["lat"]["requests"] == 0
+        assert rep["lat"]["attainment"] == 1.0
+
+    def test_no_traffic_is_vacuously_attained(self):
+        clock = FakeClock()
+        mon, _, _ = self.mk(clock)
+        mon.tick()
+        rep = {o["name"]: o for o in mon.report()["objectives"]}
+        assert rep["lat"]["attainment"] == 1.0
+        assert rep["lat"]["burn_rate"] == 0.0
+
+    def test_gauges_exported(self):
+        from kubeai_tpu.obs.slo import M_ATTAIN, M_BURN, M_WINDOW_REQS
+
+        clock = FakeClock()
+        mon, hist, _ = self.mk(clock)
+        mon.tick()
+        hist.observe(9.0)
+        clock.advance(5)
+        mon.tick()
+        assert M_ATTAIN.value(labels={"slo": "lat"}) == 0.0
+        assert M_BURN.value(labels={"slo": "lat"}) == pytest.approx(10.0)
+        assert M_WINDOW_REQS.value(labels={"slo": "lat"}) == 1.0
+
+    def test_threshold_beyond_buckets_clamps_not_vacuous(self):
+        """An objective past the largest finite bucket must NOT count
+        the +Inf overflow as good (that would pin attainment at 1.0 no
+        matter how slow requests get): it clamps down, conservatively."""
+        clock = FakeClock()
+        mon, hist, _ = self.mk(clock)
+        mon.objectives[0] = SLObjective(
+            name="lat", kind="latency", metric="kubeai_test_latency_seconds",
+            threshold_s=100.0, target=0.9,  # default buckets top out at 10
+        )
+        mon.tick()
+        hist.observe(50.0)  # would satisfy 100s, but lands in +Inf
+        clock.advance(1)
+        mon.tick()
+        rep = {o["name"]: o for o in mon.report()["objectives"]}
+        assert rep["lat"]["effective_threshold_s"] == 10
+        assert rep["lat"]["attainment"] == 0.0  # counted bad, visibly
+
+    def test_remote_pages_feed_operator_side_objectives(self):
+        """The operator process has no engine histograms: the monitor
+        must see them through the fleet collector's parsed scrapes."""
+        # Render a realistic engine page from a throwaway registry.
+        from kubeai_tpu.metrics.registry import parse_prometheus_text
+
+        eng_reg = Registry()
+        h = eng_reg.histogram("kubeai_test_latency_seconds", "remote ttft")
+        c = eng_reg.counter("kubeai_test_requests_total", "remote outcomes")
+        for _ in range(9):
+            h.observe(0.1)
+        h.observe(5.0)
+        c.inc(9, labels={"outcome": "ok"})
+        c.inc(1, labels={"outcome": "error"})
+        pages = [parse_prometheus_text(eng_reg.render())]
+
+        clock = FakeClock()
+        objectives = [
+            SLObjective(
+                name="lat", kind="latency", metric="kubeai_test_latency_seconds",
+                threshold_s=0.5, target=0.9,
+            ),
+            SLObjective(
+                name="err", kind="error", metric="kubeai_test_requests_total",
+                target=0.9,
+            ),
+        ]
+        mon = SLOMonitor(
+            objectives=objectives, registry=Registry(),  # EMPTY local registry
+            window_seconds=100.0, clock=clock, remote_pages=lambda: pages,
+        )
+        mon.tick()  # baseline
+        for _ in range(10):
+            h.observe(0.1)
+        c.inc(10, labels={"outcome": "ok"})
+        pages[0] = parse_prometheus_text(eng_reg.render())
+        clock.advance(10)
+        mon.tick()
+        rep = {o["name"]: o for o in mon.report()["objectives"]}
+        assert rep["lat"]["requests"] == 10
+        assert rep["lat"]["attainment"] == 1.0  # the window's new traffic is clean
+        assert rep["lat"]["effective_threshold_s"] == 0.5
+        assert rep["err"]["requests"] == 10
+        assert rep["err"]["attainment"] == 1.0
+
+    def test_remote_endpoint_restart_clamps_to_zero(self):
+        """A restarted engine pod resets its counters: the negative
+        window delta must read as a dip, not as garbage attainment."""
+        from kubeai_tpu.metrics.registry import parse_prometheus_text
+
+        eng_reg = Registry()
+        h = eng_reg.histogram("kubeai_test_latency_seconds", "remote ttft")
+        for _ in range(100):
+            h.observe(0.1)
+        pages = [parse_prometheus_text(eng_reg.render())]
+        clock = FakeClock()
+        mon = SLOMonitor(
+            objectives=[SLObjective(
+                name="lat", kind="latency", metric="kubeai_test_latency_seconds",
+                threshold_s=0.5, target=0.9,
+            )],
+            registry=Registry(), window_seconds=100.0, clock=clock,
+            remote_pages=lambda: pages,
+        )
+        mon.tick()
+        pages[0] = parse_prometheus_text(Registry().render())  # pod restarted
+        clock.advance(10)
+        mon.tick()
+        rep = mon.report()["objectives"][0]
+        assert rep["requests"] == 0
+        assert rep["attainment"] == 1.0
+
+    def test_non_leader_reports_inactive(self):
+        """HA: only the leader's fleet collector scrapes, so a follower
+        must advertise itself as gated instead of computing vacuous
+        numbers (its loop skips ticks entirely)."""
+        import threading
+
+        class Follower:
+            is_leader = threading.Event()  # never set
+
+        clock = FakeClock()
+        mon, _, _ = self.mk(clock)
+        mon._election = Follower()
+        assert mon.report()["active"] is False
+        Follower.is_leader.set()
+        assert mon.report()["active"] is True
+        mon._election = None  # unwired (single replica): always active
+        assert mon.report()["active"] is True
+
+    def test_latency_objective_counts_errored_outcomes_as_bad(self):
+        """A request that errored in 0.2s must VIOLATE the latency
+        objective, not satisfy it — otherwise a fast-failing outage
+        reads as perfect e2e attainment."""
+        from kubeai_tpu.metrics.registry import parse_prometheus_text
+        from kubeai_tpu.obs.slo import _page_cumulative
+
+        reg = Registry()
+        hist = reg.histogram("kubeai_test_e2e_seconds", "outcome-labeled e2e")
+        obj = SLObjective(
+            name="e2e", kind="latency", metric="kubeai_test_e2e_seconds",
+            threshold_s=0.5, target=0.9, good_label=("outcome", "ok"),
+        )
+        clock = FakeClock()
+        mon = SLOMonitor(
+            objectives=[obj], registry=reg, window_seconds=100.0, clock=clock
+        )
+        mon.tick()
+        hist.observe(0.1, labels={"outcome": "ok"})
+        hist.observe(0.1, labels={"outcome": "error"})  # fast failure
+        clock.advance(10)
+        mon.tick()
+        rep = mon.report()["objectives"][0]
+        assert rep["requests"] == 2
+        assert rep["attainment"] == 0.5  # the errored request counts bad
+        # Same rule through the remote-page path.
+        good, total, _ = _page_cumulative(parse_prometheus_text(reg.render()), obj)
+        assert (good, total) == (1.0, 2.0)
+
+    def test_leadership_takeover_restarts_window(self):
+        """A follower promoted to leader must not difference the
+        engines' all-time history against its stale construction-time
+        baseline: the window restarts at takeover."""
+        import threading
+
+        class Lease:
+            is_leader = threading.Event()
+
+        clock = FakeClock()
+        mon, hist, _ = self.mk(clock)
+        mon._election = Lease()
+        # History accrues in the engines while this replica follows.
+        for _ in range(50):
+            hist.observe(9.0)  # all violations, hours old
+        clock.advance(3600)
+        mon._gated_tick()  # follower: skipped entirely
+        assert mon.report()["objectives"][0].get("pending") is True
+        Lease.is_leader.set()
+        mon._gated_tick()  # takeover: window restarts (baseline only)
+        rep = mon.report()["objectives"][0]
+        assert rep["requests"] == 0  # old violations NOT in the window
+        hist.observe(0.1)
+        clock.advance(10)
+        mon._gated_tick()
+        rep = mon.report()["objectives"][0]
+        assert rep["requests"] == 1 and rep["attainment"] == 1.0
+
+    def test_helper_blocks(self):
+        blk = attainment_block([0.1, 0.2, 3.0, 0.3], 0.5, 0.9)
+        assert blk["requests"] == 4
+        assert blk["attainment"] == 0.75
+        assert blk["burn_rate"] == pytest.approx(2.5)
+        assert attainment_block([], 0.5, 0.9)["attainment"] == 1.0
+        # Requests that produced no sample (errored) count as violations.
+        blk = attainment_block([0.1], 0.5, 0.9, failures=1)
+        assert blk["requests"] == 2 and blk["attainment"] == 0.5
+        err = error_rate_block(1, 200, 0.99)
+        assert err["attainment"] == 0.995
+        assert err["burn_rate"] == pytest.approx(0.5)
+        assert burn_rate(1.0, 1.0) == 0.0
+
+    def test_demotion_removes_gauge_series(self):
+        """A demoted leader's kubeai_slo_* series must disappear, not
+        freeze at the last led value next to the new leader's live one."""
+        import threading
+
+        from kubeai_tpu.obs.slo import M_ATTAIN
+
+        class Lease:
+            is_leader = threading.Event()
+
+        Lease.is_leader.set()
+        clock = FakeClock()
+        mon, hist, _ = self.mk(clock)
+        mon._election = Lease()
+        mon._gated_tick()  # leads: window starts
+        hist.observe(9.0)
+        clock.advance(10)
+        mon._gated_tick()
+        key = (("slo", "lat"),)
+        assert key in M_ATTAIN.snapshot()
+        Lease.is_leader.clear()
+        mon._gated_tick()  # demoted: series removed, report pending
+        assert key not in M_ATTAIN.snapshot()
+        assert mon.report()["objectives"][0].get("pending") is True
+        assert mon.report()["active"] is False
+
+
+# ---------------------------------------------------------------------------
+# Callback gauges
+
+
+class TestCallbackGauge:
+    def test_evaluated_at_collect_time(self):
+        reg = Registry()
+        box = {"v": 3.0}
+        reg.callback_gauge("kubeai_test_cb", "test callback", lambda: box["v"])
+        assert "kubeai_test_cb 3.0" in reg.render()
+        box["v"] = 7.5  # no .set() anywhere — cannot go stale
+        assert "kubeai_test_cb 7.5" in reg.render()
+
+    def test_reregistration_rebinds_latest_callback(self):
+        reg = Registry()
+        reg.callback_gauge("kubeai_test_cb2", "h", lambda: 1.0)
+        g = reg.callback_gauge("kubeai_test_cb2", "h", lambda: 2.0)
+        assert g.value() == 2.0
+        assert "kubeai_test_cb2 2.0" in reg.render()
+
+    def test_failing_callback_does_not_break_render(self):
+        reg = Registry()
+        reg.callback_gauge(
+            "kubeai_test_cb3", "h", lambda: (_ for _ in ()).throw(RuntimeError())
+        )
+        reg.gauge("kubeai_test_other", "h").set(1.0)
+        out = reg.render()
+        assert "kubeai_test_other 1.0" in out
+        assert "# TYPE kubeai_test_cb3 gauge" in out  # header survives
+
+
+# ---------------------------------------------------------------------------
+# Engine saturation metrics (tiny CPU engine)
+
+
+class TestEngineSaturation:
+    def test_saturation_metrics_from_generate(self):
+        from kubeai_tpu.engine.core import build_test_engine
+        from kubeai_tpu.engine.sampling import SamplingParams
+
+        eng = build_test_engine()
+        step_before = {
+            k: n for k, (_, _, n) in eng.m_step.snapshot().items()
+        }
+        active_before = eng.m_slot_steps.value(labels={"state": "active"})
+        pad_before = eng.m_pad_prefill.value()
+        eng.start()
+        try:
+            ids, text, info = eng.generate(
+                list(b"hello there"), SamplingParams(temperature=0.0, max_tokens=8),
+                timeout=120,
+            )
+            assert info.completion_tokens > 0
+            # Decode steps + prefill were timed per phase.
+            steps = {k: n for k, (_, _, n) in eng.m_step.snapshot().items()}
+            decode_key = (("phase", "decode_chunk"),)
+            assert steps.get(decode_key, 0) > step_before.get(decode_key, 0)
+            assert any(
+                ("phase", "prefill_group") in k or ("phase", "prefill_chunked") in k
+                for k in steps
+            )
+            # Batch utilization: one active request on a 4-slot engine
+            # accrues both active and idle slot-steps.
+            assert eng.m_slot_steps.value(labels={"state": "active"}) > active_before
+            assert eng.m_slot_steps.value(labels={"state": "idle"}) > 0
+            # 11-token prompt pads to the 16 bucket: waste recorded.
+            assert eng.m_pad_prefill.value() >= pad_before + 5
+            # Slot capacity is scrape-visible (the fleet headroom input).
+            assert eng.m_slots_total.value() == eng.cfg.max_slots
+            # Compilations were observed (warmup compiles count).
+            assert eng.m_recompiles.value() >= 1
+        finally:
+            eng.stop()
+
+    def test_stop_unbinds_callback_gauges_without_clobbering_newer(self):
+        """A stopped engine must release its registry references (the
+        global registry would otherwise pin its KV pool for process
+        life) — but only where it is still the current owner."""
+        from kubeai_tpu.engine.core import build_test_engine
+
+        eng_a = build_test_engine()
+        eng_b = build_test_engine()  # re-registers: B now owns the gauges
+        eng_a.stop()
+        # A's stop must NOT have cleared B's binding (identity check).
+        assert eng_b.m_pages_used._fn is not None
+        assert eng_b.m_pages_used.value() == float(eng_b._pool.used())
+        eng_b.stop()
+        assert eng_b.m_pages_used._fn is None
+        assert eng_b.m_pages_used.value() == 0.0  # unbound reads 0, never stale
+
+    def test_occupancy_callback_gauges_track_pool_live(self):
+        from kubeai_tpu.engine.core import build_test_engine
+
+        eng = build_test_engine()
+        # No scheduler step has run — callback gauges still read the
+        # pool's truth at collect time (the staleness fix).
+        assert eng.m_pages_used.value() == eng._pool.used() == 0
+        assert eng.m_pages_total.value() == eng._pool.num_pages - 1
+        row = eng._pool.allocate(3)
+        assert eng.m_pages_used.value() == 3.0
+        rendered = default_registry.render()
+        assert "kubeai_engine_kv_pages_used 3.0" in rendered
+        eng._pool.release(row)
+        assert eng.m_pages_used.value() == 0.0

@@ -12,7 +12,7 @@ REPO = pathlib.Path(__file__).resolve().parent.parent
 PKG = REPO / "kubeai_tpu"
 DOC = REPO / "docs" / "observability.md"
 
-_KINDS = {"counter", "gauge", "histogram"}
+_KINDS = {"counter", "gauge", "histogram", "callback_gauge"}
 
 
 def _registration_calls():
