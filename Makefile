@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny dryrun loadgen-demo native clean charts images images-check fleet-snapshot
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos dryrun loadgen-demo native clean charts images images-check fleet-snapshot
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -12,6 +12,10 @@ test:
 test-fast:  ## skip the slow e2e/model-parity suites
 	$(PY) -m pytest tests/ -q --ignore=tests/test_e2e_local.py \
 	    --ignore=tests/test_e2e_chaos.py --ignore=tests/test_finetune.py
+
+chaos:  ## deterministic chaos + recovery suites (failpoints armed, fake clocks)
+	JAX_PLATFORMS=cpu KUBEAI_DEBUG_FAULTS=1 $(PY) -m pytest \
+	    tests/test_chaos.py tests/test_e2e_chaos.py -q
 
 bench:
 	$(PY) bench.py

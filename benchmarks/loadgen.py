@@ -162,6 +162,62 @@ def pct(values, p):
     return s[min(len(s) - 1, int(len(s) * p / 100))]
 
 
+def operator_base(url: str) -> str:
+    """http://host:8000/openai[/] -> http://host:8000 (the operator's
+    metrics/debug root)."""
+    u = url.rstrip("/")
+    return u[: -len("/openai")] if u.endswith("/openai") else u
+
+
+def scrape_retry_counters(base: str) -> dict[str, float] | None:
+    """kubeai_proxy_retries_total by reason from the operator's
+    /metrics, or None when the endpoint isn't an operator (plain
+    engines / third-party servers have no proxy retry layer)."""
+    from kubeai_tpu.metrics.registry import parse_prometheus_text
+
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return None
+    series = parse_prometheus_text(text).get("kubeai_proxy_retries_total", [])
+    return {labels.get("reason", ""): value for labels, value in series}
+
+
+def schedule_replica_kill(base: str, after_s: float) -> None:
+    """--kill-replica-at: *after_s* seconds into the run, pick one
+    serving endpoint from the operator's /debug/endpoints and arm
+    ``engine.stream=error:1`` on it over HTTP — its next streamed
+    response dies mid-stream exactly like a crashed replica, exercising
+    the proxy's mid-stream replay under live load. The target engine
+    process must run with KUBEAI_DEBUG_FAULTS=1 (fault arming over HTTP
+    is a kill switch and is 403 otherwise)."""
+
+    def run():
+        time.sleep(after_s)
+        try:
+            with urllib.request.urlopen(base + "/debug/endpoints", timeout=5) as resp:
+                models = json.load(resp)["models"]
+            addr = next(
+                ep["address"] for eps in models.values() for ep in eps
+            )
+            urllib.request.urlopen(
+                f"http://{addr}/debug/faults?set=engine.stream%3Derror%3A1",
+                timeout=5,
+            ).read()
+            print(
+                json.dumps({"kill_replica": {"endpoint": addr, "at_s": after_s}}),
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(
+                json.dumps({"kill_replica_failed": str(e)[:200]}),
+                file=sys.stderr,
+            )
+
+    threading.Thread(target=run, daemon=True, name="loadgen-kill").start()
+
+
 def run_benchmark(
     base_url: str,
     model: str,
@@ -178,9 +234,18 @@ def run_benchmark(
     slo_e2e_s: float = 30.0,
     slo_target: float = 0.95,
     slo_e2e_target: float = 0.99,
+    kill_replica_at: float | None = None,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
-    (benchmarks/routing_compare.py drives it per strategy)."""
+    (benchmarks/routing_compare.py drives it per strategy). With
+    *kill_replica_at*, one replica's streams are killed that many
+    seconds into the run and the summary gains a ``recovery`` block
+    (replayed/hedged/error-retried counts from the operator's proxy
+    counters over the run)."""
+    base = operator_base(base_url)
+    retries_before = scrape_retry_counters(base)
+    if kill_replica_at is not None:
+        schedule_replica_kill(base, kill_replica_at)
     rng = random.Random(seed)
     convo_turns: list[list[str]] = []
     for i in range(conversations):
@@ -223,9 +288,34 @@ def run_benchmark(
     failures = sum(s.failures for s in stats)
     n_requests = len(lats)
 
+    # Recovery visibility: how many requests the proxy replayed/hedged/
+    # failed over during this run (counter deltas over the operator's
+    # kubeai_proxy_retries_total; absent against non-operator targets).
+    recovery = None
+    if retries_before is not None:
+        after = scrape_retry_counters(base)
+        if after is not None:
+            # Deltas clamp at 0: an operator restart mid-run resets its
+            # counters, and a negative count is not a measurement.
+            def delta(reason):
+                return max(
+                    0, round(after.get(reason, 0.0) - retries_before.get(reason, 0.0))
+                )
+
+            recovery = {
+                "replayed": delta("replay"),
+                "hedged": delta("hedge"),
+                "error_retries": delta("error"),
+            }
+            if kill_replica_at is not None:
+                recovery["kill_replica_at_s"] = kill_replica_at
+        # End scrape failed: emit recovery: null rather than fabricating
+        # numbers from a missing sample.
+
     return {
         "requests": n_requests,
         "failures": failures,
+        "recovery": recovery,
         "elapsed_s": round(elapsed, 2),
         "req_per_s": round(n_requests / elapsed, 2) if elapsed else 0,
         "output_tok_per_s": round(total_tokens / elapsed, 2) if elapsed else 0,
@@ -284,6 +374,14 @@ def main():
     )
     parser.add_argument("--seed", type=int, default=0)
     parser.add_argument(
+        "--kill-replica-at", type=float, default=None, metavar="T",
+        help="T seconds into the run, kill one replica's streams "
+             "(arms engine.stream=error:1 on it via /debug/faults — the "
+             "engine must run KUBEAI_DEBUG_FAULTS=1) to exercise "
+             "mid-stream replay under load; the summary's recovery "
+             "block reports replayed/hedged counts",
+    )
+    parser.add_argument(
         "--slo-ttft-ms", type=float, default=2000.0,
         help="TTFT SLO objective (ms) for the emitted slo block",
     )
@@ -313,6 +411,7 @@ def main():
         slo_e2e_s=args.slo_e2e_ms / 1000.0,
         slo_target=args.slo_target,
         slo_e2e_target=args.slo_e2e_target,
+        kill_replica_at=args.kill_replica_at,
     )
     print(json.dumps(summary, indent=1))
 

@@ -63,7 +63,9 @@ log = logging.getLogger("kubeai_tpu.engine")
 
 class GangLost(ConnectionError):
     """A gang follower's dispatch connection failed — the gang's
-    collectives can never realign; serving from this rank is over."""
+    collectives cannot line up until the follower reconnects and the
+    gang re-forms (or, failing that within the supervision window,
+    the rank exits for the controller to recreate the slice)."""
 
 
 class GangDesync(RuntimeError):
@@ -262,6 +264,23 @@ class Engine:
         self._running = False
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
+        # Gang supervision: set while a follower is lost and the gang
+        # has not re-formed — is_ready() reads False so the balancer
+        # stops routing here, and the loop parks in _handle_gang_loss
+        # instead of dispatching into a dead stream.
+        self._gang_degraded = threading.Event()
+        # Adapter SOURCES (name -> original path) loaded on this rank:
+        # gang re-form must replay these to the reconnected follower —
+        # a restarted follower process has an empty adapter bank, and a
+        # LoRA dispatch it can't satisfy would kill it again
+        # (crash-loop). Survives _init_device_state like _adapters.
+        self._adapter_sources: dict[str, str] = {}
+        # Seconds rank 0 waits for a lost follower to reconnect before
+        # falling back to rank termination (the pre-recovery blast
+        # radius). <= 0 restores the old terminate-immediately behavior.
+        from kubeai_tpu.utils import env_float
+
+        self.gang_reform_timeout = env_float("KUBEAI_GANG_REFORM_TIMEOUT", 300.0)
 
         # Metrics (engine-side gauges the autoscaler can ingest).
         self.m_queue = default_registry.gauge(
@@ -391,6 +410,11 @@ class Engine:
         )
         self._jit_entries_seen = 0
         self._rate_window: deque[tuple[float, int]] = deque()
+        self.m_gang_reforms = default_registry.counter(
+            "kubeai_gang_reforms_total",
+            "gang re-formations: a lost follower reconnected and rank 0 "
+            "reset + resumed serving (vs the old fatal rank exit)",
+        )
         self.m_spec_drafted = default_registry.counter(
             "kubeai_engine_speculative_drafted_total", "draft tokens proposed"
         )
@@ -1238,6 +1262,7 @@ class Engine:
             # on this host means nothing on theirs).
             self._install_adapter(name, staged)
             self._bcast("load_adapter", scalars={"name": name, "path": path})
+            self._adapter_sources[name] = path
 
         if self._running:
             self._await_aux(self._submit_aux(do), what="adapter load")
@@ -1281,6 +1306,7 @@ class Engine:
             ok = self._adapters.unload(name)
             if ok:  # no-op unloads broadcast nothing (followers agree)
                 self._bcast("unload_adapter", scalars={"name": name})
+                self._adapter_sources.pop(name, None)
             return ok
 
         if self._running:
@@ -1335,10 +1361,21 @@ class Engine:
 
     def is_ready(self) -> bool:
         """Readiness (k8s probe seam): the scheduler loop is alive and
-        accepting submissions. Weights are resident by construction, so
-        a live loop is the whole signal."""
+        accepting submissions, and — on a gang — every follower rank is
+        connected (a degraded gang cannot decode; the balancer must
+        route to other replicas until the gang re-forms)."""
+        gang_complete = True
+        if self._publisher is not None:
+            # Monitor-detected loss (idle gang, EOF before any publish)
+            # must read not-ready immediately — the loop only learns at
+            # the next dispatch.
+            gang_complete = getattr(self._publisher, "is_complete", lambda: True)()
         return bool(
-            self._running and self._thread is not None and self._thread.is_alive()
+            self._running
+            and self._thread is not None
+            and self._thread.is_alive()
+            and not self._gang_degraded.is_set()
+            and gang_complete
         )
 
     def queue_depth(self) -> int:
@@ -1377,12 +1414,34 @@ class Engine:
         No scheduler, no HTTP inference surface — the LB only routes to
         rank 0 (loadbalancer gang awareness)."""
         self._ensure_embed_jit()
+        from kubeai_tpu.utils import env_float
+
+        reconnect_timeout = env_float("KUBEAI_GANG_RECONNECT_TIMEOUT", 60.0)
         while True:
             try:
                 op, sc, ar = follower.recv()
             except ConnectionError:
-                log.warning("gang publisher connection closed; follower exiting")
-                return
+                # Dispatch stream dropped (rank 0 blip, network cut, or
+                # an injected follower-drop fault): reconnect with
+                # backoff instead of dying — rank 0's supervision holds
+                # the gang degraded until we re-prove, then resets every
+                # rank. Only a publisher that never comes back (or
+                # rejects the handshake) ends this process.
+                reconnector = getattr(follower, "reconnect", None)
+                if reconnector is None or reconnect_timeout <= 0:
+                    log.warning("gang publisher connection closed; follower exiting")
+                    return
+                log.warning(
+                    "gang dispatch stream lost; reconnecting (up to %.0fs)",
+                    reconnect_timeout,
+                )
+                try:
+                    reconnector(timeout=reconnect_timeout)
+                except Exception as e:
+                    log.warning("gang reconnect failed (%s); follower exiting", e)
+                    return
+                log.info("gang dispatch stream re-established")
+                continue
             if op == "stop":
                 return
             if op == "reset":
@@ -1505,6 +1564,14 @@ class Engine:
                 log.critical("%s; terminating rank 0", e)
                 self._terminate_rank("gang desynced; slice restarting", code=14)
                 return  # tests stub _terminate_rank; production never gets here
+            except GangLost as e:
+                # A follower's dispatch connection died. Fail in-flight
+                # work (its collectives can never complete), go
+                # not-ready, and SUPERVISE: wait for the follower to
+                # reconnect and re-form the gang instead of wedging or
+                # exiting immediately.
+                self._handle_gang_loss(str(e))
+                pending = None
             except Exception:
                 # A failed jitted step may have consumed donated buffers —
                 # the device state is unusable. Fail all in-flight requests
@@ -1563,14 +1630,72 @@ class Engine:
     def _recover(self):
         try:
             self._bcast("reset")
-        except GangLost:
+        except GangLost as e:
             if self._running:
-                # A follower is gone: the gang's collectives can never
-                # line up again, so serving from this process is over.
-                log.critical("gang follower connection lost; terminating rank 0")
-                self._terminate_rank("gang follower lost; slice restarting", code=13)
+                # A follower is gone on top of the device error: route
+                # into gang supervision (which fails in-flight work and
+                # re-forms or, on timeout, terminates the rank).
+                self._handle_gang_loss(str(e))
+            return
         self._fail_inflight("engine reset after device error")
         self._init_device_state()
+
+    def _handle_gang_loss(self, reason: str) -> None:
+        """Rank 0 gang supervision: a follower is gone. Fail everything
+        in flight, flip not-ready (the balancer routes elsewhere), then
+        wait for the restarted follower to reconnect. On re-form:
+        broadcast "reset" so every rank rebuilds device state from the
+        same zero, rebuild locally, count kubeai_gang_reforms_total,
+        and resume serving. If the gang does not re-form within
+        KUBEAI_GANG_REFORM_TIMEOUT, fall back to rank termination (the
+        controller recreates the whole slice — the pre-recovery blast
+        radius)."""
+        self._gang_degraded.set()
+        log.warning("gang degraded (%s); waiting for re-form", reason)
+        self._fail_inflight(f"gang follower lost; re-forming ({reason})")
+        pub = self._publisher
+        if pub is None:  # defensive: GangLost only arises with a publisher
+            self._gang_degraded.clear()
+            return
+        if self.gang_reform_timeout <= 0:
+            # Supervision disabled: the original terminate-immediately
+            # blast radius.
+            self._terminate_rank("gang follower lost; slice restarting", code=13)
+            return
+        deadline = time.monotonic() + self.gang_reform_timeout
+        while self._running:
+            if pub.wait_complete(0.1):
+                try:
+                    self._bcast("reset")
+                    # Replay adapter loads: a RESTARTED follower has an
+                    # empty bank, and the first LoRA dispatch it cannot
+                    # satisfy would kill it again (re-form crash-loop).
+                    # Survivors re-install idempotently — the ops are
+                    # ordered in the same stream, so every rank's bank
+                    # converges before any later dispatch.
+                    for name, path in self._adapter_sources.items():
+                        self._bcast(
+                            "load_adapter", scalars={"name": name, "path": path}
+                        )
+                except GangLost:
+                    # Re-formed member died again before the reset
+                    # landed; keep supervising until the deadline.
+                    if time.monotonic() >= deadline:
+                        break
+                    continue
+                self._init_device_state()
+                self._gang_degraded.clear()
+                self.m_gang_reforms.inc()
+                log.info("gang re-formed; serving resumes")
+                return
+            if time.monotonic() >= deadline:
+                break
+        if self._running:
+            log.critical(
+                "gang did not re-form within %.0fs; terminating rank 0",
+                self.gang_reform_timeout,
+            )
+            self._terminate_rank("gang follower lost; slice restarting", code=13)
 
     DEADLINE_MSG = "deadline exceeded"
 
@@ -1701,13 +1826,19 @@ class Engine:
                         self._release_slot_pages(slot_idx)
                 # Escalate to _loop's recovery when the failure can't be
                 # contained to this request: a failed jitted prefill may
-                # have consumed the donated cache, and a same-round
-                # claimant of the failed slot's pages would read garbage
-                # (poisoned). Requests drained from the queue but not yet
-                # prefilled would otherwise be silently dropped (their
-                # callers would hang): error them out before raising.
+                # have consumed the donated cache, a same-round claimant
+                # of the failed slot's pages would read garbage
+                # (poisoned), and a gang failure (lost follower /
+                # desync) needs the loop's supervision, not per-request
+                # swallowing. Requests drained from the queue but not
+                # yet prefilled would otherwise be silently dropped
+                # (their callers would hang): error them out first.
                 kbuf = self._cache["kv"]
-                if poisoned or getattr(kbuf, "is_deleted", lambda: False)():
+                if (
+                    poisoned
+                    or isinstance(e, (GangLost, GangDesync))
+                    or getattr(kbuf, "is_deleted", lambda: False)()
+                ):
                     for later_items, _ in work[w + 1 :]:
                         for slot_idx, req in later_items:
                             if self._slots[slot_idx] is None:

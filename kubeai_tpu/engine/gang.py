@@ -23,11 +23,18 @@ Arrays ride as raw C-order bytes in header order. No pickle — the
 channel crosses pod boundaries, and a codec this small is cheaper to
 audit than to sandbox.
 
-A broken follower connection is fatal for the gang (the next collective
-would hang anyway): the publisher raises, the engine's recovery errors
-in-flight requests, and the pod exits for the controller to recreate
-the slice gang — the same blast radius as losing a NCCL rank in the
-reference's Ray workers.
+A broken follower connection fails all in-flight work (the next
+collective could never line up), but it is no longer automatically
+fatal for the rank: the publisher detects the dead rank (at publish
+time, or proactively via the EOF monitor), drops it, and keeps
+accepting connections — when the restarted follower reconnects (the
+follower side retries with exponential backoff) and re-proves the
+shared secret, the gang RE-FORMS: rank 0 broadcasts "reset", every
+rank rebuilds device state, and serving resumes. While incomplete the
+engine reports not-ready so the balancer routes elsewhere. Only when
+re-form does not happen within the supervision window does the rank
+exit for the controller to recreate the whole slice gang — the old
+blast radius, now the fallback instead of the only move.
 """
 
 from __future__ import annotations
@@ -37,6 +44,7 @@ import hashlib
 import json
 import logging
 import os
+import select
 import socket
 import struct
 import threading
@@ -64,6 +72,12 @@ def _mac(secret: bytes, tag: bytes, challenge: bytes, rank: int) -> bytes:
 
 class GangAuthError(ConnectionError):
     """A peer failed the shared-secret handshake."""
+
+
+class GangLostRanks(ConnectionError):
+    """publish() found follower rank(s) dead or the gang incomplete.
+    A ConnectionError so the engine's _bcast wraps it into GangLost
+    exactly like any other dead-peer failure."""
 
 
 def _encode(op: str, scalars: dict | None, arrays: dict[str, np.ndarray] | None) -> bytes:
@@ -157,6 +171,13 @@ class GangPublisher:
         # out behind the assembled check (advisor r5).
         self._proven: set[int] = set()
         self._assembled = threading.Event()
+        self._closed = False
+        # Set whenever a rank is dropped: even if the rank silently
+        # rejoins between publishes (rank 0 never saw a send fail), ops
+        # published into the dead socket were LOST — the gang's device
+        # state may have diverged, so every op except "reset" is
+        # refused until a reset broadcast resynchronizes the ranks.
+        self._needs_reset = False
         self._srv = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         self._srv.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
         self._srv.bind((host, port))
@@ -175,6 +196,16 @@ class GangPublisher:
             target=self._accept_loop, name="gang-accept", daemon=True
         )
         self._acceptor.start()
+        # Dead-follower detection on IDLE gangs: the dispatch stream is
+        # one-way, so a follower that dies between publishes would only
+        # be discovered at the next sendall — and its restarted pod's
+        # reconnect would be rejected as a duplicate rank until then.
+        # The monitor watches registered connections for EOF (followers
+        # never send after the handshake) and drops dead ranks promptly.
+        self._monitor = threading.Thread(
+            target=self._monitor_loop, name="gang-monitor", daemon=True
+        )
+        self._monitor.start()
 
     def _handshake(self, conn: socket.socket, addr) -> tuple[int, bytes]:
         """Challenge-response on a fresh connection; returns the proven
@@ -207,12 +238,13 @@ class GangPublisher:
         return rank, transcript
 
     def _accept_loop(self) -> None:
-        """Accept until the gang is assembled (or the server socket
-        closes). Each handshake runs on its own bounded thread — done
-        serially, one slow/malicious peer reconnecting in a loop would
-        hold the acceptor for _HANDSHAKE_BUDGET per attempt and starve
-        the real followers out of accept_all's whole assembly window."""
-        while not self._assembled.is_set():
+        """Accept for the publisher's whole lifetime (reconnecting
+        followers re-form a degraded gang — the acceptor must outlive
+        the initial assembly). Each handshake runs on its own bounded
+        thread — done serially, one slow/malicious peer reconnecting in
+        a loop would hold the acceptor for _HANDSHAKE_BUDGET per attempt
+        and starve the real followers out of the assembly window."""
+        while not self._closed:
             try:
                 self._srv.settimeout(None)
                 conn, addr = self._srv.accept()
@@ -239,9 +271,11 @@ class GangPublisher:
             return
         # Membership under the publish lock: concurrent handshakes for
         # the same rank must not both register, and publish() must not
-        # iterate _conns mid-append.
+        # iterate _conns mid-append. A rank currently absent (never
+        # joined, or dropped after its connection died) may register
+        # even after a prior assembly — that is the re-form path.
         with self._lock:
-            if rank in self._ranks or self._assembled.is_set():
+            if rank in self._ranks:
                 log.warning(
                     "rejecting gang connection from %s: duplicate rank %d", addr, rank
                 )
@@ -298,19 +332,115 @@ class GangPublisher:
                 f"{self.n_followers} followers authenticated within {timeout}s"
             )
 
+    # -- supervision -------------------------------------------------------
+
+    def _drop_rank_locked(self, rank: int, reason: str) -> None:
+        """Remove *rank* from the gang (lock held): its connection is
+        closed, assembly flips incomplete, and a reconnect for the rank
+        becomes acceptable again."""
+        conn = self._ranks.pop(rank, None)
+        if conn is None:
+            return
+        if conn in self._conns:
+            self._conns.remove(conn)
+        self._proven.discard(rank)
+        self._needs_reset = True
+        if len(self._proven) < self.n_followers:
+            self._assembled.clear()
+        try:
+            conn.close()
+        except OSError:
+            pass
+        log.warning("gang follower rank %d dropped: %s", rank, reason)
+
+    def _monitor_loop(self) -> None:
+        """Watch registered follower connections for EOF. The dispatch
+        stream is publisher->follower only, so the only thing a
+        registered socket can ever become readable with is its death
+        (EOF/RST) — recv() it and drop the rank so an idle gang notices
+        follower loss without waiting for the next publish."""
+        while not self._closed:
+            with self._lock:
+                conns = {conn: rank for rank, conn in self._ranks.items()}
+            if not conns:
+                time.sleep(0.05)
+                continue
+            try:
+                readable, _, _ = select.select(list(conns), [], [], 0.2)
+            except (OSError, ValueError):
+                continue  # a conn closed mid-select; next pass re-snapshots
+            for conn in readable:
+                dead = False
+                try:
+                    # MSG_DONTWAIT, not setblocking(): flipping the
+                    # socket's blocking mode here would race a publish()
+                    # mid-sendall on the same socket.
+                    data = conn.recv(1, socket.MSG_DONTWAIT)
+                    dead = data == b""
+                except (BlockingIOError, InterruptedError):
+                    pass
+                except OSError:
+                    dead = True
+                if dead:
+                    with self._lock:
+                        rank = conns.get(conn)
+                        if rank is not None and self._ranks.get(rank) is conn:
+                            self._drop_rank_locked(rank, "connection EOF")
+
+    def missing_ranks(self) -> set[int]:
+        with self._lock:
+            return set(range(1, self.n_followers + 1)) - set(self._ranks)
+
+    def is_complete(self) -> bool:
+        """Every follower rank connected and proven."""
+        return self._assembled.is_set()
+
+    def wait_complete(self, timeout: float) -> bool:
+        """Block up to *timeout* for the gang to (re-)complete."""
+        return self._assembled.wait(timeout)
+
     def publish(self, op: str, scalars: dict | None = None, arrays: dict[str, np.ndarray] | None = None) -> None:
         # Failpoint: chaos tests sever/stall the gang dispatch stream
         # here; a FaultError is a ConnectionError, so it exercises the
-        # real GangLost fatal path in the engine.
+        # real GangLost recovery path in the engine.
         from kubeai_tpu.faults import fault
 
         fault("gang.publish")
         payload = _encode(op, scalars, arrays)
         with self._lock:
-            for conn in self._conns:
-                conn.sendall(payload)
+            if self.n_followers and len(self._proven) < self.n_followers:
+                missing = set(range(1, self.n_followers + 1)) - set(self._ranks)
+                raise GangLostRanks(
+                    f"gang incomplete: missing rank(s) {sorted(missing)}"
+                )
+            if self._needs_reset:
+                if op != "reset":
+                    # A rank dropped (and possibly silently rejoined):
+                    # ops it missed are unrecoverable — only a reset
+                    # rebroadcast may pass, resynchronizing every rank.
+                    raise GangLostRanks(
+                        "gang member was lost since the last reset; "
+                        "reset required before dispatch"
+                    )
+                self._needs_reset = False
+            dead: list[int] = []
+            for rank, conn in list(self._ranks.items()):
+                try:
+                    conn.sendall(payload)
+                except OSError as e:
+                    log.warning(
+                        "gang follower rank %d send failed: %s", rank, e
+                    )
+                    dead.append(rank)
+            for rank in dead:
+                self._drop_rank_locked(rank, "publish send failed")
+            if dead:
+                raise GangLostRanks(
+                    f"gang follower rank(s) {sorted(dead)} lost during publish"
+                )
 
     def close(self) -> None:
+        self._closed = True
         # Best-effort "stop": if the scheduler thread is wedged inside
         # publish() (follower stopped reading, TCP window full) it holds
         # _lock — blocking here would deadlock shutdown. Skip the
@@ -344,9 +474,28 @@ class GangFollower:
             raise ValueError("gang secret must be non-empty (set KUBEAI_GANG_SECRET)")
         if rank < 1:
             raise ValueError(f"follower rank must be >= 1, got {rank}")
-        sec = secret.encode() if isinstance(secret, str) else secret
+        self._host = host
+        self._port = port
+        self._rank = rank
+        self._secret = secret.encode() if isinstance(secret, str) else secret
+        self._establish(timeout, base=0.5, cap=0.5)
+
+    def reconnect(self, timeout: float = 60.0) -> None:
+        """Re-join after the dispatch stream dropped (rank 0 restarted,
+        or a network blip): exponential backoff between attempts —
+        rank 0 rejects our rank as a duplicate until it has noticed the
+        old connection's death, so immediate hammering just burns its
+        acceptor. Raises TimeoutError when the publisher never comes
+        back inside *timeout* (the pod then exits for the controller)."""
+        self.close()
+        self._establish(timeout, base=0.5, cap=5.0)
+
+    def _establish(self, timeout: float, base: float, cap: float) -> None:
+        sec = self._secret
+        host, port, rank = self._host, self._port, self._rank
         deadline = time.monotonic() + timeout
         last_err: Exception | None = None
+        delay = base
         while True:
             try:
                 self._sock = socket.create_connection((host, port), timeout=10)
@@ -393,7 +542,8 @@ class GangFollower:
                     raise TimeoutError(
                         f"could not reach gang publisher {host}:{port}: {last_err}"
                     ) from last_err
-                time.sleep(0.5)
+                time.sleep(delay)
+                delay = min(delay * 2, cap)
         self._sock.setsockopt(socket.IPPROTO_TCP, socket.TCP_NODELAY, 1)
         # Blocking reads: the dispatch stream is idle whenever rank 0 has
         # no requests (the connect timeout must not apply to recv).
@@ -402,6 +552,12 @@ class GangFollower:
         log.info("connected to gang publisher %s:%d as rank %d", host, port, rank)
 
     def recv(self) -> tuple[str, dict, dict[str, np.ndarray]]:
+        # Failpoint "follower-drop": chaos tests sever the stream from
+        # the follower's side (FaultError is a ConnectionError, so it
+        # takes the real reconnect-with-backoff path in run_follower).
+        from kubeai_tpu.faults import fault
+
+        fault("gang.follower")
         return _decode(self._file)
 
     def close(self) -> None:

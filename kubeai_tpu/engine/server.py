@@ -34,7 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeai_tpu.engine.core import Engine
 from kubeai_tpu.engine.sampling import SamplingParams
-from kubeai_tpu.faults import handle_faults_request
+from kubeai_tpu.faults import FaultError, fault, handle_faults_request
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs import extract_context, handle_debug_request
 
@@ -283,6 +283,26 @@ def _make_handler(srv: EngineServer):
                     deadline = time.monotonic() + max(float(dl_hdr), 0.0)
                 except ValueError:
                     pass  # unparseable deadline = no deadline
+            # Mid-stream replay hint: the proxy already delivered this
+            # many stream events to the client from a replica that died
+            # mid-stream, and is re-running the (deterministic) request
+            # here — it suppresses that prefix of OUR stream, so the
+            # client sees one seamless continuation. The engine's job
+            # is to regenerate identically (prompt prefill may hit the
+            # shared-prefix cache); the hint is surfaced for logs and
+            # the flight recorder.
+            resume_tokens = 0
+            rt_hdr = self.headers.get("X-Resume-Tokens", "")
+            if rt_hdr:
+                try:
+                    resume_tokens = max(int(rt_hdr), 0)
+                except ValueError:
+                    pass
+                if resume_tokens and rid:
+                    log.info(
+                        "request id=%s is a mid-stream replay: %d events "
+                        "already delivered upstream", rid, resume_tokens,
+                    )
             try:
                 body = json.loads(self._read_body() or b"{}")
             except json.JSONDecodeError as e:
@@ -293,9 +313,15 @@ def _make_handler(srv: EngineServer):
                 return self._saturated("server is draining")
             try:
                 if path == "/v1/completions":
-                    self._completions(body, chat=False, trace_ctx=trace_ctx, deadline=deadline)
+                    self._completions(
+                        body, chat=False, trace_ctx=trace_ctx, deadline=deadline,
+                        resume_tokens=resume_tokens,
+                    )
                 elif path == "/v1/chat/completions":
-                    self._completions(body, chat=True, trace_ctx=trace_ctx, deadline=deadline)
+                    self._completions(
+                        body, chat=True, trace_ctx=trace_ctx, deadline=deadline,
+                        resume_tokens=resume_tokens,
+                    )
                 elif path == "/v1/embeddings":
                     self._embeddings(body)
                 elif path == "/v1/load_lora_adapter":
@@ -387,7 +413,7 @@ def _make_handler(srv: EngineServer):
                 return None, None
             return prompt, None
 
-        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None):
+        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None, resume_tokens=0):
             tok = srv.engine.tokenizer
             prompt_ids = None
             if chat:
@@ -537,6 +563,8 @@ def _make_handler(srv: EngineServer):
                         r.trace.model = srv.model_name
                         if n_choices > 1:
                             r.trace.attrs["choice"] = i
+                        if resume_tokens:
+                            r.trace.attrs["resume_tokens"] = resume_tokens
                     reqs.append(r)
             except ValueError as e:
                 _cancel_all(reqs)
@@ -695,6 +723,11 @@ def _make_handler(srv: EngineServer):
             self.end_headers()
 
             def send_chunk(payload: str):
+                # Failpoint "kill-after-N-tokens": arming
+                # engine.stream=error:1:skip=N severs the response after
+                # the Nth SSE event left this replica — the chaos seam
+                # for mid-stream replica death (proxy replay under test).
+                fault("engine.stream")
                 data = f"data: {payload}\n\n".encode()
                 self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                 self.wfile.flush()
@@ -835,6 +868,19 @@ def _make_handler(srv: EngineServer):
                         send_chunk(json.dumps({"error": {"message": ev[1]}}))
                         self.wfile.write(b"0\r\n\r\n")
                         return
+            except FaultError:
+                # Injected mid-stream death: die like a crashed replica —
+                # sever the socket with the chunked stream UNterminated,
+                # so the downstream proxy sees a dead upstream (and its
+                # replay path engages), not a clean short response.
+                import socket as _socket
+
+                _cancel_all(reqs)
+                try:
+                    self.connection.shutdown(_socket.SHUT_RDWR)
+                except OSError:
+                    pass
+                self.close_connection = True
             except (BrokenPipeError, ConnectionResetError):
                 _cancel_all(reqs)
 

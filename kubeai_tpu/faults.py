@@ -32,7 +32,12 @@ Known sites (grep ``fault(`` for ground truth):
     balancer.reconcile   per endpoint-reconcile pass
     engine.submit        request admission into the engine queue
     engine.step          top of each scheduler-loop iteration
+    engine.stream        before each SSE event the engine server writes
+                         (error:1:skip=N = kill-after-N-tokens: the
+                         response socket is severed like a dead replica)
     gang.publish         before each gang dispatch broadcast
+    gang.follower        each follower recv (follower-drop: dead-peer
+                         error exercising reconnect-with-backoff)
     weights.load         checkpoint loading
 """
 

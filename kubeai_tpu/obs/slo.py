@@ -32,7 +32,6 @@ KUBEAI_SLO_ERROR_TARGET, KUBEAI_SLO_WINDOW_SECONDS.
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from bisect import bisect_left
@@ -76,11 +75,7 @@ class SLObjective:
     good_label: tuple[str, str] | None = None
 
 
-def _env_float(name: str, default: float) -> float:
-    try:
-        return float(os.environ.get(name, ""))
-    except ValueError:
-        return default
+from kubeai_tpu.utils import env_float as _env_float  # noqa: E402 — shared knob parser
 
 
 def default_objectives() -> list[SLObjective]:

@@ -25,6 +25,16 @@ from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
 from kubeai_tpu.obs import SpanBuilder, extract_context
 from kubeai_tpu.proxy.apiutils import APIError, Request, parse_request
+from kubeai_tpu.proxy.recovery import (
+    M_BUDGET_REMAINING,
+    HedgeTracker,
+    RetryBudget,
+    hedging_enabled,
+    is_token_event,
+    replay_enabled,
+    request_replayable,
+    sse_events,
+)
 
 log = logging.getLogger("kubeai_tpu.proxy")
 
@@ -42,6 +52,16 @@ class ProxyResult:
         self.body_iter = body_iter
 
 
+class _HedgeFailed(Exception):
+    """Every hedged connect attempt failed; cleanup (done callbacks,
+    breaker feedback, failed-address bookkeeping) already happened
+    inside the hedge — the retry loop must NOT repeat it."""
+
+    def __init__(self, err: Exception):
+        super().__init__(str(err))
+        self.err = err
+
+
 class ModelProxy:
     def __init__(
         self,
@@ -50,6 +70,8 @@ class ModelProxy:
         max_retries: int = 3,
         await_timeout: float = 600.0,
         connect_timeout: float = 600.0,
+        retry_budget: RetryBudget | None = None,
+        hedge_tracker: HedgeTracker | None = None,
     ):
         self.model_client = model_client
         self.lb = load_balancer
@@ -58,6 +80,16 @@ class ModelProxy:
         # Per-connection socket timeout (was hard-coded 600 s); a client
         # deadline tightens it further per attempt.
         self.connect_timeout = connect_timeout
+        # Process-wide retry budget gating ALL extra attempts (failover
+        # retries, mid-stream replays, latency hedges): under fleet-wide
+        # failure the proxy degrades to fail-fast instead of multiplying
+        # offered load by max_retries+1.
+        self.budget = retry_budget or RetryBudget()
+        M_BUDGET_REMAINING.set_callback(self.budget.remaining)
+        # Latency hedging (opt-in, non-streaming only): second attempt
+        # after a p95-based delay; first response wins.
+        self.hedge = hedge_tracker or HedgeTracker()
+        self.hedge_enabled: bool | None = None  # None = read env per request
         self.active = default_registry.gauge(
             ACTIVE_REQUESTS, "requests currently being served per model"
         )
@@ -113,12 +145,28 @@ class ModelProxy:
     def _proxy_with_retries(self, req: Request, path: str, headers: dict[str, str], release, cancelled):
         body = req.body_bytes()
         t0 = time.monotonic()
+        # Every handled request feeds the retry budget (the deposit side
+        # of the ~10%-of-request-rate token bucket).
+        self.budget.deposit()
         # End-to-end deadline: one budget spanning endpoint await, every
         # connect attempt, and the stream. None = no client deadline.
         deadline = None if req.timeout is None else t0 + req.timeout
 
         def remaining() -> float | None:
             return None if deadline is None else deadline - time.monotonic()
+
+        # Mid-stream replay eligibility: a deterministic single-choice
+        # streaming request can be seamlessly resumed on another
+        # endpoint if its replica dies mid-stream.
+        replayable = replay_enabled() and request_replayable(req.body)
+        # Latency hedging eligibility: opt-in, non-streaming JSON only
+        # (a hedge re-issues the whole request; streams replay instead).
+        hedge_on = (
+            (hedging_enabled() if self.hedge_enabled is None else self.hedge_enabled)
+            and req.body is not None
+            and not req.body.stream
+            and req.raw_body is None
+        )
 
         tb: SpanBuilder | None = req.trace
         # Propagate downstream (dropping any case-variant inbound copy so
@@ -167,7 +215,22 @@ class ModelProxy:
             if rem is not None:
                 headers["X-Request-Deadline"] = f"{max(rem, 0.001):.3f}"
             try:
-                resp, conn = self._connect(addr, path, headers, body, timeout=rem)
+                if hedge_on and attempt == 0:
+                    resp, conn, addr, done, t_conn = self._connect_hedged(
+                        req, addr, done, path, headers, body, rem,
+                        failed_addrs, cancelled, tb,
+                    )
+                else:
+                    resp, conn = self._connect(addr, path, headers, body, timeout=rem)
+            except _HedgeFailed as e:
+                # done()/breaker/failed_addrs handled inside the hedge.
+                last_err = e.err
+                if attempt < attempts - 1 and not self.budget.try_take("error"):
+                    raise APIError(
+                        502,
+                        f"upstream unavailable and retry budget exhausted: {last_err}",
+                    )
+                continue
             except (ConnectionError, OSError, http.client.HTTPException) as e:
                 done()
                 self.lb.report_result(req.model_name, addr, ok=False)
@@ -179,15 +242,24 @@ class ModelProxy:
                         endpoint=addr, attempt=attempt + 1, error=str(e)[:200],
                     )
                 log.info("connection to %s failed (%s); attempt %d", addr, e, attempt + 1)
+                # The NEXT attempt is a retry: it must fit the budget.
+                # Out of budget = fail fast (no retry amplification when
+                # the whole fleet is down).
+                if attempt < attempts - 1 and not self.budget.try_take("error"):
+                    raise APIError(
+                        502,
+                        f"upstream unavailable and retry budget exhausted: {e}",
+                    )
                 continue
             # 429 (queue full / draining) fails over like a 5xx — another
             # replica may have capacity — but does NOT feed the breaker:
             # a saturated endpoint is alive and healthy, just busy. On
-            # exhaustion the client gets the upstream's own 429 +
-            # Retry-After.
+            # exhaustion (attempts OR retry budget) the client gets the
+            # upstream's own response — budget exhaustion means fail
+            # fast with the upstream's error, not silent extra load.
             if (
                 resp.status in RETRYABLE_CODES or resp.status == 429
-            ) and attempt < attempts - 1:
+            ) and attempt < attempts - 1 and self.budget.try_take("error"):
                 log.info(
                     "retrying %s after upstream %d (attempt %d)",
                     req.model_name, resp.status, attempt + 1,
@@ -231,6 +303,12 @@ class ModelProxy:
                 self.lb.report_result(req.model_name, addr, ok=False)
                 report = None
             else:
+                if req.body is not None and not req.body.stream and resp.status < 400:
+                    # Non-streaming SUCCESS headers latency feeds the
+                    # hedge delay's p95 window (4xx excluded: fast 429s
+                    # under saturation would shrink the delay and spawn
+                    # more hedges exactly when the fleet is overloaded).
+                    self.hedge.record(time.monotonic() - t_conn)
                 # Success is reported at body EXHAUSTION: an endpoint that
                 # returns 200 headers then dies mid-stream is failing, and
                 # a half-open probe must not close the breaker until the
@@ -239,13 +317,28 @@ class ModelProxy:
                 # a later ejection cannot close the fresh breaker.
                 def report(ok, _model=req.model_name, _addr=addr, _t=t_conn):
                     self.lb.report_result(_model, _addr, ok=ok, started_at=_t)
-            return ProxyResult(
-                resp.status, resp_headers,
-                self._body_iter(
+            if (
+                replayable
+                and resp.status == 200
+                and (resp.getheader("Content-Type") or "").startswith(
+                    "text/event-stream"
+                )
+            ):
+                # Streaming + deterministic: mid-stream upstream death
+                # resumes on another endpoint instead of truncating the
+                # client's stream. Gated on the upstream ACTUALLY
+                # answering SSE — re-framing a JSON body as events would
+                # discard it.
+                body_iter = self._stream_with_replay(
+                    req, path, dict(headers), body, release, cancelled, tb,
+                    resp, conn, done, addr, t_conn, failed_addrs, remaining,
+                )
+            else:
+                body_iter = self._body_iter(
                     resp, conn, done, release, tb=tb, t_conn=t_conn,
                     cancelled=cancelled, report=report,
-                ),
-            )
+                )
+            return ProxyResult(resp.status, resp_headers, body_iter)
         log.info(
             "request id=%s model=%s failed after %d attempts: %s",
             req.id, req.model_name, attempts, last_err,
@@ -270,6 +363,285 @@ class ModelProxy:
         fwd["Content-Length"] = str(len(body))
         conn.request("POST", self._upstream_path(path), body=body, headers=fwd)
         return conn.getresponse(), conn
+
+    def _connect_hedged(self, req, addr, done, path, headers, body, rem, failed_addrs, cancelled, tb):
+        """First-attempt connect with an optional latency hedge: if the
+        primary has produced no response headers within the p95-based
+        hedge delay, a second identical request goes to a different
+        endpoint (budget-gated); the first response wins and the loser
+        is abandoned (connection closed — never double-answered).
+
+        Returns (resp, conn, addr, done, t_conn) for the winner. Raises
+        _HedgeFailed when every spawned attempt failed — with all
+        cleanup (done callbacks, breaker feedback, failed_addrs)
+        already performed."""
+        import queue as _q
+
+        results: "_q.Queue[tuple]" = _q.Queue()
+        settled = threading.Event()
+        lock = threading.Lock()
+
+        def fetch(a, d, t_start):
+            try:
+                resp, conn = self._connect(a, path, dict(headers), body, timeout=rem)
+            except Exception as e:
+                with lock:
+                    if not settled.is_set():
+                        results.put(("err", a, d, e, t_start))
+                        return
+                d()  # settled without us: release the endpoint pick
+                return
+            with lock:
+                if not settled.is_set():
+                    results.put(("resp", a, d, resp, conn, t_start))
+                    return
+            # Lost the hedge: abandon quietly (no breaker feedback — the
+            # endpoint answered, we just didn't wait).
+            try:
+                conn.close()
+            finally:
+                d()
+
+        t0 = time.monotonic()
+        threading.Thread(
+            target=fetch, args=(addr, done, t0), daemon=True, name="proxy-hedge-0"
+        ).start()
+        outstanding = 1
+        first = None
+        try:
+            first = results.get(timeout=max(self.hedge.delay(), 0.001))
+        except _q.Empty:
+            # Primary is slow. Hedge if a DIFFERENT endpoint exists
+            # (hedging the same replica is pure load) and the budget
+            # grants a token — checked in that order so the hedge
+            # counter only counts hedges that actually launched.
+            try:
+                addr2, done2 = self.lb.await_best_address(
+                    req, timeout=0.05, cancelled=cancelled,
+                    exclude={addr} | failed_addrs,
+                )
+            except (TimeoutError, RuntimeError):
+                addr2 = None
+            if addr2 is not None and addr2 != addr and self.budget.try_take("hedge"):
+                log.info(
+                    "hedging %s after %.0fms against %s",
+                    req.model_name, self.hedge.delay() * 1000, addr2,
+                )
+                if tb is not None:
+                    tb.attrs["hedged"] = True
+                threading.Thread(
+                    target=fetch, args=(addr2, done2, time.monotonic()),
+                    daemon=True, name="proxy-hedge-1",
+                ).start()
+                outstanding += 1
+            elif addr2 is not None:
+                done2()  # same endpoint (fail-open) or no budget
+        winner = None
+        first_err: Exception | None = None
+        while outstanding:
+            if first is not None:
+                entry, first = first, None
+            else:
+                entry = results.get()
+            outstanding -= 1
+            if entry[0] == "resp":
+                winner = entry
+                break
+            _, a, d, e, _t = entry
+            d()
+            self.lb.report_result(req.model_name, a, ok=False)
+            failed_addrs.add(a)
+            if first_err is None:
+                first_err = e
+        with lock:
+            settled.set()
+        # Drain results that landed before we settled (a late loser).
+        while True:
+            try:
+                entry = results.get_nowait()
+            except _q.Empty:
+                break
+            if entry[0] == "resp":
+                _, a, d, resp, conn, _t = entry
+                try:
+                    conn.close()
+                finally:
+                    d()
+            else:
+                entry[2]()
+        if winner is None:
+            raise _HedgeFailed(first_err or ConnectionError("hedge: no result"))
+        # No latency record here: the retry loop's success path records
+        # the winner (status < 400 only — a fast 429 under saturation
+        # must not drag the hedge delay down and spawn MORE hedges
+        # exactly when the fleet is overloaded).
+        _, a, d, resp, conn, t_start = winner
+        return resp, conn, a, d, t_start
+
+    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining):
+        """Stream an SSE body with mid-stream replay: events are
+        forwarded whole (a half-event from a dying upstream never
+        reaches the client); when the upstream dies after N delivered
+        events, the request is re-dispatched to another endpoint with
+        ``X-Resume-Tokens: N`` and the first N regenerated events are
+        suppressed — the client sees one uninterrupted stream with zero
+        duplicated and zero dropped events. Eligibility (deterministic,
+        single-choice, streaming) was checked by the caller; attempts
+        are bounded by max_retries, gated by the retry budget, and
+        deadline-aware. When replay is impossible the original error
+        propagates and the client sees the truncation, exactly as
+        before."""
+        forwarded = 0  # data events delivered to the client (excl. [DONE])
+        suppress = 0  # data events to drop from the current (replayed) stream
+        replays = 0
+        completed = False
+
+        def reader(r):
+            # read1 (at most one chunk per call) over read: a bulk
+            # read(N) on a chunked response that died mid-stream raises
+            # IncompleteRead WITHOUT surfacing the chunks it already
+            # buffered — events the client could have had would vanish
+            # and the resume cursor would undercount.
+            read1 = getattr(r, "read1", None)
+            if read1 is not None:
+                return lambda: read1(65536)
+            return lambda: r.read(65536)
+
+        try:
+            while True:
+                died: Exception | None = None
+                try:
+                    for ev in sse_events(reader(resp)):
+                        if is_token_event(ev):
+                            if suppress:
+                                suppress -= 1
+                                continue
+                            forwarded += 1
+                        yield ev
+                except Exception as e:
+                    died = e
+                if died is None:
+                    expected = getattr(resp, "length", None)
+                    if expected not in (None, 0):
+                        # Content-Length truncation = mid-stream death.
+                        died = http.client.IncompleteRead(b"", expected)
+                if died is None:
+                    # Clean exhaustion: success for the breaker.
+                    self.lb.report_result(
+                        req.model_name, addr, ok=True, started_at=t_conn
+                    )
+                    if tb is not None:
+                        tb.add_span(
+                            "upstream", t_conn,
+                            endpoint=addr, status=resp.status, replays=replays,
+                        )
+                    completed = True
+                    return
+                # Upstream died mid-stream.
+                self.lb.report_result(req.model_name, addr, ok=False)
+                failed_addrs.add(addr)
+                try:
+                    conn.close()
+                finally:
+                    done()
+                conn = None
+                done = None
+                if tb is not None:
+                    tb.add_span(
+                        "upstream", t_conn,
+                        endpoint=addr, error=str(died)[:200],
+                        delivered_events=forwarded,
+                    )
+                log.info(
+                    "request id=%s upstream %s died mid-stream after %d events: %s",
+                    req.id, addr, forwarded, died,
+                )
+                resp, conn, done, addr, t_conn, replays = (
+                    self._acquire_replay_upstream(
+                        req, path, base_headers, body, cancelled,
+                        failed_addrs, remaining, forwarded, replays, died,
+                    )
+                )
+                suppress = forwarded
+                log.info(
+                    "request id=%s replaying on %s (resume at event %d)",
+                    req.id, addr, forwarded,
+                )
+        finally:
+            if conn is not None:
+                conn.close()
+            if done is not None:
+                done()
+            release()
+            if tb is not None:
+                if cancelled is not None and cancelled.is_set():
+                    outcome = "cancelled"
+                elif completed:
+                    outcome = "ok"
+                else:
+                    outcome = "error"
+                tb.attrs["replays"] = replays
+                tb.finish(outcome, status=200)
+
+    def _acquire_replay_upstream(self, req, path, base_headers, body, cancelled, failed_addrs, remaining, forwarded, replays, died):
+        """Find and connect a fresh endpoint for a mid-stream replay.
+        Each attempt (including connect failures and non-200 answers)
+        consumes one replay slot and one retry-budget token. Raises the
+        original *died* error when replay is not possible — the client
+        then sees the truncated stream it would have seen without the
+        recovery layer."""
+        while True:
+            rem = remaining()
+            if (
+                (cancelled is not None and cancelled.is_set())
+                or replays >= self.max_retries
+                or (rem is not None and rem <= 0)
+                or not self.budget.try_take("replay")
+            ):
+                raise died
+            replays += 1
+            await_t = 5.0 if rem is None else min(5.0, max(rem, 0.001))
+            try:
+                addr, done = self.lb.await_best_address(
+                    req, timeout=await_t, cancelled=cancelled,
+                    exclude=failed_addrs or None,
+                )
+            except (TimeoutError, RuntimeError):
+                raise died from None
+            hdrs = dict(base_headers)
+            # The resume cursor: how many stream events the client has
+            # already received — the engine logs/records it; the proxy
+            # suppresses exactly this many events of the fresh stream.
+            hdrs["X-Resume-Tokens"] = str(forwarded)
+            rem = remaining()
+            if rem is not None:
+                hdrs["X-Request-Deadline"] = f"{max(rem, 0.001):.3f}"
+            t_conn = time.monotonic()
+            try:
+                resp, conn = self._connect(addr, path, hdrs, body, timeout=rem)
+            except (ConnectionError, OSError, http.client.HTTPException) as e:
+                done()
+                self.lb.report_result(req.model_name, addr, ok=False)
+                failed_addrs.add(addr)
+                log.info("replay connect to %s failed: %s", addr, e)
+                continue
+            if resp.status != 200 or not (
+                resp.getheader("Content-Type") or ""
+            ).startswith("text/event-stream"):
+                # Only a fresh 200 SSE stream can be grafted into the
+                # open stream.
+                try:
+                    resp.read()
+                except Exception:
+                    pass
+                conn.close()
+                done()
+                if resp.status >= 500:
+                    self.lb.report_result(req.model_name, addr, ok=False)
+                failed_addrs.add(addr)
+                log.info("replay upstream %s answered %d", addr, resp.status)
+                continue
+            return resp, conn, done, addr, t_conn, replays
 
     @staticmethod
     def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None):

@@ -21,9 +21,65 @@ import time
 
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.api.core_types import KIND_JOB, KIND_POD, Pod
+from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.runtime.store import NotFound, Store
+from kubeai_tpu.utils import env_float as _env_float
 
 log = logging.getLogger("kubeai_tpu.localruntime")
+
+# Pod phase surfaced while a crashed pod waits out its restart backoff
+# (mirrors the kubelet's waiting-state reason). The pod reads not-ready
+# (status.ready False), so the balancer routes around it; operators see
+# WHY in the phase instead of a bare "Failed".
+CRASH_LOOP_PHASE = "CrashLoopBackOff"
+
+M_POD_RESTARTS = default_registry.counter(
+    "kubeai_pod_restarts_total",
+    "pod subprocess restarts performed by the local runtime after a "
+    "crash (post-backoff relaunches, labeled by model)",
+)
+
+
+class CrashBackoff:
+    """Exponential restart backoff with reset-after-stable, one per pod.
+
+    Each crash doubles the delay before the next relaunch (base * 2^k,
+    capped) so a wedged model stops hot-looping; a process that stayed
+    up for *stable_reset* seconds before dying counts as having been
+    healthy — its next crash starts the schedule over at *base*. Pure
+    host-side math over an injectable *clock* so chaos tests drive the
+    whole schedule deterministically."""
+
+    def __init__(
+        self,
+        base: float = 1.0,
+        cap: float = 60.0,
+        stable_reset: float = 120.0,
+        clock=time.monotonic,
+    ):
+        self.base = base
+        self.cap = cap
+        self.stable_reset = stable_reset
+        self._clock = clock
+        self.crashes = 0  # consecutive crashes (resets after stability)
+        self.restarts = 0  # total relaunches performed
+        self._started_at: float | None = None
+
+    def on_start(self) -> None:
+        self._started_at = self._clock()
+
+    def on_exit(self) -> float:
+        """Record a process exit; returns the backoff delay (seconds)
+        before the next relaunch."""
+        now = self._clock()
+        if (
+            self._started_at is not None
+            and now - self._started_at >= self.stable_reset
+        ):
+            self.crashes = 0  # it ran stably; forgive the history
+        self._started_at = None
+        self.crashes += 1
+        return min(self.base * (2 ** (self.crashes - 1)), self.cap)
 
 
 def free_port() -> int:
@@ -41,7 +97,18 @@ class LocalProcess:
 
 
 class LocalRuntime:
-    def __init__(self, store: Store, namespace: str = "default", repo_root: str | None = None, extra_env: dict[str, str] | None = None):
+    def __init__(
+        self,
+        store: Store,
+        namespace: str = "default",
+        repo_root: str | None = None,
+        extra_env: dict[str, str] | None = None,
+        restart_crashed: bool | None = None,
+        crash_backoff_base: float | None = None,
+        crash_backoff_cap: float | None = None,
+        crash_stable_reset: float | None = None,
+        clock=time.monotonic,
+    ):
         self.store = store
         self.namespace = namespace
         self.repo_root = repo_root or os.path.dirname(os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
@@ -51,6 +118,33 @@ class LocalRuntime:
         self._lock = threading.Lock()
         self._running = False
         self._threads: list[threading.Thread] = []
+        # Crash-loop supervision (the kubelet restart-policy analogue):
+        # a crashed pod process is relaunched after exponential backoff
+        # instead of staying dead forever (or hot-looping). Knobs come
+        # from the constructor (tests) or KUBEAI_CRASH_* env.
+        self.restart_crashed = (
+            os.environ.get("KUBEAI_CRASH_RESTARTS", "1") not in ("0", "false", "no")
+            if restart_crashed is None
+            else restart_crashed
+        )
+        self.crash_backoff_base = (
+            _env_float("KUBEAI_CRASH_BACKOFF_BASE", 1.0)
+            if crash_backoff_base is None
+            else crash_backoff_base
+        )
+        self.crash_backoff_cap = (
+            _env_float("KUBEAI_CRASH_BACKOFF_CAP", 60.0)
+            if crash_backoff_cap is None
+            else crash_backoff_cap
+        )
+        self.crash_stable_reset = (
+            _env_float("KUBEAI_CRASH_STABLE_RESET", 120.0)
+            if crash_stable_reset is None
+            else crash_stable_reset
+        )
+        self._clock = clock
+        self._backoffs: dict[str, CrashBackoff] = {}  # pod name -> schedule
+        self._pending_restarts: dict[str, float] = {}  # pod name -> due time
 
     def start(self):
         self._running = True
@@ -87,6 +181,10 @@ class LocalRuntime:
                     elif ev.type == "DELETED":
                         with self._lock:
                             lp = self._procs.pop(ev.obj.meta.name, None)
+                            # A deleted pod must not restart out of the
+                            # grave (nor keep its crash history).
+                            self._pending_restarts.pop(ev.obj.meta.name, None)
+                            self._backoffs.pop(ev.obj.meta.name, None)
                         if lp:
                             self._kill(lp)
                 elif ev.kind == KIND_JOB and ev.type == "ADDED":
@@ -231,6 +329,18 @@ class LocalRuntime:
                 stdout.close()  # the child holds its own dup of the fd
         with self._lock:
             self._procs[pod.meta.name] = LocalProcess(pod.meta.name, proc, port)
+            # Stability clock for reset-after-stable: a process that
+            # lives >= crash_stable_reset before dying restarts the
+            # backoff schedule from base.
+            self._backoffs.setdefault(
+                pod.meta.name,
+                CrashBackoff(
+                    self.crash_backoff_base,
+                    self.crash_backoff_cap,
+                    self.crash_stable_reset,
+                    self._clock,
+                ),
+            ).on_start()
         self._set_status(pod.meta.name, phase="Running", scheduled=True, pod_ip="127.0.0.1", port=port)
 
     @staticmethod
@@ -264,6 +374,7 @@ class LocalRuntime:
 
         while self._running:
             time.sleep(0.25)
+            self._process_due_restarts()
             with self._lock:
                 procs = list(self._procs.values())
             for lp in procs:
@@ -271,7 +382,7 @@ class LocalRuntime:
                     log.warning("pod process %s exited (%s)", lp.pod_name, lp.proc.returncode)
                     with self._lock:
                         self._procs.pop(lp.pod_name, None)
-                    self._set_status(lp.pod_name, phase="Failed", ready=False)
+                    self._on_pod_exit(lp)
                     continue
                 if lp.ready:
                     continue
@@ -286,6 +397,72 @@ class LocalRuntime:
                             )
                 except Exception:
                     pass
+
+    def _on_pod_exit(self, lp: LocalProcess) -> None:
+        """A pod subprocess died. With restarts enabled and the pod
+        object still desired (present in the store), schedule a
+        relaunch after this pod's current backoff delay and surface the
+        CrashLoopBackOff phase (not-ready — the balancer routes around
+        it; `pod_is_ready` is false the whole time). Without restarts,
+        the old terminal Failed phase."""
+        name = lp.pod_name
+        if self.restart_crashed and self._running:
+            try:
+                self.store.get(KIND_POD, name, self.namespace)
+            except NotFound:
+                with self._lock:
+                    self._backoffs.pop(name, None)
+                return  # pod deleted; nothing to revive
+            with self._lock:
+                bo = self._backoffs.setdefault(
+                    name,
+                    CrashBackoff(
+                        self.crash_backoff_base,
+                        self.crash_backoff_cap,
+                        self.crash_stable_reset,
+                        self._clock,
+                    ),
+                )
+                delay = bo.on_exit()
+                self._pending_restarts[name] = self._clock() + delay
+                crashes = bo.crashes
+            self._set_status(name, phase=CRASH_LOOP_PHASE, ready=False)
+            log.warning(
+                "pod %s in %s (crash #%d); restarting in %.1fs",
+                name, CRASH_LOOP_PHASE, crashes, delay,
+            )
+        else:
+            self._set_status(name, phase="Failed", ready=False)
+
+    def _process_due_restarts(self) -> None:
+        """Relaunch crashed pods whose backoff delay has elapsed (health
+        loop cadence, so restart latency quantizes to its 0.25 s poll)."""
+        with self._lock:
+            now = self._clock()
+            due = [n for n, t in self._pending_restarts.items() if now >= t]
+            for n in due:
+                self._pending_restarts.pop(n, None)
+        for name in due:
+            try:
+                pod = self.store.get(KIND_POD, name, self.namespace)
+            except NotFound:
+                with self._lock:
+                    self._backoffs.pop(name, None)
+                continue
+            model = pod.meta.labels.get(mt.LABEL_MODEL) or "unknown"
+            M_POD_RESTARTS.inc(labels={"model": model})
+            log.info("relaunching crashed pod %s (model %s)", name, model)
+            try:
+                self._launch(pod)
+            except Exception:
+                # A transient relaunch failure (fd exhaustion, port
+                # race, store hiccup) must not kill the supervisor
+                # thread — reschedule after another backoff step.
+                log.exception("relaunch of pod %s failed; rescheduling", name)
+                with self._lock:
+                    bo = self._backoffs.get(name)
+                    delay = bo.on_exit() if bo is not None else self.crash_backoff_base
+                    self._pending_restarts[name] = self._clock() + delay
 
     def _set_status(self, pod_name: str, phase: str | None = None, ready: bool | None = None, scheduled: bool | None = None, pod_ip: str | None = None, port: int | None = None):
         def mutate(p):

@@ -1,0 +1,15 @@
+"""Small dependency-free helpers shared across the package."""
+
+from __future__ import annotations
+
+import os
+
+
+def env_float(name: str, default: float) -> float:
+    """Float env knob with a safe fallback: unset, blank, or junk
+    values yield *default* (a typo'd knob must never crash a serving
+    process at import time)."""
+    try:
+        return float(os.environ.get(name, "") or default)
+    except (TypeError, ValueError):
+        return default

@@ -24,7 +24,7 @@ from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.api.model_types import Model, ModelSpec
 from kubeai_tpu.config.system import System
 from kubeai_tpu.controller.controller import ModelReconciler
-from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+from kubeai_tpu.engine.core import Engine, EngineConfig, build_test_engine
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.engine.server import EngineServer
 from kubeai_tpu.loadbalancer.balancer import LoadBalancer
@@ -1005,6 +1005,698 @@ class TestEngineDrainAndStop:
         # ...and stop() is idempotent: the second call is a no-op even
         # though the first raised.
         srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# Recovery layer: retry budget, mid-stream replay, hedging, gang re-form,
+# crash-loop backoff (docs/robustness.md "Recovery")
+
+
+from kubeai_tpu.proxy.recovery import (  # noqa: E402
+    M_RETRIES,
+    HedgeTracker,
+    RetryBudget,
+    is_token_event,
+    request_replayable,
+    sse_events,
+)
+
+
+def retries(reason: str) -> float:
+    return M_RETRIES.value(labels={"reason": reason})
+
+
+class TestRetryBudget:
+    def test_bucket_math(self):
+        b = RetryBudget(ratio=0.5, cap=2.0)
+        assert b.remaining() == 2.0
+        assert b.try_take("error") and b.try_take("error")
+        assert not b.try_take("error"), "empty bucket must deny"
+        b.deposit()
+        b.deposit()
+        assert b.remaining() == 1.0
+        assert b.try_take("error")
+        assert not b.try_take("error")
+
+    def test_deposits_cap_at_bucket_size(self):
+        b = RetryBudget(ratio=1.0, cap=3.0)
+        for _ in range(10):
+            b.deposit()
+        assert b.remaining() == 3.0
+
+    def test_disabled_budget_always_grants(self):
+        b = RetryBudget(ratio=0.1, cap=0)
+        assert all(b.try_take("error") for _ in range(50))
+
+    def test_fleet_outage_with_exhausted_budget_fails_fast_502(self, stack):
+        """Zone-wide outage + drained budget: the client gets a prompt
+        502 and the proxy performs exactly the budgeted number of
+        attempts — no retry amplification."""
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = FakeEngine()
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        # One token, no refill: attempt 0 + exactly ONE retry.
+        api.proxy.budget = RetryBudget(ratio=0.0, cap=1.0)
+        faults.arm_spec("proxy.connect", "error")  # every endpoint "down"
+        t0 = time.monotonic()
+        status, _, body = post(api.port, {"model": "m1", "prompt": "x"})
+        assert status == 502
+        assert "retry budget exhausted" in body["error"]["message"]
+        assert time.monotonic() - t0 < 5.0
+        [desc] = [f for f in faults.list_faults() if f["name"] == "proxy.connect"]
+        assert desc["fired"] == 2, (
+            f"expected initial attempt + 1 budgeted retry, saw {desc['fired']}"
+        )
+
+
+class TestReplayEligibility:
+    class B:
+        def __init__(self, data, stream=True):
+            self.data = data
+            self.stream = stream
+
+    def test_rules(self):
+        assert request_replayable(self.B({"temperature": 0}))
+        assert request_replayable(self.B({"temperature": 0.0}))
+        assert request_replayable(self.B({"seed": 7, "temperature": 0.9}))
+        # Non-deterministic sampling: replay would visibly fork the text.
+        assert not request_replayable(self.B({"temperature": 0.7}))
+        assert not request_replayable(self.B({}))  # default temperature 1.0
+        # Multi-choice SSE interleaving is timing-dependent.
+        assert not request_replayable(self.B({"temperature": 0, "n": 2}))
+        # Non-streaming bodies retry whole (or hedge) instead.
+        assert not request_replayable(self.B({"temperature": 0}, stream=False))
+        assert not request_replayable(None)
+
+    def test_sse_framing_discards_partial_event(self):
+        chunks = [b"data: a\n", b"\ndata: b\n\ndata: c", b""]
+        it = iter(chunks)
+        evs = list(sse_events(lambda: next(it)))
+        # "data: c" never completed: it must not be forwarded.
+        assert evs == [b"data: a\n\n", b"data: b\n\n"]
+        assert is_token_event(b'data: {"x": 1}\n\n')
+        assert not is_token_event(b"data: [DONE]\n\n")
+        assert not is_token_event(b": comment\n\n")
+
+    def test_sse_framing_handles_crlf_delimiters(self):
+        """Third-party engines behind the operator may emit CRLF line
+        endings; the splitter must frame those too (and mixed streams),
+        or a replay-eligible request through such an upstream would
+        buffer forever and deliver nothing."""
+        chunks = [b"data: a\r\n\r\ndata: b\n\ndata: c\r\n", b"\r\n", b""]
+        it = iter(chunks)
+        evs = list(sse_events(lambda: next(it)))
+        assert evs == [b"data: a\r\n\r\n", b"data: b\n\n", b"data: c\r\n\r\n"]
+        assert is_token_event(b"data: a\r\n\r\n")
+        assert not is_token_event(b"data: [DONE]\r\n\r\n")
+
+
+class ScriptedSSEEngine:
+    """Streams a scripted SSE event sequence; the first *die_after*-armed
+    request is severed (socket slam) after that many events. Records the
+    X-Resume-Tokens header of every request."""
+
+    def __init__(self, events: list[str], die_after: int | None = None):
+        outer = self
+        self.resume_headers: list[str | None] = []
+        self.die_remaining = 1 if die_after is not None else 0
+
+        class H(BaseHTTPRequestHandler):
+            protocol_version = "HTTP/1.1"
+
+            def log_message(self, *a):
+                pass
+
+            def do_POST(self):
+                import socket as _socket
+
+                n = int(self.headers.get("Content-Length", 0))
+                self.rfile.read(n)
+                outer.resume_headers.append(self.headers.get("X-Resume-Tokens"))
+                die_here = outer.die_remaining > 0
+                if die_here:
+                    outer.die_remaining -= 1
+                self.send_response(200)
+                self.send_header("Content-Type", "text/event-stream")
+                self.send_header("Transfer-Encoding", "chunked")
+                self.end_headers()
+                for i, ev in enumerate(events):
+                    if die_here and i >= die_after:
+                        self.connection.shutdown(_socket.SHUT_RDWR)
+                        return
+                    data = f"data: {ev}\n\n".encode()
+                    self.wfile.write(
+                        f"{len(data):x}\r\n".encode() + data + b"\r\n"
+                    )
+                    self.wfile.flush()
+                self.wfile.write(b"0\r\n\r\n")
+
+        self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+        self.port = self.httpd.server_port
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def stream_post(port, body, path="/openai/v1/completions", timeout=30):
+    """POST a streaming request; returns the SSE data payload strings in
+    arrival order (requires the stream to COMPLETE — truncation raises)."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    out = []
+    for block in raw.split(b"\n\n"):
+        if block.startswith(b"data: "):
+            out.append(block[6:].decode())
+    return out
+
+
+class TestMidStreamReplay:
+    EVENTS = [
+        '{"choices": [{"index": 0, "text": "tok%d", "finish_reason": null}]}' % i
+        for i in range(5)
+    ] + [
+        '{"choices": [{"index": 0, "text": "", "finish_reason": "stop"}]}',
+        "[DONE]",
+    ]
+
+    def test_mid_stream_kill_resumes_with_exact_suppression(self, stack):
+        """The upstream dies after 2 delivered events; the proxy replays
+        (fail-open onto the same endpoint — the only one) carrying
+        X-Resume-Tokens: 2 and suppresses exactly 2 regenerated events:
+        the client sees every scripted event exactly once, in order."""
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = ScriptedSSEEngine(self.EVENTS, die_after=2)
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        before = retries("replay")
+        got = stream_post(
+            api.port,
+            {"model": "m1", "prompt": "x", "stream": True, "temperature": 0},
+        )
+        assert got == self.EVENTS, "duplicated or dropped stream events"
+        assert retries("replay") == before + 1
+        # The replay attempt carried the exact resume cursor.
+        assert eng.resume_headers == [None, "2"]
+
+    def test_non_deterministic_stream_is_not_replayed(self, stack):
+        """temperature > 0 without a seed: replay is OFF — the client
+        sees the truncation (pre-recovery behavior), not a forked
+        continuation."""
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = ScriptedSSEEngine(self.EVENTS, die_after=2)
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        before = retries("replay")
+        with pytest.raises(Exception):
+            stream_post(
+                api.port,
+                {"model": "m1", "prompt": "x", "stream": True, "temperature": 0.9},
+            )
+        assert retries("replay") == before
+        assert eng.resume_headers == [None]
+
+    def test_replay_denied_when_budget_empty(self, stack):
+        """Mid-stream death with a drained retry budget: fail fast — the
+        truncation surfaces instead of a replay."""
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = ScriptedSSEEngine(self.EVENTS, die_after=2)
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        api.proxy.budget = RetryBudget(ratio=0.0, cap=0.5)  # < 1 token
+        with pytest.raises(Exception):
+            stream_post(
+                api.port,
+                {"model": "m1", "prompt": "x", "stream": True, "temperature": 0},
+            )
+        assert eng.resume_headers == [None], "replay ran without budget"
+
+    def test_streaming_survives_replica_kill_real_engine(self, stack, eng_srv):
+        """Acceptance: a client streaming against a REAL engine survives
+        a mid-stream replica kill (engine.stream failpoint severs the
+        socket after 2 events) with byte-identical output to an
+        unkilled run — zero duplicated, zero dropped tokens."""
+        eng, srv = eng_srv
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        forge_ready(store, pods[0].meta.name, srv)
+        body = {
+            "model": "m1", "prompt": "count with me", "stream": True,
+            "temperature": 0, "max_tokens": 6,
+        }
+        reference = stream_post(api.port, body)
+        assert reference[-1] == "[DONE]"
+
+        def shape(events):
+            """(text, finish_reason) per event — the client-visible
+            stream, minus the per-request id/created fields."""
+            out = []
+            for p in events:
+                if p == "[DONE]":
+                    out.append("[DONE]")
+                    continue
+                c = json.loads(p)["choices"][0]
+                out.append((c.get("text"), c.get("finish_reason")))
+            return out
+
+        before = retries("replay")
+        faults.arm_spec("engine.stream", "error:1:skip=2")
+        killed = stream_post(api.port, body)
+        assert retries("replay") == before + 1, "the kill did not trigger replay"
+        assert shape(killed) == shape(reference), (
+            "token stream diverged across the replay (duplicate or dropped)"
+        )
+        drain_engine(eng)
+        assert eng._pool.used() == 0
+
+
+class TestHedging:
+    def test_hedge_wins_and_loser_is_released(self, stack):
+        """One slow replica, one fast: with hedging on, requests landing
+        on the slow replica first are answered by the hedge within the
+        hedge delay + fast latency; the loser's endpoint pick is
+        released (in-flight drains to zero)."""
+        store, rec, lb, mc, api, engines = stack
+
+        class SlowEngine:
+            def __init__(self, delay=1.5):
+                class H(BaseHTTPRequestHandler):
+                    protocol_version = "HTTP/1.1"
+
+                    def log_message(self, *a):
+                        pass
+
+                    def do_POST(self):
+                        n = int(self.headers.get("Content-Length", 0))
+                        self.rfile.read(n)
+                        time.sleep(delay)
+                        payload = json.dumps(
+                            {"choices": [{"text": "slow"}]}
+                        ).encode()
+                        try:
+                            self.send_response(200)
+                            self.send_header("Content-Type", "application/json")
+                            self.send_header("Content-Length", str(len(payload)))
+                            self.end_headers()
+                            self.wfile.write(payload)
+                        except OSError:
+                            pass  # hedge winner already answered; we lost
+
+                self.httpd = ThreadingHTTPServer(("127.0.0.1", 0), H)
+                self.port = self.httpd.server_port
+                threading.Thread(
+                    target=self.httpd.serve_forever, daemon=True
+                ).start()
+
+            def stop(self):
+                self.httpd.shutdown()
+
+        store.create(
+            mt.KIND_MODEL,
+            mk_model(
+                replicas=2, min_replicas=2,
+                load_balancing=mt.LoadBalancing(strategy="RoundRobin"),
+            ),
+        )
+        pods = await_pods(store, "m1", 2)
+        slow, fast = SlowEngine(), FakeEngine()
+        engines += [slow, fast]
+        forge_ready(store, pods[0].meta.name, slow)
+        forge_ready(store, pods[1].meta.name, fast)
+        api.proxy.hedge_enabled = True
+        api.proxy.hedge = HedgeTracker(min_delay=0.05)
+        before = retries("hedge")
+        # Two requests: RoundRobin alternates, so one of them lands on
+        # the slow replica first and must be rescued by its hedge.
+        for _ in range(2):
+            t0 = time.monotonic()
+            status, _, body = post(api.port, {"model": "m1", "prompt": "x"})
+            assert status == 200
+            assert "ok:" in body["choices"][0]["text"], "slow replica answered"
+            assert time.monotonic() - t0 < 1.2, "hedge did not rescue the request"
+        assert retries("hedge") >= before + 1
+        # The loser's pick drains: no leaked in-flight accounting.
+        _await(
+            lambda: all(
+                v == 0 for v in lb.group("m1").endpoint_loads().values()
+            ),
+            timeout=5.0, msg="hedge loser released its endpoint pick",
+        )
+
+    def test_hedge_off_by_default(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = FakeEngine()
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        before = retries("hedge")
+        status, _, _ = post(api.port, {"model": "m1", "prompt": "x"})
+        assert status == 200
+        assert retries("hedge") == before
+
+
+class TestGangReform:
+    GANG_SECRET = "chaos-gang-secret"
+
+    def _mk_pair(self):
+        from kubeai_tpu.engine.gang import GangPublisher
+        from tests.test_gang_protocol import connect_pair
+
+        follower_eng = build_test_engine()
+        pub = GangPublisher(1, port=0, host="127.0.0.1", secret=self.GANG_SECRET)
+        fol = connect_pair(pub, secret=self.GANG_SECRET)
+        # Config MUST match the follower's (build_test_engine default):
+        # the replayed dispatch arrays are shaped by the leader's slots.
+        leader = Engine(
+            follower_eng.model_config,
+            follower_eng.params,
+            follower_eng.tokenizer,
+            EngineConfig(
+                max_slots=4, max_seq_len=256, prefill_buckets=(16, 32, 64, 128)
+            ),
+            publisher=pub,
+        )
+        return leader, follower_eng, pub, fol
+
+    def test_monitor_detects_idle_follower_loss_and_reconnect(self):
+        """A follower that dies while the gang is IDLE must be noticed
+        (EOF monitor) — is_complete flips false, publish refuses, and a
+        reconnect for the freed rank re-completes the gang."""
+        from kubeai_tpu.engine.gang import GangFollower, GangPublisher
+        from tests.test_gang_protocol import connect_pair
+
+        pub = GangPublisher(1, port=0, host="127.0.0.1", secret=self.GANG_SECRET)
+        fol = connect_pair(pub, secret=self.GANG_SECRET)
+        assert pub.is_complete()
+        fol.close()
+        _await(lambda: not pub.is_complete(), msg="EOF monitor drop")
+        assert pub.missing_ranks() == {1}
+        with pytest.raises(ConnectionError):
+            pub.publish("decode", {"x": 1})
+        fol2 = GangFollower(
+            "127.0.0.1", pub.port, timeout=10,
+            secret=self.GANG_SECRET, rank=1,
+        )
+        assert pub.wait_complete(5), "reconnect did not re-complete the gang"
+        # A rank was lost since the last reset: ops the dead socket
+        # swallowed are unrecoverable, so ordinary dispatch stays
+        # refused until a reset resynchronizes the ranks.
+        with pytest.raises(ConnectionError):
+            pub.publish("decode", {"x": 2})
+        pub.publish("reset")
+        pub.publish("decode", {"x": 2})  # now dispatch flows again
+        assert fol2.recv()[0] == "reset"
+        assert fol2.recv()[1] == {"x": 2}
+        fol2.close()
+        pub.close()
+
+    def test_follower_drop_fails_inflight_then_reforms(self):
+        """Acceptance: mid-generation follower drop -> in-flight request
+        errors, the leader goes NOT-ready (no wedge), the follower's
+        reconnect-with-backoff re-forms the gang (reset broadcast,
+        kubeai_gang_reforms_total), and serving resumes."""
+        leader, follower_eng, pub, fol = self._mk_pair()
+        t = threading.Thread(
+            target=follower_eng.run_follower, args=(fol,), daemon=True
+        )
+        t.start()
+        leader.start()
+        try:
+            leader.generate(
+                leader.tokenizer.encode("warm"), mk_params(max_tokens=2),
+                timeout=120,
+            )
+            reforms0 = leader.m_gang_reforms.value()
+            assert leader.is_ready()
+            # Slow the scheduler so the long generation is provably
+            # mid-decode when the stream is severed.
+            faults.arm_spec("engine.step", "delay:0.02")
+            req = leader.submit(
+                leader.tokenizer.encode("long"), mk_params(max_tokens=100)
+            )
+            ev = req.out.get(timeout=60)
+            assert ev[0] == "token"
+            # Follower drop: sever the dispatch stream. run_follower's
+            # reconnect-with-backoff takes over on the follower side.
+            fol.close()
+            while ev[0] == "token":
+                ev = req.out.get(timeout=60)
+            assert ev[0] == "error", f"in-flight request must fail, got {ev}"
+            faults.clear_fault("engine.step")
+            # Supervision: not wedged, not dead — the gang re-forms.
+            _await(
+                lambda: leader.m_gang_reforms.value() == reforms0 + 1,
+                timeout=30, msg="gang re-form",
+            )
+            _await(lambda: leader.is_ready(), timeout=10, msg="ready after re-form")
+            ids, _, fin = leader.generate(
+                leader.tokenizer.encode("after"), mk_params(max_tokens=3),
+                timeout=120,
+            )
+            assert fin.completion_tokens >= 1
+            # The follower mirrored the post-reset stream: device state
+            # reconverges (lengths match leader's).
+            import jax
+            import numpy as np
+
+            want = np.asarray(jax.device_get(leader._lengths))
+            deadline = time.monotonic() + 30
+            while time.monotonic() < deadline:
+                try:
+                    got = np.asarray(jax.device_get(follower_eng._lengths))
+                except RuntimeError:
+                    time.sleep(0.05)
+                    continue
+                if np.array_equal(got, want):
+                    break
+                time.sleep(0.05)
+            np.testing.assert_array_equal(got, want)
+        finally:
+            faults.clear_all()
+            leader.stop()
+            t.join(timeout=20)
+            assert not t.is_alive(), "follower loop did not exit"
+
+    def test_reform_replays_adapters_to_fresh_follower(self, tmp_path):
+        """A RESTARTED follower has an empty adapter bank: re-form must
+        replay rank 0's adapter loads after the reset, or the first
+        LoRA dispatch kills the new follower again (re-form crash
+        loop). Simulated by swapping in a brand-new follower engine for
+        the dropped rank."""
+        from kubeai_tpu.engine.gang import GangFollower
+        from tests.test_lora import write_peft_checkpoint
+
+        leader, follower_eng, pub, fol = self._mk_pair()
+        t = threading.Thread(
+            target=follower_eng.run_follower, args=(fol,), daemon=True
+        )
+        t.start()
+        leader.start()
+        fresh = None
+        t2 = None
+        try:
+            leader.generate(
+                leader.tokenizer.encode("warm"), mk_params(max_tokens=2),
+                timeout=120,
+            )
+            write_peft_checkpoint(
+                str(tmp_path / "ad"), leader.model_config, seed=2
+            )
+            leader.load_adapter("re-ad", str(tmp_path / "ad"))
+            _await(
+                lambda: follower_eng.loaded_adapters() == ["re-ad"],
+                timeout=20, msg="adapter replicated pre-drop",
+            )
+            # "Restart" the follower pod: the old process exits for good
+            # (reconnect disabled) and a fresh engine takes over rank 1.
+            fol.reconnect = None  # getattr seam in run_follower
+            fol.close()
+            t.join(timeout=20)
+            assert not t.is_alive()
+            fresh = build_test_engine()
+            assert fresh.loaded_adapters() == []
+            fol2 = GangFollower(
+                "127.0.0.1", pub.port, timeout=30,
+                secret=self.GANG_SECRET, rank=1,
+            )
+            t2 = threading.Thread(
+                target=fresh.run_follower, args=(fol2,), daemon=True
+            )
+            t2.start()
+            _await(pub.is_complete, timeout=10, msg="fresh follower joined")
+            # First dispatch after the silent rejoin trips reset-required
+            # -> supervision fails it, re-forms, and REPLAYS the adapter.
+            try:
+                leader.generate(
+                    leader.tokenizer.encode("probe"), mk_params(max_tokens=2),
+                    timeout=60, adapter="re-ad",
+                )
+            except (RuntimeError, TimeoutError):
+                pass  # failed in-flight by the re-form — expected
+            _await(lambda: leader.is_ready(), timeout=30, msg="re-formed")
+            _await(
+                lambda: fresh.loaded_adapters() == ["re-ad"],
+                timeout=20, msg="adapter replayed to the fresh follower",
+            )
+            # Adapter-routed serving works against the new gang member.
+            ids, _, fin = leader.generate(
+                leader.tokenizer.encode("after"), mk_params(max_tokens=3),
+                timeout=120, adapter="re-ad",
+            )
+            assert fin.completion_tokens >= 1
+        finally:
+            faults.clear_all()
+            leader.stop()
+            t.join(timeout=20)
+            if t2 is not None:
+                t2.join(timeout=20)
+                assert not t2.is_alive(), "fresh follower loop did not exit"
+
+    def test_reform_timeout_zero_terminates_rank(self):
+        """KUBEAI_GANG_REFORM_TIMEOUT <= 0 restores the old blast
+        radius: follower loss terminates the rank immediately."""
+        leader, follower_eng, pub, fol = self._mk_pair()
+        calls = {}
+
+        def fake_terminate(message, code):
+            calls["code"] = code
+            leader._fail_inflight(message)
+            leader._running = False
+
+        leader._terminate_rank = fake_terminate
+        leader.gang_reform_timeout = 0.0
+        leader.start()
+        try:
+            leader.generate(
+                leader.tokenizer.encode("warm"), mk_params(max_tokens=2),
+                timeout=120,
+            )
+            faults.arm_spec("engine.step", "delay:0.02")
+            req = leader.submit(
+                leader.tokenizer.encode("x"), mk_params(max_tokens=100)
+            )
+            assert req.out.get(timeout=60)[0] == "token"
+            fol.close()
+            _await(lambda: calls.get("code") == 13, timeout=30, msg="rank termination")
+        finally:
+            faults.clear_all()
+            leader.stop()
+            pub.close()
+
+
+class TestCrashLoopBackoff:
+    def test_schedule_and_reset_after_stable(self):
+        from kubeai_tpu.runtime.local import CrashBackoff
+
+        clk = [0.0]
+        bo = CrashBackoff(
+            base=1.0, cap=8.0, stable_reset=30.0, clock=lambda: clk[0]
+        )
+        delays = []
+        for _ in range(5):
+            bo.on_start()
+            clk[0] += 1.0  # crashes after 1 s of life — unstable
+            delays.append(bo.on_exit())
+        assert delays == [1.0, 2.0, 4.0, 8.0, 8.0], "schedule must double then cap"
+        # A stable run (>= stable_reset) forgives the history.
+        bo.on_start()
+        clk[0] += 31.0
+        assert bo.on_exit() == 1.0, "stable run must reset the schedule"
+
+    def test_local_runtime_restarts_crashed_pod_with_backoff(self):
+        import sys
+
+        from kubeai_tpu.api.core_types import Container, PodSpec
+        from kubeai_tpu.runtime.local import (
+            CRASH_LOOP_PHASE,
+            M_POD_RESTARTS,
+            LocalRuntime,
+        )
+
+        store = Store()
+        rt = LocalRuntime(
+            store,
+            restart_crashed=True,
+            crash_backoff_base=0.2,
+            crash_backoff_cap=0.4,
+            crash_stable_reset=60.0,
+        )
+        from kubeai_tpu.api.core_types import KIND_POD, Pod
+
+        pod = Pod(
+            meta=ObjectMeta(name="crashy", labels={mt.LABEL_MODEL: "mcrash"}),
+            spec=PodSpec(
+                containers=[
+                    Container(
+                        command=[sys.executable, "-c", "import sys; sys.exit(3)"]
+                    )
+                ]
+            ),
+        )
+        before = M_POD_RESTARTS.value(labels={"model": "mcrash"})
+        rt.start()
+        try:
+            store.create(KIND_POD, pod)
+            _await(
+                lambda: store.get(KIND_POD, "crashy").status.phase
+                == CRASH_LOOP_PHASE,
+                timeout=15, msg="CrashLoopBackOff phase",
+            )
+            p = store.get(KIND_POD, "crashy")
+            assert p.status.ready is False, "crash-looping pod must read not-ready"
+            from kubeai_tpu.api.core_types import pod_is_ready
+
+            assert not pod_is_ready(p)
+            _await(
+                lambda: M_POD_RESTARTS.value(labels={"model": "mcrash"})
+                >= before + 2,
+                timeout=20, msg="post-backoff restarts",
+            )
+            assert rt._backoffs["crashy"].crashes >= 2, "backoff must escalate"
+        finally:
+            rt.stop()
+
+    def test_restart_disabled_keeps_failed_phase(self):
+        import sys
+
+        from kubeai_tpu.api.core_types import KIND_POD, Container, Pod, PodSpec
+        from kubeai_tpu.runtime.local import LocalRuntime
+
+        store = Store()
+        rt = LocalRuntime(store, restart_crashed=False)
+        pod = Pod(
+            meta=ObjectMeta(name="oneshot"),
+            spec=PodSpec(
+                containers=[
+                    Container(command=[sys.executable, "-c", "import sys; sys.exit(1)"])
+                ]
+            ),
+        )
+        rt.start()
+        try:
+            store.create(KIND_POD, pod)
+            _await(
+                lambda: store.get(KIND_POD, "oneshot").status.phase == "Failed",
+                timeout=15, msg="terminal Failed phase",
+            )
+        finally:
+            rt.stop()
 
 
 def test_no_nondaemon_threads_leaked():

@@ -27,6 +27,18 @@ from tests.test_e2e_local import ckpt_dir  # noqa: F401 (fixture reuse)
 pytestmark = pytest.mark.e2e
 
 
+def _cpu_backend_supports_multiprocess() -> bool:
+    """jax 0.4.x's CPU backend cannot execute multiprocess (global-mesh)
+    computations at all — every gang pod dies at engine build with
+    'Multiprocess computations aren't implemented on the CPU backend'.
+    Gate the 2-process slice e2e on that capability instead of burning
+    minutes of crash-loop to a guaranteed failure."""
+    import jax
+
+    major, minor, *_ = (int(x) for x in jax.__version__.split(".")[:2])
+    return (major, minor) >= (0, 5)
+
+
 @pytest.fixture(scope="module")
 def manager():
     system = System().default_and_validate()
@@ -41,6 +53,78 @@ def manager():
     mgr.stop()
 
 
+def test_gang_round_trips_completion_in_process():
+    """Fast tier-1 gang e2e: a rank-0 engine with a publisher serves a
+    completion over REAL HTTP while a follower engine replays the
+    dispatch stream over the REAL TCP wire — the whole gang data path
+    (handshake, lockstep broadcast, reset/stop) minus jax.distributed,
+    which the tier-1 CPU backend cannot run multiprocess. The 2-process
+    slice test below covers that half where the backend allows."""
+    import json as _json
+    import threading
+    import urllib.request as _rq
+
+    import numpy as np
+
+    from kubeai_tpu.engine.core import Engine, EngineConfig, build_test_engine
+    from kubeai_tpu.engine.gang import GangPublisher
+    from kubeai_tpu.engine.server import EngineServer
+    from tests.test_gang_protocol import SECRET, connect_pair
+
+    follower_eng = build_test_engine()
+    pub = GangPublisher(1, port=0, host="127.0.0.1", secret=SECRET)
+    fol = connect_pair(pub)
+    leader = Engine(
+        follower_eng.model_config,
+        follower_eng.params,
+        follower_eng.tokenizer,
+        EngineConfig(max_slots=4, max_seq_len=256, prefill_buckets=(16, 32, 64, 128)),
+        publisher=pub,
+    )
+    t = threading.Thread(
+        target=follower_eng.run_follower, args=(fol,), daemon=True
+    )
+    t.start()
+    srv = EngineServer(leader, "gang-fast", host="127.0.0.1", port=0)
+    srv.start()
+    try:
+        def complete():
+            req = _rq.Request(
+                f"http://127.0.0.1:{srv.port}/v1/completions",
+                data=_json.dumps(
+                    {"model": "gang-fast", "prompt": "hello gang",
+                     "max_tokens": 8, "temperature": 0.7, "seed": 7}
+                ).encode(),
+                headers={"Content-Type": "application/json"},
+            )
+            with _rq.urlopen(req, timeout=120) as resp:
+                return _json.loads(resp.read())
+
+        body = complete()
+        assert body["usage"]["completion_tokens"] >= 1
+        # Seeded sampling reproduces through the gang path.
+        assert complete()["choices"][0]["text"] == body["choices"][0]["text"]
+        # The follower consumed the same dispatch stream: device carries
+        # converge to the leader's exactly.
+        import jax
+
+        from tests.test_gang_protocol import _sync
+
+        want = np.asarray(jax.device_get(leader._lengths))
+        got = _sync(lambda: follower_eng._lengths, want)
+        np.testing.assert_array_equal(got, want)
+    finally:
+        srv.stop()  # publisher.close() sends the follower "stop"
+        t.join(timeout=20)
+        assert not t.is_alive(), "follower loop did not exit on stop"
+
+
+@pytest.mark.slow
+@pytest.mark.skipif(
+    not _cpu_backend_supports_multiprocess(),
+    reason="jax 0.4 CPU backend cannot execute multiprocess computations "
+           "(the 2-process slice gang crash-loops at engine build)",
+)
 def test_gang_round_trips_completion(manager, ckpt_dir):  # noqa: F811
     mgr = manager
     mgr.store.create(
