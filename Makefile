@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos dryrun loadgen-demo native clean charts images images-check fleet-snapshot
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -22,6 +22,9 @@ bench:
 
 bench-tiny:
 	$(PY) bench.py --tiny
+
+cold-start: ## scale-from-zero SLO: serial vs streamed+warmed vs parked attach
+	JAX_PLATFORMS=cpu $(PY) benchmarks/cold_start.py --json BENCH_cold_start.json
 
 OPERATOR_URL ?= http://localhost:8000
 fleet-snapshot: ## dump /debug/fleet + /debug/autoscaler + /debug/slo (runbook capture)

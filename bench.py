@@ -296,10 +296,14 @@ def run_worker(args) -> None:
     import jax
 
     # Persistent compile cache: a fallback/retry run skips recompilation.
+    # Shared helper (engine/coldstart.py): KUBEAI_COMPILE_CACHE wins so a
+    # loader-warmed shared mount benefits bench runs too.
     try:
-        os.makedirs(COMPILE_CACHE_DIR, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", COMPILE_CACHE_DIR)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+        from kubeai_tpu.engine.coldstart import setup_compile_cache
+
+        setup_compile_cache(
+            os.environ.get("KUBEAI_COMPILE_CACHE") or COMPILE_CACHE_DIR
+        )
     except Exception as e:  # pragma: no cover - cache is best-effort
         log(f"compile cache unavailable: {e}")
 

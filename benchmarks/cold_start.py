@@ -1,16 +1,29 @@
 """Cold-start benchmark: Model applied -> first generated token.
 
-BASELINE.json's north-star metrics include 0->N cold start; the
-reference never measures it (its engines are external containers).
-Here the full path is in-repo: Model created -> controller plans a pod
--> LocalRuntime spawns the engine process -> weights load -> XLA
-compiles -> LB endpoint appears -> the waiting completion's first token
-streams back. Measured twice with the SAME persistent compile cache
-dir: the cold run pays first-compile, the warm run (fresh process,
-fresh model name, same shapes) shows what the cache saves — the number
-that matters for scale-from-zero and slice recovery.
+Scale-from-zero is a first-class latency target (ROADMAP item 3): this
+benchmark measures the full path — Model created -> controller plans a
+pod -> LocalRuntime spawns (or parked pod attaches) -> weights stream ->
+XLA compiles (overlapped / cache-warmed) -> LB endpoint appears -> the
+waiting completion's first token streams back — under FOUR regimes:
 
-    python benchmarks/cold_start.py [--json out.json]
+  serial        the seed path: whole-checkpoint host load, no
+                compile/load overlap, empty compile cache
+  fast_cold     streamed weight load + background AOT compile overlap,
+                still an empty cache (isolates the overlap win)
+  fast_warm     the full fast path: the loader Job pre-warmed the
+                shared KUBEAI_COMPILE_CACHE (--warm-compile-cache),
+                weights stream, compiles are disk reads
+  parked_attach scale-from-zero lands on a pre-warmed PARKED pod
+                (process + jax + cache already up; /v1/attach streams
+                weights in) — no process spawn at all
+
+The fast_warm engine's per-phase breakdown (stage/load/compile/warmup
+from /debug/engine's cold_start section) is embedded in the output;
+``phases.overlap_s > 0`` / phase_sum > span is the direct evidence that
+load and compile ran concurrently. The parked run's attach decision is
+read back from /debug/autoscaler (action=parked_attach).
+
+    python benchmarks/cold_start.py [--json out.json] [--skip-parked]
 """
 
 from __future__ import annotations
@@ -18,6 +31,8 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import shutil
+import subprocess
 import sys
 import tempfile
 import time
@@ -25,9 +40,50 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-def first_token_seconds(mgr, store, ckpt: str, name: str) -> float:
+def save_bench_checkpoint(path: str, vocab=8192, hidden=768, inter=2048, layers=6, heads=8, kv=4) -> int:
+    """A ~200 MB float32 HF-format checkpoint written directly from
+    numpy (no torch): big enough that the weight-load phase is visible
+    next to compilation — the tiny e2e checkpoint loads in ~10 ms,
+    which makes every regime look identical. Returns the weight bytes."""
+    import numpy as np
+
+    from kubeai_tpu.engine.weights import save_hf_checkpoint
+    from kubeai_tpu.models.base import ModelConfig
+
+    rng = np.random.default_rng(0)
+    h = hidden // heads
+
+    def w(*shape):
+        return (rng.standard_normal(shape).astype(np.float32) * 0.02)
+
+    sd = {
+        "model.embed_tokens.weight": w(vocab, hidden),
+        "model.norm.weight": np.ones(hidden, np.float32),
+        "lm_head.weight": w(vocab, hidden),
+    }
+    for i in range(layers):
+        p = f"model.layers.{i}."
+        sd[p + "input_layernorm.weight"] = np.ones(hidden, np.float32)
+        sd[p + "post_attention_layernorm.weight"] = np.ones(hidden, np.float32)
+        sd[p + "self_attn.q_proj.weight"] = w(heads * h, hidden)
+        sd[p + "self_attn.k_proj.weight"] = w(kv * h, hidden)
+        sd[p + "self_attn.v_proj.weight"] = w(kv * h, hidden)
+        sd[p + "self_attn.o_proj.weight"] = w(hidden, heads * h)
+        sd[p + "mlp.gate_proj.weight"] = w(inter, hidden)
+        sd[p + "mlp.up_proj.weight"] = w(inter, hidden)
+        sd[p + "mlp.down_proj.weight"] = w(hidden, inter)
+    cfg = ModelConfig(
+        vocab_size=vocab, hidden_size=hidden, intermediate_size=inter,
+        num_layers=layers, num_heads=heads, num_kv_heads=kv, dtype="float32",
+    )
+    save_hf_checkpoint(path, cfg, sd)
+    return sum(v.nbytes for v in sd.values())
+
+
+def first_token_seconds(mgr, store, ckpt: str, name: str) -> tuple[float, dict | None]:
     """Create the Model and immediately issue a streaming completion;
-    returns seconds from Model-create to the first streamed token."""
+    returns (seconds from Model-create to first streamed token, the
+    engine pod's cold-start phase snapshot or None)."""
     import urllib.request
 
     from kubeai_tpu.api import model_types as mt
@@ -71,53 +127,195 @@ def first_token_seconds(mgr, store, ckpt: str, name: str) -> float:
         else:
             raise RuntimeError("stream ended without a token")
 
+    # Per-phase breakdown from the serving pod's /debug/engine BEFORE
+    # tearing the model down.
+    phases = None
+    try:
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: name})
+        port = pods[0].meta.annotations.get(mt.ANNOTATION_MODEL_POD_PORT)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/debug/engine?limit=1", timeout=10
+        ) as r:
+            phases = json.loads(r.read()).get("cold_start")
+    except Exception as e:
+        print(f"# phase fetch failed: {e}", file=sys.stderr)
+
     store.delete(mt.KIND_MODEL, name)
     deadline = time.time() + 60
     while time.time() < deadline:
         if not store.list(KIND_POD, selector={mt.LABEL_MODEL: name}):
             break
         time.sleep(0.2)
-    return t_first - t0
+    return t_first - t0, phases
+
+
+def run_manager(ckpt: str, xla_cache: str, fast: bool, parked: int = 0):
+    from kubeai_tpu.config.system import System
+    from kubeai_tpu.manager import Manager
+
+    system = System().default_and_validate()
+    system.autoscaling.interval_seconds = 0.5
+    system.parked_replicas = parked
+    mgr = Manager(system, local_runtime=True, host="127.0.0.1", port=0)
+    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
+        mgr.local_runtime.extra_env["JAX_PLATFORMS"] = "cpu"
+    mgr.local_runtime.extra_env["KUBEAI_COMPILE_CACHE"] = xla_cache
+    # EVERY regime warms up fully before ready, so "first token" always
+    # prices the same compiled coverage — without this the serial run
+    # hides most of its compile debt behind later requests and the
+    # comparison is apples-to-oranges.
+    mgr.local_runtime.extra_env["KUBEAI_ENGINE_WARMUP"] = "1"
+    if not fast:
+        # The seed path: whole-dict host load, serial compile.
+        mgr.local_runtime.extra_env["KUBEAI_STREAM_WEIGHTS"] = "0"
+        mgr.local_runtime.extra_env["KUBEAI_COLDSTART_OVERLAP"] = "0"
+    mgr.start()
+    return mgr
+
+
+def wait_parked_up(mgr, timeout: float = 180.0) -> bool:
+    """Wait until a parked pod's HTTP surface answers (jax imported,
+    attach endpoint live) — measuring attach latency against a pod
+    that is still booting python would measure the boot, not the
+    attach."""
+    import urllib.request
+
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.core_types import KIND_POD
+    from kubeai_tpu.controller.parked import LABEL_PARKED
+
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods = mgr.store.list(KIND_POD, selector={LABEL_PARKED: "true"})
+        for p in pods:
+            port = p.meta.annotations.get(mt.ANNOTATION_MODEL_POD_PORT)
+            if not port:
+                continue
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}/health", timeout=1
+                ) as r:
+                    if json.loads(r.read()).get("parked"):
+                        return True
+            except Exception:
+                pass
+        time.sleep(0.5)
+    return False
 
 
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--json", default=None)
+    parser.add_argument(
+        "--skip-parked", action="store_true",
+        help="skip the parked-replica attach measurement",
+    )
     args = parser.parse_args()
 
-    from kubeai_tpu.config.system import System
-    from kubeai_tpu.engine.weights import save_tiny_test_checkpoint
-    from kubeai_tpu.manager import Manager
-
-    import shutil
-
     ckpt = tempfile.mkdtemp(prefix="cold-start-ckpt-")
-    save_tiny_test_checkpoint(ckpt)
-    xla_cache = tempfile.mkdtemp(prefix="cold-start-xla-")
-
-    system = System().default_and_validate()
-    mgr = Manager(system, local_runtime=True, host="127.0.0.1", port=0)
-    if os.environ.get("JAX_PLATFORMS", "cpu") == "cpu":
-        mgr.local_runtime.extra_env["JAX_PLATFORMS"] = "cpu"
-    mgr.local_runtime.extra_env["KUBEAI_COMPILE_CACHE"] = xla_cache
-    mgr.start()
-    try:
-        cold = first_token_seconds(mgr, mgr.store, ckpt, "coldstart-cold")
-        print(f"# cold (empty compile cache): {cold:.1f}s", file=sys.stderr)
-        warm = first_token_seconds(mgr, mgr.store, ckpt, "coldstart-warm")
-        print(f"# warm (persistent compile cache): {warm:.1f}s", file=sys.stderr)
-    finally:
-        mgr.stop()
-        shutil.rmtree(ckpt, ignore_errors=True)
-        shutil.rmtree(xla_cache, ignore_errors=True)
-
-    out = {
+    nbytes = save_bench_checkpoint(ckpt)
+    cache_cold1 = tempfile.mkdtemp(prefix="cold-start-xla-serial-")
+    cache_cold2 = tempfile.mkdtemp(prefix="cold-start-xla-fastcold-")
+    cache_warm = tempfile.mkdtemp(prefix="cold-start-xla-warm-")
+    out: dict = {
         "metric": "cold_start_first_token_seconds",
-        "cold_s": round(cold, 1),
-        "warm_s": round(warm, 1),
-        "compile_cache_saving_pct": round(100 * (1 - warm / cold), 1),
+        "checkpoint_mb": round(nbytes / 1e6, 1),
     }
-    print(json.dumps(out))
+
+    def log(msg):
+        print(f"# {msg}", file=sys.stderr, flush=True)
+
+    try:
+        # 1. serial seed path, empty cache.
+        mgr = run_manager(ckpt, cache_cold1, fast=False)
+        try:
+            serial, _ = first_token_seconds(mgr, mgr.store, ckpt, "cs-serial")
+        finally:
+            mgr.stop()
+        log(f"serial (seed path, cold cache): {serial:.1f}s")
+        out["serial_s"] = round(serial, 1)
+
+        # 2. fast path, still-cold cache: isolates stream+overlap.
+        mgr = run_manager(ckpt, cache_cold2, fast=True)
+        try:
+            fast_cold, _ = first_token_seconds(mgr, mgr.store, ckpt, "cs-fastcold")
+        finally:
+            mgr.stop()
+        log(f"fast path (cold cache): {fast_cold:.1f}s")
+        out["fast_cold_s"] = round(fast_cold, 1)
+
+        # 3. loader Job warms the shared cache (the satellite CLI),
+        #    then the fast path runs against it.
+        env = dict(os.environ, KUBEAI_COMPILE_CACHE=cache_warm)
+        env.setdefault("JAX_PLATFORMS", "cpu")
+        t0 = time.monotonic()
+        staged = os.path.join(tempfile.mkdtemp(prefix="cold-start-staged-"), "model")
+        r = subprocess.run(
+            [sys.executable, "-m", "kubeai_tpu.loader", "--warm-compile-cache",
+             f"file://{ckpt}", staged, "--max-seq-len", "512", "--max-slots", "4"],
+            env=env, capture_output=True, text=True,
+        )
+        loader_warm_s = time.monotonic() - t0
+        log(f"loader --warm-compile-cache: {loader_warm_s:.1f}s rc={r.returncode}")
+        out["loader_warm_s"] = round(loader_warm_s, 1)
+
+        mgr = run_manager(ckpt, cache_warm, fast=True)
+        try:
+            fast_warm, phases = first_token_seconds(mgr, mgr.store, ckpt, "cs-fastwarm")
+        finally:
+            mgr.stop()
+        log(f"fast path (loader-warmed cache): {fast_warm:.1f}s")
+        out["fast_warm_s"] = round(fast_warm, 1)
+        if phases:
+            out["phases"] = phases
+            log(
+                f"phases: sum={phases.get('phase_sum_s')}s "
+                f"span={phases.get('span_s')}s overlap={phases.get('overlap_s')}s"
+            )
+
+        # 4. parked-replica attach: process + jax + warmed cache already
+        #    up; scale-from-zero attaches instead of spawning.
+        if not args.skip_parked:
+            import urllib.request
+
+            mgr = run_manager(ckpt, cache_warm, fast=True, parked=1)
+            try:
+                if wait_parked_up(mgr):
+                    attach_s, _ = first_token_seconds(mgr, mgr.store, ckpt, "cs-parked")
+                    out["parked_attach_s"] = round(attach_s, 1)
+                    log(f"parked attach: {attach_s:.1f}s")
+                    with urllib.request.urlopen(
+                        f"http://127.0.0.1:{mgr.api.port}/debug/autoscaler?model=cs-parked",
+                        timeout=10,
+                    ) as resp:
+                        recs = json.loads(resp.read()).get("decisions", [])
+                    out["parked_attach_decisions"] = [
+                        r for r in recs if r.get("action") == "parked_attach"
+                    ]
+                else:
+                    log("parked pod never came up; skipping attach measurement")
+            finally:
+                mgr.stop()
+    finally:
+        shutil.rmtree(ckpt, ignore_errors=True)
+        for d in (cache_cold1, cache_cold2, cache_warm):
+            shutil.rmtree(d, ignore_errors=True)
+
+    if out.get("serial_s") and out.get("fast_warm_s"):
+        out["improvement_pct"] = round(
+            100 * (1 - out["fast_warm_s"] / out["serial_s"]), 1
+        )
+    out["note"] = (
+        "CPU regime: warmup EXECUTION (zeros through every compiled "
+        "shape) dominates and is constant across regimes, so the "
+        "serial-vs-fast delta isolates load+compile; fast_cold pays "
+        "the one-time cache fill (wins come from cache+overlap "
+        "together, and load<<compile on CPU caps the overlap at the "
+        "load time); parked wins scale with process-spawn + "
+        "accelerator-init cost, ~2s on a page-cached CPU box vs "
+        "tens of seconds on a TPU pod"
+    )
+    print(json.dumps(out, indent=1))
     if args.json:
         with open(args.json, "w") as f:
             json.dump(out, f, indent=1)

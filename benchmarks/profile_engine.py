@@ -72,6 +72,8 @@ def run_sweep(
     smoke=False,
     qlen=1,
     seed=0,
+    out_path="",
+    resume=False,
 ):
     """Kernel-level decode-attention microbench: per-step latency of ONE
     paged-attention call (per layer, S=qlen queries per slot) for every
@@ -94,7 +96,14 @@ def run_sweep(
     CPU runs (smoke or no accelerator) time the REFERENCE
     implementations — structure and relative trends only, and the
     emitted document says so (`degraded: true`).
+
+    *out_path* + *resume*: per-cell results persist to *out_path*
+    (atomic tmp+rename) after EVERY measurement, and a restart with
+    resume=True skips cells the existing document already measured — a
+    flaky device mid-grid costs one cell, not the whole 30-min run.
     """
+    import json
+
     import jax
     import jax.numpy as jnp
     import numpy as np
@@ -112,12 +121,70 @@ def run_sweep(
     max_pages = seq // page
     dtype = jnp.float32 if degraded else jnp.bfloat16
 
+    def make_doc(rows):
+        return {
+            "metric": "paged_decode_attention_sweep",
+            "backend": backend,
+            "device": str(kind),
+            "degraded": degraded,
+            "note": (
+                "CPU reference timings — relative trends only, not TPU numbers"
+                if degraded else "per-layer kernel call, mid-generation tables"
+            ),
+            "shapes": {
+                "H": H, "Kv": Kv, "head_dim": h, "page": page, "seq": seq,
+                "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+            },
+            "results": rows,
+        }
+
+    def cell_key(row):
+        return (row.get("kernel"), row.get("block"), row.get("slots"), row.get("qlen"))
+
+    completed: dict[tuple, dict] = {}
+    if resume and out_path and os.path.exists(out_path):
+        try:
+            with open(out_path) as f:
+                prior = json.load(f)
+        except (OSError, ValueError) as e:
+            log(f"resume: cannot read {out_path} ({e}); starting fresh")
+            prior = None
+        if prior and prior.get("metric") == "paged_decode_attention_sweep":
+            for row in prior.get("results", []):
+                # A measured latency OR a recorded failure both count as
+                # done; a row with neither was interrupted mid-cell.
+                if row.get("latency_ms") is not None or row.get("error"):
+                    completed[cell_key(row)] = row
+            log(f"resume: {len(completed)} completed cells in {out_path}")
+
     results = []
+
+    def persist():
+        if not out_path:
+            return
+        tmp = out_path + ".tmp"
+        with open(tmp, "w") as f:
+            json.dump(make_doc(results), f, indent=2)
+            f.write("\n")
+        os.replace(tmp, out_path)
+
     # The block knob is trace-time global state: remember the caller's
     # value (tuned deployments export it) and restore it afterwards —
     # the sweep must not silently erase a live process's tuning.
     prior_blk = os.environ.get("KUBEAI_PAGED_KERNEL_BLOCK")
     for B in slots_list:
+        pending = [
+            (kernel, blk)
+            for kernel, blk in [("dedicated", "slotwise")] + [("ragged", b) for b in blocks]
+            if (kernel, blk, B, qlen) not in completed
+        ]
+        if not pending:
+            # Every cell at this slot count is already measured: reuse
+            # the rows without allocating the (large) test arrays.
+            for kernel, blk in [("dedicated", "slotwise")] + [("ragged", b) for b in blocks]:
+                results.append(completed[(kernel, blk, B, qlen)])
+                log(f"sweep kernel={kernel} block={blk} slots={B}: resumed")
+            continue
         P = 1 + B * max_pages
         q = jnp.asarray(rng.standard_normal((B, qlen, H, h)), dtype)
         kv_pages = jnp.asarray(rng.standard_normal((P, page, 2 * Kv, h)), dtype)
@@ -134,6 +201,10 @@ def run_sweep(
 
         configs = [("dedicated", "slotwise")] + [("ragged", blk) for blk in blocks]
         for kernel, blk in configs:
+            if (kernel, blk, B, qlen) in completed:
+                results.append(completed[(kernel, blk, B, qlen)])
+                log(f"sweep kernel={kernel} block={blk} slots={B}: resumed")
+                continue
             if kernel == "ragged":
                 if blk == "default":
                     os.environ.pop("KUBEAI_PAGED_KERNEL_BLOCK", None)
@@ -187,6 +258,7 @@ def run_sweep(
             if err:
                 row["error"] = err
             results.append(row)
+            persist()
             log(
                 f"sweep kernel={kernel} block={blk} slots={B}: "
                 f"{'%.3f ms' % ms if ms else 'FAILED'}"
@@ -195,21 +267,8 @@ def run_sweep(
         os.environ.pop("KUBEAI_PAGED_KERNEL_BLOCK", None)
     else:
         os.environ["KUBEAI_PAGED_KERNEL_BLOCK"] = prior_blk
-    return {
-        "metric": "paged_decode_attention_sweep",
-        "backend": backend,
-        "device": str(kind),
-        "degraded": degraded,
-        "note": (
-            "CPU reference timings — relative trends only, not TPU numbers"
-            if degraded else "per-layer kernel call, mid-generation tables"
-        ),
-        "shapes": {
-            "H": H, "Kv": Kv, "head_dim": h, "page": page, "seq": seq,
-            "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
-        },
-        "results": results,
-    }
+    persist()
+    return make_doc(results)
 
 
 def main():
@@ -228,6 +287,12 @@ def main():
         help="tiny shapes for the sweep (CI/CPU; labeled degraded)",
     )
     p.add_argument("--out", default="", help="write the sweep JSON here (default stdout)")
+    p.add_argument(
+        "--resume", action="store_true",
+        help="with --out: skip grid cells the existing JSON already "
+             "measured and persist per-cell, so a flaky device mid-grid "
+             "costs one cell, not the run",
+    )
     p.add_argument(
         "--sweep-slots", default="",
         help="comma list of slot counts (default 16,48,64,96; smoke: 2,4)",
@@ -256,23 +321,32 @@ def main():
             if args.sweep_blocks
             else (("default", "2:8") if args.smoke else ("default", "8:32", "16:32", "32:8", "64:4"))
         )
+        if args.resume and not args.out:
+            p.error("--resume requires --out (the file to resume from)")
         doc = run_sweep(
-            slots_list=slots, blocks=blocks, smoke=args.smoke, qlen=args.sweep_qlen
+            slots_list=slots, blocks=blocks, smoke=args.smoke,
+            qlen=args.sweep_qlen, out_path=args.out, resume=args.resume,
         )
         payload = json.dumps(doc, indent=2)
         if args.out:
-            with open(args.out, "w") as f:
-                f.write(payload + "\n")
+            # run_sweep already persisted per-cell; the file is current.
             log(f"sweep written to {args.out}")
         else:
             print(payload)
         return
 
-    import jax
+    import jax  # noqa: F401  (backend init before shape work)
 
-    jax.config.update("jax_compilation_cache_dir", os.path.join(
-        os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_compile_cache"))
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
+    from kubeai_tpu.engine.coldstart import setup_compile_cache
+
+    # Shared helper: KUBEAI_COMPILE_CACHE wins, else the repo-local dir.
+    setup_compile_cache(
+        os.environ.get("KUBEAI_COMPILE_CACHE")
+        or os.path.join(
+            os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+            ".jax_compile_cache",
+        )
+    )
 
     import jax.numpy as jnp
     import numpy as np

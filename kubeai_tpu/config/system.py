@@ -106,6 +106,21 @@ class System:
     allow_pod_address_override: bool = False
     fixed_self_metric_addrs: list[str] = field(default_factory=list)
     leader_election_lease_seconds: float = 15.0
+    # Parked-replica pool (cold-start fast path): keep N pre-warmed
+    # engine processes holding compiled programs but no weights;
+    # scale-from-zero ATTACHES a model to one instead of cold-spawning.
+    # parked_args are extra engine-server args for the parked pods
+    # (e.g. --park-config <ckpt> to AOT-warm a model's shapes at park
+    # time, or engine shape flags matching the expected Models).
+    parked_replicas: int = 0
+    parked_args: list[str] = field(default_factory=list)
+    # "<profile>[:count]" applied to parked pods so they schedule like
+    # model pods on a real cluster (TPU requests, node selector);
+    # LocalRuntime ignores scheduling fields, so it is optional there.
+    parked_resource_profile: str = ""
+    # Opt-in: cache loader Jobs ALSO warm the shared compile cache
+    # (--warm-compile-cache) against the staged checkpoint's shapes.
+    cache_warm_compile: bool = False
 
     def default_and_validate(self) -> "System":
         # Default engine images (parity with the reference matrix shape,

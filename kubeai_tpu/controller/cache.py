@@ -26,7 +26,7 @@ from kubeai_tpu.api.core_types import (
     PVCSpec,
     job_is_completed,
 )
-from kubeai_tpu.api.model_types import Model
+from kubeai_tpu.api.model_types import ENGINE_TPU, Model
 from kubeai_tpu.config.system import System
 from kubeai_tpu.runtime.store import AlreadyExists, NotFound, ObjectMeta, Store
 
@@ -168,6 +168,19 @@ class CacheReconciler:
         try:
             return self.store.get(KIND_JOB, name, ns)
         except NotFound:
+            # Opt-in loader warm: the staging Job also AOT-compiles the
+            # engine step functions for this checkpoint into the shared
+            # KUBEAI_COMPILE_CACHE, keyed to the Model's own engine args
+            # — hot before the first replica starts. One decision for
+            # both the flag and the trailing args (they are useless
+            # apart).
+            warm = self.system.cache_warm_compile and model.spec.engine == ENGINE_TPU
+            command = ["python", "-m", "kubeai_tpu.loader"]
+            if warm:
+                command += ["--warm-compile-cache"]
+            command += [model.spec.url, self.model_cache_dir(model)]
+            if warm:
+                command += [str(a) for a in model.spec.args]
             job = Job(
                 meta=ObjectMeta(
                     name=name,
@@ -175,10 +188,7 @@ class CacheReconciler:
                     labels={"model": model.meta.name},
                     owner_uids=[model.meta.uid],
                 ),
-                spec=self._loader_pod_spec(
-                    model,
-                    ["python", "-m", "kubeai_tpu.loader", model.spec.url, self.model_cache_dir(model)],
-                ),
+                spec=self._loader_pod_spec(model, command),
             )
             try:
                 return self.store.create(KIND_JOB, job)

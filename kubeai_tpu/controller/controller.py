@@ -31,11 +31,15 @@ log = logging.getLogger("kubeai_tpu.controller")
 
 
 class ModelReconciler:
-    def __init__(self, store: Store, system: System, cache_reconciler=None, adapter_reconciler=None):
+    def __init__(self, store: Store, system: System, cache_reconciler=None, adapter_reconciler=None, parked_pool=None):
         self.store = store
         self.system = system
         self.cache_reconciler = cache_reconciler
         self.adapter_reconciler = adapter_reconciler
+        # Parked-replica pool (controller/parked.py): scale-ups attach
+        # to a pre-warmed parked pod instead of creating one, when one
+        # is available and the model is eligible.
+        self.parked_pool = parked_pool
         self._running = False
         self._thread: threading.Thread | None = None
 
@@ -115,6 +119,31 @@ class ModelReconciler:
             self._execute_slice_plan(model, pods, desired, hosts)
         else:
             plan = calculate_pod_plan(pods, model, desired, surge=self.system.model_rollouts.surge)
+            if (
+                plan.to_create
+                and self.parked_pool is not None
+                and model.spec.engine == mt.ENGINE_TPU
+                # Only sources a parked pod can reach WITHOUT per-model
+                # volumes: local/shared paths and self-staging hf://
+                # downloads. pvc:// and cache-profile models mount
+                # volumes at pod creation, which a running pod can never
+                # gain — an attach would just fail and burn a parked pod.
+                and cfg.source.scheme in ("file", "hf")
+                and not model.spec.cache_profile
+            ):
+                # Scale-from-zero fast path: attach to parked pods
+                # before spawning; whatever the pool can't cover is
+                # created normally.
+                remaining = []
+                for pod in plan.to_create:
+                    claimed = self.parked_pool.claim(model, pod)
+                    if claimed is None:
+                        remaining.append(pod)
+                    else:
+                        plan.details.append(
+                            f"attached parked pod {claimed.meta.name}"
+                        )
+                plan.to_create = remaining
             self._execute_plan(model, plan)
 
         if self.adapter_reconciler is not None:

@@ -64,14 +64,17 @@ def base_pod(model, cfg: ModelPodConfig, container: Container) -> Pod:
     return pod
 
 
-def default_probes(container: Container, startup_seconds: int = 10800):
+def default_probes(container: Container, startup_seconds: int = 10800, ready_path: str = "/health"):
     """vLLM-style probes: 3h startup allowance for big weight loads
-    (ref: engine_vllm.go:101-138)."""
+    (ref: engine_vllm.go:101-138). *ready_path* lets engines with a real
+    readiness route (the TPU engine's /readyz: engine loop down,
+    draining, parked/attaching, degraded gang) probe it; third-party
+    images keep /health."""
     container.startup_probe = Probe(
         path="/health", port=MODEL_PORT, failure_threshold=startup_seconds // 10,
         period_seconds=10,
     )
-    container.readiness_probe = Probe(path="/health", port=MODEL_PORT, period_seconds=5)
+    container.readiness_probe = Probe(path=ready_path, port=MODEL_PORT, period_seconds=5)
     container.liveness_probe = Probe(
         path="/health", port=MODEL_PORT, period_seconds=10, failure_threshold=6
     )

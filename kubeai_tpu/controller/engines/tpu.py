@@ -53,7 +53,7 @@ def tpu_engine_pod_for_model(model, cfg: ModelPodConfig) -> Pod:
         args=args,
         env={"PYTHONUNBUFFERED": "1"},
     )
-    default_probes(container)
+    default_probes(container, ready_path="/readyz")
     pod = base_pod(model, cfg, container)
 
     if cfg.profile.hosts_per_replica > 1:

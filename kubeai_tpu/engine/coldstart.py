@@ -1,0 +1,433 @@
+"""Cold-start fast path: phase stamps, compile-cache plumbing, and
+ahead-of-time (AOT) warm compilation of the engine's step functions.
+
+Scale-from-zero used to pay a strictly serial chain — stage weights,
+read the whole checkpoint, convert on host, device_put, jit-compile,
+warm up — while the proxy held requests. This module provides the
+machinery that collapses it:
+
+- ``setup_compile_cache()`` — the ONE place ``KUBEAI_COMPILE_CACHE``
+  is honored. Every engine entry point (CLI server, gang follower,
+  bench harnesses, in-process engines) calls it, so a shared cache
+  mount turns first-compiles into disk reads everywhere.
+- ``ColdStartTimeline`` — per-phase stamps (stage/load/compile/warmup
+  → ready) surfaced in ``/debug/engine`` and the
+  ``kubeai_engine_cold_start_seconds{phase}`` histogram. Sum-of-phases
+  exceeding wall-clock is the direct evidence that load and compile
+  overlapped.
+- ``warm_compile()`` / ``warm_from_checkpoint()`` — build the engine's
+  EXACT jitted step functions (core.build_step_functions) and compile
+  them against abstract ``ShapeDtypeStruct`` trees derived from
+  config.json alone — no weights needed. With the persistent cache
+  enabled the compiled binaries land on disk, so the loader Job
+  (``--warm-compile-cache``), a parked replica, or a background thread
+  overlapped with the weight stream can all pre-pay compilation.
+- ``start_background_warm()`` — kick the AOT compile off on a thread
+  while weights stream, so engine start costs ~max(load, compile)
+  instead of their sum.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import threading
+import time
+from contextlib import contextmanager
+
+from kubeai_tpu.metrics import default_registry
+
+log = logging.getLogger("kubeai_tpu.engine.coldstart")
+
+# Engine starts span milliseconds (tiny CPU tests) to minutes (big
+# checkpoints compiling on a TPU) — the default buckets top out far too
+# low to resolve either end.
+_COLD_START_BUCKETS = (0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30, 60, 120, 300, 600)
+
+M_COLD_START = default_registry.histogram(
+    "kubeai_engine_cold_start_seconds",
+    "engine start phase durations, labeled phase=stage|load|compile|"
+    "build|warmup plus phase=ready (total start-to-serving wall "
+    "clock); phase sums exceeding their span mean phases overlapped",
+    buckets=_COLD_START_BUCKETS,
+)
+
+
+def setup_compile_cache(cache_dir: str | None = None) -> str | None:
+    """Point jax's persistent compilation cache at *cache_dir* (default:
+    the ``KUBEAI_COMPILE_CACHE`` env var; no-op when neither is set).
+
+    The single shared helper every engine entry point calls — the CLI
+    server, the gang follower path, bench.py, profile_engine.py, and
+    in-process engine construction — so a shared cache mount benefits
+    all of them, not just the CLI server. Safe to call repeatedly."""
+    cache_dir = cache_dir or os.environ.get("KUBEAI_COMPILE_CACHE")
+    if not cache_dir:
+        return None
+    import jax
+
+    os.makedirs(cache_dir, exist_ok=True)
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache EVERY compilation by default: the loader-warmed / parked
+    # fast path depends on sub-second compiles (small models, per-bucket
+    # prefill shapes) being hits too — the old 1s floor silently skipped
+    # exactly the entries that make warmup cheap.
+    min_secs = float(os.environ.get("KUBEAI_COMPILE_CACHE_MIN_SECS", "0"))
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", min_secs)
+    try:
+        # The cache module latches its initialized state at first use:
+        # a process that already compiled anything (in-process engines,
+        # tests) would silently ignore the new dir without this reset.
+        from jax._src import compilation_cache as _cc
+
+        if getattr(_cc, "is_initialized", lambda: False)():
+            _cc.reset_cache()
+    except Exception:  # pragma: no cover - private-API drift guard
+        pass
+    return cache_dir
+
+
+class ColdStartTimeline:
+    """Thread-safe per-phase stamps for one engine start.
+
+    Phases may overlap (that is the point: the compile phase runs on a
+    background thread while load streams on the caller's), so stamps
+    are independent begin/end pairs, not a stack. ``install()`` makes
+    the timeline visible at ``/debug/engine`` under ``cold_start``."""
+
+    def __init__(self, clock=time.monotonic):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self.t0_mono = clock()
+        self.t0_wall = time.time()
+        self._phases: dict[str, dict] = {}
+        self.ready_mono: float | None = None
+        self.attrs: dict = {}
+
+    def begin(self, name: str) -> None:
+        with self._lock:
+            self._phases.setdefault(name, {})["start"] = self._clock()
+
+    def end(self, name: str) -> None:
+        now = self._clock()
+        with self._lock:
+            ph = self._phases.setdefault(name, {})
+            ph.setdefault("start", now)
+            ph["end"] = now
+            dur = ph["end"] - ph["start"]
+        M_COLD_START.observe(dur, labels={"phase": name})
+
+    @contextmanager
+    def phase(self, name: str):
+        self.begin(name)
+        try:
+            yield self
+        finally:
+            self.end(name)
+
+    def ready(self) -> None:
+        """Stamp serving readiness; observes the total wall clock as
+        phase="ready" (idempotent — the first stamp wins)."""
+        with self._lock:
+            if self.ready_mono is not None:
+                return
+            self.ready_mono = self._clock()
+            total = self.ready_mono - self.t0_mono
+        M_COLD_START.observe(total, labels={"phase": "ready"})
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            phases = {k: dict(v) for k, v in self._phases.items()}
+            ready = self.ready_mono
+        out_phases = {}
+        intervals: list[tuple[float, float]] = []
+        phase_sum = 0.0
+        for name, ph in phases.items():
+            start = ph.get("start")
+            end = ph.get("end")
+            rec = {"start_s": round(start - self.t0_mono, 4)}
+            if end is not None:
+                rec["end_s"] = round(end - self.t0_mono, 4)
+                rec["duration_s"] = round(end - start, 4)
+                phase_sum += end - start
+                intervals.append((start, end))
+            out_phases[name] = rec
+        out = {
+            "t0_unix": round(self.t0_wall, 3),
+            "phases": out_phases,
+            "phase_sum_s": round(phase_sum, 4),
+            "attrs": dict(self.attrs),
+        }
+        if intervals:
+            # Interval-union coverage: sum − union is the time at least
+            # two phases ran CONCURRENTLY (gaps between serial phases
+            # must not mask it — a span-based diff would).
+            union = 0.0
+            cur_s, cur_e = None, None
+            for s, e in sorted(intervals):
+                if cur_e is None or s > cur_e:
+                    union += cur_e - cur_s if cur_e is not None else 0.0
+                    cur_s, cur_e = s, e
+                else:
+                    cur_e = max(cur_e, e)
+            union += cur_e - cur_s
+            out["span_s"] = round(max(e for _, e in intervals) - min(s for s, _ in intervals), 4)
+            out["overlap_s"] = round(max(phase_sum - union, 0.0), 4)
+        if ready is not None:
+            out["ready_s"] = round(ready - self.t0_mono, 4)
+        return out
+
+    def install(self) -> "ColdStartTimeline":
+        """Expose this timeline at /debug/engine (latest install wins —
+        one engine start per process is the norm; a parked replica's
+        attach installs a fresh timeline over the park-time one)."""
+        from kubeai_tpu.obs.recorder import register_engine_debug_section
+
+        register_engine_debug_section("cold_start", self.snapshot)
+        return self
+
+
+# ---------------------------------------------------------------------------
+# Abstract shapes from config alone.
+
+
+def padded_vocab_size(vocab_size: int, tp: int = 1) -> int:
+    """The engine's vocab padding target (weights.pad_vocab): tp
+    divisibility + MXU-friendly tiling."""
+    multiple = max(tp * 128, 128)
+    return ((vocab_size + multiple - 1) // multiple) * multiple
+
+
+def param_shapes(model_config, quantization: str = ""):
+    """ShapeDtypeStruct tree of the engine's parameters, derived from
+    the model config alone (no weights touched). Mirrors what the
+    checkpoint loader produces — llama.init_params builds the identical
+    tree structure to params_from_hf, and quantize_model_params is
+    traceable — via jax.eval_shape, so nothing is allocated and drift
+    with the real loaders is impossible by construction. The config
+    must already carry the PADDED vocab (padded_vocab_size)."""
+    import jax
+
+    from kubeai_tpu.models import llama
+
+    def build():
+        params = llama.init_params(model_config, jax.random.key(0))
+        if quantization == "int8":
+            from kubeai_tpu.engine.weights import quantize_model_params
+
+            params = quantize_model_params(params, model_config)
+        return params
+
+    return jax.eval_shape(build)
+
+
+def warm_compile(
+    model_config,
+    engine_config=None,
+    quantization: str = "",
+    n_valid_vocab: int | None = None,
+    include_group: bool = True,
+) -> dict:
+    """AOT-compile the engine's step functions for *model_config* ×
+    *engine_config* against abstract arguments: the decode chunk,
+    batch-1 cold prefill per bucket, the group-cap batch, and the
+    chunked-prefill shape — the same coverage Engine.warmup() dispatches.
+
+    With the persistent compile cache enabled (setup_compile_cache) the
+    compiled executables land on disk keyed by the identical HLO the
+    real engine later lowers, so its first dispatches become cache
+    reads. Without a cache dir this still validates compilability but
+    benefits nobody else — callers should set the cache up first.
+
+    The *model_config* must be the engine's post-padding config; pass
+    the tokenizer's vocab as *n_valid_vocab* so the pad-masking branch
+    matches the serving process. Per-shape failures are collected, not
+    raised — a warm miss must never fail a load."""
+    import jax
+    import jax.numpy as jnp
+
+    from kubeai_tpu.engine import core
+    from kubeai_tpu.models import llama
+
+    cfg = engine_config or core.EngineConfig()
+    t0 = time.monotonic()
+    sf = core.build_step_functions(model_config, cfg, n_valid_vocab)
+    max_pages, P, hist_width = core.engine_dims(cfg)
+    B = cfg.max_slots
+    Kb = cfg.max_logit_bias
+    G = cfg.speculate_tokens
+    params = param_shapes(model_config, quantization)
+    cache = jax.eval_shape(
+        lambda: llama.init_paged_cache(model_config, P, cfg.page_size)
+    )
+    keys = jax.eval_shape(
+        lambda: jax.random.key_data(jax.random.split(jax.random.key(0), B))
+    )
+
+    def sds(shape, dtype):
+        return jax.ShapeDtypeStruct(shape, dtype)
+
+    i32, f32, u32 = jnp.int32, jnp.float32, jnp.uint32
+    shapes = 0
+    errors: list[str] = []
+
+    def compile_one(label, fn, *args, **kw):
+        nonlocal shapes
+        try:
+            fn.lower(*args, **kw).compile()
+            shapes += 1
+        except Exception as e:  # pragma: no cover - depends on backend
+            log.warning("warm compile of %s failed: %s", label, e)
+            errors.append(f"{label}: {e}")
+
+    adm_hist_kw = {"adm_hist": sds((B, hist_width), i32)} if G > 0 else {}
+    compile_one(
+        "decode",
+        sf.decode_jit_for(sf.decode_kernel),
+        params, cache, sds((B, max_pages), i32), sds((B, hist_width), i32),
+        sds((B,), i32), sds((B,), i32), keys,
+        sds((B,), jnp.bool_), sds((B,), f32), sds((B,), f32), sds((B,), i32),
+        sds((B,), f32), sds((B,), f32), sds((B,), i32),
+        sds((B, Kb), i32), sds((B, Kb), f32),
+        sds((B,), jnp.bool_), sds((B,), i32), sds((B,), u32), sds((B,), i32),
+        **adm_hist_kw,
+    )
+    cap = max(1, min(cfg.prefill_group_cap, cfg.max_slots))
+    sizes = (1, cap) if include_group and cap > 1 else (1,)
+    for bucket in cfg.prefill_buckets:
+        for n_pad in sizes:
+            compile_one(
+                f"prefill_batch[{n_pad}x{bucket}]",
+                sf.prefill_batch_jit,
+                params, sds((n_pad, bucket), i32), sds((n_pad,), i32),
+                sds((n_pad, max_pages), i32), sds((n_pad,), i32),
+                sds((n_pad,), u32), sds((n_pad,), f32), sds((n_pad,), f32),
+                sds((n_pad,), i32), sds((n_pad, Kb), i32),
+                sds((n_pad, Kb), f32), sds((B,), i32), cache,
+            )
+    max_bucket = max(cfg.prefill_buckets)
+    compile_one(
+        f"prefill_chunk[{max_bucket}]",
+        sf.prefill_chunk_jit,
+        params, sds((1, max_bucket), i32), sds((), i32), sds((), i32),
+        sds((1, max_pages), i32), sds((), i32), sds((), u32), sds((), f32),
+        sds((), f32), sds((), i32), sds((Kb,), i32), sds((Kb,), f32),
+        sds((B,), i32), cache,
+    )
+    out = {
+        "shapes": shapes,
+        "seconds": round(time.monotonic() - t0, 3),
+        "decode_kernel": sf.decode_kernel,
+    }
+    if errors:
+        out["errors"] = errors
+    log.info(
+        "AOT warm compile: %d shapes in %.1fs (%d failed)",
+        shapes, out["seconds"], len(errors),
+    )
+    return out
+
+
+def warm_from_checkpoint(
+    path: str,
+    engine_args: list[str] | None = None,
+    include_group: bool = True,
+) -> dict:
+    """Warm the compile cache for the model staged at *path* using only
+    its config.json (+ tokenizer files for the exact vocab mask) — the
+    loader Job's ``--warm-compile-cache`` step and the parked replica's
+    ``--park-config`` both land here. *engine_args* are engine-server
+    CLI args (e.g. the Model's spec.args: ``--max-seq-len 512``) so the
+    warmed shapes match what the serving pod will actually run."""
+    from kubeai_tpu.engine.server import engine_config_from_args, make_engine_arg_parser
+    from kubeai_tpu.engine.tokenizer import load_tokenizer
+    from kubeai_tpu.engine.weights import apply_backend_flags
+    from kubeai_tpu.models.base import ModelConfig
+
+    parser = make_engine_arg_parser(require_model=False)
+    args, unknown = parser.parse_known_args(list(engine_args or []))
+    if unknown:
+        log.info("warm_from_checkpoint ignoring unknown args: %s", unknown)
+    cfg_path = path if os.path.isdir(path) else os.path.dirname(path)
+    # Mirror the serving path's config pipeline EXACTLY (dtype default,
+    # backend flags, tie fallback, vocab padding) — any divergence
+    # silently turns the whole warm into cache misses.
+    config = apply_backend_flags(ModelConfig.from_json_file(cfg_path))
+    try:
+        from kubeai_tpu.engine.weights import SafetensorsSource
+
+        if (
+            "lm_head.weight" not in SafetensorsSource(cfg_path)
+            and not config.tie_word_embeddings
+        ):
+            config = config.replace(tie_word_embeddings=True)
+    except FileNotFoundError:
+        pass  # .bin checkpoint: trust config.json (the load does too)
+    tp = max(args.tensor_parallel_size, 1)
+    config = config.replace(
+        vocab_size=padded_vocab_size(config.vocab_size, tp)
+    )
+    tokenizer = load_tokenizer(cfg_path)
+    n_valid = getattr(tokenizer, "vocab_size", config.vocab_size)
+    ec = engine_config_from_args(args)
+    return warm_compile(
+        config, ec,
+        quantization=args.quantization,
+        n_valid_vocab=n_valid,
+        include_group=include_group,
+    )
+
+
+# ---------------------------------------------------------------------------
+# Background warm: compile while weights stream.
+
+
+class BackgroundWarm:
+    """Handle to an AOT warm compile running on a daemon thread. The
+    launcher stamps the timeline's compile phase around the thread's
+    actual lifetime; join() returns the warm stats (or the error as a
+    stats dict — a warm failure must never fail the load)."""
+
+    def __init__(self, fn, timeline: ColdStartTimeline | None = None):
+        self.result: dict | None = None
+        self._timeline = timeline
+        if timeline is not None:
+            # The compile phase begins the moment the thread is
+            # launched: lowering starts concurrently with the caller's
+            # weight stream, which is exactly the claim the stamps make.
+            timeline.begin("compile")
+
+        def run():
+            try:
+                self.result = fn()
+            except Exception as e:  # pragma: no cover - backend-dependent
+                log.warning("background warm compile failed: %s", e)
+                self.result = {"shapes": 0, "error": str(e)}
+            finally:
+                if timeline is not None:
+                    timeline.end("compile")
+
+        self._thread = threading.Thread(
+            target=run, name="coldstart-warm", daemon=True
+        )
+        self._thread.start()
+
+    def join(self, timeout: float | None = None) -> dict | None:
+        self._thread.join(timeout)
+        return self.result
+
+
+def start_background_warm(
+    model_config,
+    engine_config,
+    quantization: str = "",
+    n_valid_vocab: int | None = None,
+    timeline: ColdStartTimeline | None = None,
+) -> BackgroundWarm:
+    return BackgroundWarm(
+        lambda: warm_compile(
+            model_config, engine_config,
+            quantization=quantization, n_valid_vocab=n_valid_vocab,
+        ),
+        timeline=timeline,
+    )

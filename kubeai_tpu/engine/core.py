@@ -167,6 +167,20 @@ class EngineConfig:
     top_logprobs_k: int = 5
 
 
+def engine_dims(cfg: EngineConfig) -> tuple[int, int, int]:
+    """Derived device-state dimensions shared by the engine's state init
+    and the cold-start AOT warm compiler (one source of truth — a drift
+    between them silently turns every pre-warmed executable into a
+    cache miss): (max_pages_per_slot, total_pool_pages, hist_width)."""
+    ps = cfg.page_size
+    max_pages = -(-cfg.max_seq_len // ps)
+    P = cfg.num_pages or (cfg.max_slots * max_pages + 1)
+    hist_width = cfg.max_seq_len + (cfg.decode_chunk + 1) * (
+        cfg.speculate_tokens + 1
+    )
+    return max_pages, P, hist_width
+
+
 @dataclass
 class FinishInfo:
     reason: str  # "stop" | "length"
@@ -451,14 +465,12 @@ class Engine:
 
         B = self.cfg.max_slots
         ps = self.cfg.page_size
-        self._max_pages = -(-self.cfg.max_seq_len // ps)
-        P = self.cfg.num_pages or (B * self._max_pages + 1)
+        self._max_pages, P, hist_width = engine_dims(self.cfg)
         self._pool = PagePool(P, ps)
         # Device-resident token history for speculative n-gram drafting
         # (written positions only; padded past max_seq_len so in-chunk
         # speculation overshoot after a finish never scatter-collides).
         G = self.cfg.speculate_tokens
-        hist_width = self.cfg.max_seq_len + (self.cfg.decode_chunk + 1) * (G + 1)
 
         def mk_device_arrays():
             cache = llama.init_paged_cache(self.model_config, P, ps)
@@ -568,306 +580,18 @@ class Engine:
         # divisibility, MXU tiling); padded columns carry zero weights and
         # logit 0.0, which is very much sampleable — mask them out.
         n_valid = min(getattr(self.tokenizer, "vocab_size", mc.vocab_size), mc.vocab_size)
-
-        def mask_pad(logits):
-            if n_valid < mc.vocab_size:
-                return logits.at[..., n_valid:].set(-jnp.inf)
-            return logits
-
-        mtk = self.cfg.max_top_k
-        topn = max(1, self.cfg.top_logprobs_k)
-
-        def prefill_batch_fn(params, tokens, lengths, tables, slots, seeds, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_rows=None):
-            """Cold prefill for N requests in ONE call (N is a static pad
-            size — 1 for steady-state singles, max_slots for cold
-            bursts): tokens [N, S] land in the pages of *tables*
-            [N, max_pages]. Sampled first tokens are scattered into the
-            device staging vector adm_toks[slots] so the NEXT decode
-            dispatch can merge them in-graph without a host round-trip
-            (padding duplicates the last row: same slot, same value —
-            benign). PRNG keys derive from uint32 *seeds* in-graph, so
-            every argument arrives as plain numpy riding the dispatch."""
-            keys = jax.vmap(jax.random.key)(seeds)
-            logits, cache = llama.prefill_paged_cold(
-                params, mc, tokens, cache, tables, lengths,
-                lora=lora, lora_rows=lora_rows,
-            )
-            masked = mask_pad(logits[:, -1])
-            # Bias steers choice; the reported logprob stays the model's
-            # raw log p (same contract as decode).
-            toks = sample(
-                apply_logit_bias(masked, bias_ids, bias_vals),
-                keys, temp, top_p, top_k, max_top_k=mtk,
-            )
-            logp = jax.nn.log_softmax(masked, axis=-1)
-            lps = jnp.take_along_axis(logp, toks[:, None], axis=1)[:, 0]
-            t_lp, t_ids = jax.lax.top_k(logp, topn)
-            adm_toks = adm_toks.at[slots].set(toks)
-            return toks, lps, t_ids.astype(jnp.int32), t_lp, cache, adm_toks
-
-        def prefill_chunk_fn(params, tokens, start, last_idx, table, slot, seed, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_row=None):
-            """One chunk of a long or prefix-resuming prompt."""
-            key = jax.random.key(seed)
-            logits, cache = llama.prefill_paged(
-                params, mc, tokens, cache, table, start[None], last_idx[None],
-                lora=lora,
-                lora_rows=None if lora_row is None else lora_row[None],
-            )
-            masked = mask_pad(logits[:, -1])
-            tok = sample(
-                apply_logit_bias(masked, bias_ids[None], bias_vals[None]),
-                key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk,
-            )[0]
-            logp = jax.nn.log_softmax(masked, axis=-1)
-            lp = logp[0, tok]
-            t_lp, t_ids = jax.lax.top_k(logp[0], topn)
-            adm_toks = adm_toks.at[slot].set(tok)
-            return tok, lp, t_ids.astype(jnp.int32), t_lp, cache, adm_toks
-
-        K = self.cfg.decode_chunk
-        G = self.cfg.speculate_tokens
-
-        def ngram_drafts(hist, lengths, last):
-            """Per-slot 2-gram continuation lookup over the device token
-            history: find the latest previous occurrence of the bigram
-            (hist[L-1], last) and propose the G tokens that followed it.
-            No match (or tail too short) proposes zeros, which simply
-            fail verification. All shapes static; runs inside the scan."""
-            Sh = hist.shape[1]
-            idx = jnp.arange(Sh)
-
-            def one(h, L, a):
-                prev = h[jnp.maximum(L - 1, 0)]
-                nxt = jnp.roll(h, -1)  # nxt[j] = h[j+1]
-                ok = (h == prev) & (nxt == a) & (idx < L - 1) & (L > 0)
-                found = ok.any()
-                j = jnp.argmax(jnp.where(ok, idx, -1))
-                didx = j + 2 + jnp.arange(G)
-                valid = found & (didx < L)
-                return jnp.where(valid, h[jnp.clip(didx, 0, Sh - 1)], 0)
-
-            return jax.vmap(one)(hist, lengths, last)
-
-        penalties_on = self.cfg.enable_penalties
-
-        def make_decode_fn(decode_kernel: str):
-            """Decode step builder, parameterized by the CONCRETE paged-
-            attention kernel ("ragged" | "dedicated") baked into the
-            trace. Rank 0 resolves EngineConfig.decode_kernel once and
-            broadcasts the resolution with every decode op; a follower
-            whose own config disagrees compiles the broadcast flavor
-            (gang lockstep: all ranks must run the same program)."""
-            return partial(decode_fn, _decode_kernel=decode_kernel)
-
-        def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, bias_ids, bias_vals, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None, _decode_kernel="ragged"):
-            """K fused decode steps, each verifying up to G drafts.
-            Returns (drafts [K, B, G], corr [K, B], accepted [K, B]) —
-            the host emits drafts[:a] + [corr] per slot per step, where
-            corr is THE device-chosen next token (greedy: the model's
-            argmax after the accepted drafts; sampled: the sampled
-            token — never substitute argmax, the device decodes from
-            corr so emission must match it). G=0 reduces exactly to
-            one-token-per-step decoding.
-
-            Slots admitted since the last dispatch are REBASED in-graph
-            (adm_mask/adm_len/adm_seed numpy from the host; adm_toks the
-            device staging vector the prefill scattered its sample into)
-            — admission therefore requires zero eager device mutation
-            and the dispatch never waits on a first-token host sync."""
-            B = lengths.shape[0]
-            adm_keys = jax.vmap(
-                lambda s: jax.random.fold_in(jax.random.key(s), 1)
-            )(adm_seed)
-            # *keys* arrives as raw uint32 key data (see mk_device_arrays)
-            # and is wrapped here; returned as raw data again below.
-            keys = jax.random.wrap_key_data(
-                jnp.where(
-                    adm_mask[:, None],
-                    jax.random.key_data(adm_keys),
-                    keys,
-                )
-            )
-            lengths = jnp.where(adm_mask, adm_len, lengths)
-            last_tokens = jnp.where(adm_mask, adm_toks, last_tokens)
-            if G > 0:
-                hist = jnp.where(adm_mask[:, None], adm_hist, hist)
-
-            def body(carry, _):
-                cache, hist, lengths, last, keys = carry
-                if G > 0:
-                    drafts = ngram_drafts(hist, lengths, last)
-                else:
-                    drafts = jnp.zeros((B, 0), jnp.int32)
-                inputs = jnp.concatenate([last[:, None], drafts], axis=1)
-                # Record the inputs this step WRITES into KV at positions
-                # lengths..lengths+G (history width covers overshoot) —
-                # BEFORE the penalty window is read, so position
-                # `lengths` (= the previously emitted token, this step's
-                # input) is already in the history when penalties count
-                # it (ADVICE r5: computing penalties first lagged them
-                # one token — the most recent token's first immediate
-                # repeat went unpenalized, off OpenAI/vLLM semantics).
-                pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
-                hist = hist.at[jnp.arange(B)[:, None], pos].set(
-                    jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
-                )
-                logits, cache = llama.decode_speculative_paged(
-                    params, mc, inputs, cache, tables, lengths,
-                    lora=lora, lora_rows=lora_rows,
-                    decode_kernel=_decode_kernel,
-                )
-                logits = mask_pad(logits)  # [B, G+1, V]
-                if penalties_on:
-                    # OpenAI presence/frequency penalties over the
-                    # GENERATED window of the device token history —
-                    # [gen_start, lengths] INCLUSIVE: position `lengths`
-                    # holds this step's input (the token emitted last
-                    # step, just scattered above), so the full output so
-                    # far counts. Unaccepted-draft overshoot sits at
-                    # positions > lengths, outside the window. Applied
-                    # to position 0 (the token being chosen this step);
-                    # penalty slots never accept drafts (below), so
-                    # positions 1..G stay penalty-free verify lanes.
-                    # The penalized view steers CHOICE only (argmax /
-                    # sampling); reported logprobs stay the model's raw
-                    # log p(token | prefix), matching how temperature /
-                    # top_p shape choice without reshaping logprobs.
-                    w_idx = jnp.arange(hist.shape[1], dtype=jnp.int32)[None, :]
-                    pen_valid = (w_idx >= gen_start[:, None]) & (
-                        w_idx <= lengths[:, None]
-                    )
-                    pen0 = apply_penalties(
-                        logits[:, 0], hist, pen_valid, presence, frequency
-                    )
-                else:
-                    pen0 = logits[:, 0]
-                pen0 = apply_logit_bias(pen0, bias_ids, bias_vals)
-                # Chosen-token logprob = raw logit - logsumexp: avoids
-                # materializing a normalized [B, G+1, V] tensor in the
-                # hottest loop just to gather G+1 entries.
-                lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, G+1]
-                yhat = jnp.argmax(logits, axis=-1).astype(jnp.int32)
-                yhat0_pen = jnp.argmax(pen0, axis=-1).astype(jnp.int32)
-                # Greedy slots accept the longest draft prefix the model
-                # agrees with (exactness by causality); sampled slots
-                # accept nothing and sample position 0 as before. Slots
-                # with any penalty also accept nothing: draft exactness
-                # is argmax-equivalence against the UNpenalized verify
-                # lanes, which a penalized distribution breaks.
-                greedy = temp <= 0.0
-                if G > 0:
-                    matches = (yhat[:, :G] == drafts).astype(jnp.int32)
-                    acc = jnp.cumprod(matches, axis=1).sum(axis=1)
-                    # Penalty/bias slots accept nothing: the verify
-                    # lanes (positions 1..G) are raw-argmax.
-                    no_pen = (
-                        (presence == 0.0)
-                        & (frequency == 0.0)
-                        & (bias_vals == 0.0).all(axis=1)
-                    )
-                    acc = jnp.where(greedy & active & no_pen, acc, 0)
-                else:
-                    acc = jnp.zeros((B,), jnp.int32)
-                step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
-                sampled0 = sample(
-                    pen0, step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk
-                )
-                # Greedy: position 0 picks from the penalized view
-                # (identical to raw when penalties are zero); accepted-
-                # draft positions (acc>0, only reachable penalty-free)
-                # pick from the raw verify lanes.
-                greedy_pick = jnp.where(
-                    acc > 0,
-                    jnp.take_along_axis(yhat, acc[:, None], axis=1)[:, 0],
-                    yhat0_pen,
-                )
-                corr = jnp.where(greedy, greedy_pick, sampled0)
-                corr = jnp.where(active, corr, last)
-                if G > 0:
-                    lp_d = (
-                        jnp.take_along_axis(
-                            logits[:, :G], drafts[:, :, None], axis=2
-                        )[:, :, 0]
-                        - lse[:, :G]
-                    )
-                else:
-                    lp_d = jnp.zeros((B, 0), jnp.float32)
-                logits_at_a = jnp.take_along_axis(logits, acc[:, None, None], axis=1)[:, 0]
-                lp_corr = (
-                    jnp.take_along_axis(logits_at_a, corr[:, None], axis=1)[:, 0]
-                    - jnp.take_along_axis(lse, acc[:, None], axis=1)[:, 0]
-                )
-                # Top-N alternatives per position (raw model dist, pre-
-                # penalty/bias — same contract as the chosen logprob).
-                t_raw, t_ids = jax.lax.top_k(logits, topn)  # [B, G+1, N]
-                t_lp = t_raw - lse[..., None]
-                lengths = jnp.where(active, lengths + acc + 1, lengths)
-                return (cache, hist, lengths, corr, step_keys[:, 1]), (
-                    drafts, corr, acc, lp_d, lp_corr,
-                    t_ids.astype(jnp.int32), t_lp,
-                )
-
-            (cache, hist, lengths, last, keys), (
-                d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
-            ) = jax.lax.scan(
-                body, (cache, hist, lengths, last_tokens, keys), None, length=K
-            )
-            return (
-                d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
-                cache, hist, lengths, last, jax.random.key_data(keys),
-            )
-
-        # adm_toks (prefill arg 11 / chunk arg 12) and the cache are
-        # donated through prefill calls; decode reads adm_toks without
-        # donating it (it survives until the next prefill overwrites it).
-        # Multi-process gangs pin out_shardings explicitly: the KV pool
-        # keeps its tp sharding, everything the host reads back must be
-        # fully replicated (device_get on a cross-process-sharded array
-        # has no local copy to fetch) — single-host leaves GSPMD free.
-        shard_kw = {}
-        chunk_kw = {}
-        if self._multiproc:
-            from jax.sharding import NamedSharding, PartitionSpec
-
-            from kubeai_tpu.parallel.sharding import paged_cache_specs
-
-            repl = NamedSharding(self._mesh, PartitionSpec())
-            cache_sh = {
-                k: NamedSharding(self._mesh, s)
-                for k, s in paged_cache_specs().items()
-            }
-            shard_kw = {
-                "out_shardings": (repl, repl, repl, repl, repl, repl, repl, cache_sh, repl, repl, repl, repl)
-            }
-            chunk_kw = {"out_shardings": (repl, repl, repl, repl, cache_sh, repl)}
-        self._prefill_chunk_jit = jax.jit(
-            prefill_chunk_fn, donate_argnums=(12, 13), **chunk_kw
+        sf = build_step_functions(
+            mc, self.cfg, n_valid, mesh=self._mesh, multiproc=self._multiproc
         )
-        self._prefill_batch_jit = jax.jit(
-            prefill_batch_fn, donate_argnums=(11, 12), **chunk_kw
-        )
-        # tables + per-slot request state (active/temp/top_p/top_k and
-        # the adm_* merge arrays) are host-authoritative numpy uploaded
-        # per dispatch — not donated. cache/hist/lengths/last/keys are
-        # the device carries. One jit per kernel flavor, built lazily
-        # (_decode_jit_for): the configured flavor compiles at warmup as
-        # before; a follower only pays for a second flavor if rank 0's
-        # broadcast actually asks for it.
-        if self.cfg.decode_kernel not in ("ragged", "dedicated", "auto"):
-            raise ValueError(
-                f"decode_kernel must be 'ragged', 'dedicated' or 'auto', "
-                f"got {self.cfg.decode_kernel!r}"
-            )
-        from kubeai_tpu.ops.paged_decode_attention import resolve_decode_kernel
-
-        self._decode_kernel = resolve_decode_kernel(
-            self.cfg.decode_kernel, 1 + self.cfg.speculate_tokens
-        )
-        self._decode_jits = {}
-        self._make_decode_jit = lambda kernel: jax.jit(
-            make_decode_fn(kernel), donate_argnums=(1, 3, 4, 5, 6), **shard_kw
-        )
+        self._step_fns = sf
+        self._prefill_chunk_jit = sf.prefill_chunk_jit
+        self._prefill_batch_jit = sf.prefill_batch_jit
+        self._decode_jits = sf.decode_jits
+        self._make_decode_jit = sf.make_decode_jit
+        self._decode_kernel = sf.decode_kernel
         self._decode_jit = self._decode_jit_for(self._decode_kernel)
+
+
 
     def _decode_jit_for(self, kernel: str):
         """The jitted decode step for a concrete kernel flavor, built on
@@ -898,6 +622,88 @@ class Engine:
             gauge.set_callback(fn)
         self._thread = threading.Thread(target=self._loop, name="engine-loop", daemon=True)
         self._thread.start()
+
+    def warmup(self, include_group: bool = True) -> dict:
+        """Pre-compile (or pre-load from the persistent compile cache)
+        every step-function shape the serving path hits: the decode
+        chunk, batch-1 cold prefill for every bucket, the group-cap
+        batch (cold bursts), and one chunked-prefill shape (long/reuse
+        prompts). Called BEFORE start()/serving so the first real
+        request never pays a compile; dispatches write only the KV
+        pool's trash page (tables all zero — the designed garbage sink)
+        and touch no slot bookkeeping. Single-host only: on a gang every
+        dispatch must be broadcast, and followers compile at replay."""
+        if self._multiproc or self._publisher is not None:
+            log.info("warmup skipped on a multi-host gang")
+            return {"shapes": 0, "skipped": "gang"}
+        B = self.cfg.max_slots
+        Kb = self.cfg.max_logit_bias
+        t0 = time.monotonic()
+        shapes = 0
+        # Decode chunk (the hot loop).
+        adm_hist = (
+            {"adm_hist": self._adm_hist.copy()}
+            if self.cfg.speculate_tokens > 0
+            else {}
+        )
+        (
+            *_,
+            self._cache, self._tok_hist, self._lengths,
+            self._last_tokens, self._keys,
+        ) = self._decode_jit(
+            self.params, self._cache, self._page_table.copy(), self._tok_hist,
+            self._lengths, self._last_tokens, self._keys,
+            self._h_active.copy(), self._h_temp.copy(), self._h_top_p.copy(),
+            self._h_top_k.copy(), self._h_presence.copy(), self._h_freq.copy(),
+            self._h_gen_start.copy(), self._h_bias_ids.copy(),
+            self._h_bias_vals.copy(), self._adm_mask.copy(),
+            self._adm_len.copy(), self._adm_seed.copy(), self._adm_toks,
+            **adm_hist,
+        )
+        shapes += 1
+        cap = max(1, min(self.cfg.prefill_group_cap, self.cfg.max_slots))
+        sizes = (1, cap) if include_group and cap > 1 else (1,)
+        for bucket in self.cfg.prefill_buckets:
+            for n_pad in sizes:
+                *_, self._cache, self._adm_toks = self._prefill_batch_jit(
+                    self.params,
+                    np.zeros((n_pad, bucket), np.int32),
+                    np.full((n_pad,), bucket, np.int32),
+                    np.zeros((n_pad, self._max_pages), np.int32),
+                    np.zeros((n_pad,), np.int32),
+                    np.zeros((n_pad,), np.uint32),
+                    np.ones((n_pad,), np.float32),
+                    np.ones((n_pad,), np.float32),
+                    np.zeros((n_pad,), np.int32),
+                    np.zeros((n_pad, Kb), np.int32),
+                    np.zeros((n_pad, Kb), np.float32),
+                    self._adm_toks,
+                    self._cache,
+                )
+                shapes += 1
+        max_bucket = max(self.cfg.prefill_buckets)
+        *_, self._cache, self._adm_toks = self._prefill_chunk_jit(
+            self.params,
+            np.zeros((1, max_bucket), np.int32),
+            np.int32(0),
+            np.int32(max_bucket - 1),
+            np.zeros((1, self._max_pages), np.int32),
+            np.int32(0),
+            np.uint32(0),
+            np.float32(1.0),
+            np.float32(1.0),
+            np.int32(0),
+            np.zeros((Kb,), np.int32),
+            np.zeros((Kb,), np.float32),
+            self._adm_toks,
+            self._cache,
+        )
+        shapes += 1
+        jax.block_until_ready(self._adm_toks)
+        dur = time.monotonic() - t0
+        self._update_recompile_counter()
+        log.info("engine warmup: %d shapes in %.1fs", shapes, dur)
+        return {"shapes": shapes, "seconds": round(dur, 3)}
 
     def stop(self):
         self._running = False
@@ -2478,12 +2284,359 @@ class Engine:
         )
 
 
+@dataclass
+class StepFunctions:
+    """The engine's jitted step functions, built OUTSIDE the Engine so
+    the cold-start warm compiler (engine/coldstart.py) can construct
+    byte-identical programs from config alone — AOT-compiling these with
+    abstract args populates the persistent compile cache the engine's
+    own first dispatches then hit."""
+
+    prefill_batch_jit: Any
+    prefill_chunk_jit: Any
+    decode_jits: dict
+    make_decode_jit: Any
+    decode_kernel: str
+
+    def decode_jit_for(self, kernel: str):
+        fn = self.decode_jits.get(kernel)
+        if fn is None:
+            fn = self.decode_jits[kernel] = self.make_decode_jit(kernel)
+        return fn
+
+
+def build_step_functions(
+    model_config: ModelConfig,
+    engine_config: EngineConfig,
+    n_valid_vocab: int | None = None,
+    mesh=None,
+    multiproc: bool = False,
+) -> StepFunctions:
+    """Build the jitted prefill/decode step functions for a config pair.
+
+    Extracted from Engine so the SAME traced programs can be compiled
+    ahead of time (loader warm, parked replicas, compile/load overlap):
+    identical closures + identical argument shapes ⇒ identical HLO ⇒
+    persistent-compile-cache hits when the real engine first dispatches.
+    *n_valid_vocab* is the tokenizer's vocab (logits beyond it are
+    masked); defaults to the model vocab (no padding mask)."""
+    mc = model_config
+    cfg = engine_config
+    if cfg.decode_kernel not in ("ragged", "dedicated", "auto"):
+        raise ValueError(
+            f"decode_kernel must be 'ragged', 'dedicated' or 'auto', "
+            f"got {cfg.decode_kernel!r}"
+        )
+    n_valid = mc.vocab_size if n_valid_vocab is None else min(n_valid_vocab, mc.vocab_size)
+
+    def mask_pad(logits):
+        if n_valid < mc.vocab_size:
+            return logits.at[..., n_valid:].set(-jnp.inf)
+        return logits
+
+    mtk = cfg.max_top_k
+    topn = max(1, cfg.top_logprobs_k)
+
+    def prefill_batch_fn(params, tokens, lengths, tables, slots, seeds, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_rows=None):
+        """Cold prefill for N requests in ONE call (N is a static pad
+        size — 1 for steady-state singles, max_slots for cold
+        bursts): tokens [N, S] land in the pages of *tables*
+        [N, max_pages]. Sampled first tokens are scattered into the
+        device staging vector adm_toks[slots] so the NEXT decode
+        dispatch can merge them in-graph without a host round-trip
+        (padding duplicates the last row: same slot, same value —
+        benign). PRNG keys derive from uint32 *seeds* in-graph, so
+        every argument arrives as plain numpy riding the dispatch."""
+        keys = jax.vmap(jax.random.key)(seeds)
+        logits, cache = llama.prefill_paged_cold(
+            params, mc, tokens, cache, tables, lengths,
+            lora=lora, lora_rows=lora_rows,
+        )
+        masked = mask_pad(logits[:, -1])
+        # Bias steers choice; the reported logprob stays the model's
+        # raw log p (same contract as decode).
+        toks = sample(
+            apply_logit_bias(masked, bias_ids, bias_vals),
+            keys, temp, top_p, top_k, max_top_k=mtk,
+        )
+        logp = jax.nn.log_softmax(masked, axis=-1)
+        lps = jnp.take_along_axis(logp, toks[:, None], axis=1)[:, 0]
+        t_lp, t_ids = jax.lax.top_k(logp, topn)
+        adm_toks = adm_toks.at[slots].set(toks)
+        return toks, lps, t_ids.astype(jnp.int32), t_lp, cache, adm_toks
+
+    def prefill_chunk_fn(params, tokens, start, last_idx, table, slot, seed, temp, top_p, top_k, bias_ids, bias_vals, adm_toks, cache, lora=None, lora_row=None):
+        """One chunk of a long or prefix-resuming prompt."""
+        key = jax.random.key(seed)
+        logits, cache = llama.prefill_paged(
+            params, mc, tokens, cache, table, start[None], last_idx[None],
+            lora=lora,
+            lora_rows=None if lora_row is None else lora_row[None],
+        )
+        masked = mask_pad(logits[:, -1])
+        tok = sample(
+            apply_logit_bias(masked, bias_ids[None], bias_vals[None]),
+            key[None], temp[None], top_p[None], top_k[None], max_top_k=mtk,
+        )[0]
+        logp = jax.nn.log_softmax(masked, axis=-1)
+        lp = logp[0, tok]
+        t_lp, t_ids = jax.lax.top_k(logp[0], topn)
+        adm_toks = adm_toks.at[slot].set(tok)
+        return tok, lp, t_ids.astype(jnp.int32), t_lp, cache, adm_toks
+
+    K = cfg.decode_chunk
+    G = cfg.speculate_tokens
+
+    def ngram_drafts(hist, lengths, last):
+        """Per-slot 2-gram continuation lookup over the device token
+        history: find the latest previous occurrence of the bigram
+        (hist[L-1], last) and propose the G tokens that followed it.
+        No match (or tail too short) proposes zeros, which simply
+        fail verification. All shapes static; runs inside the scan."""
+        Sh = hist.shape[1]
+        idx = jnp.arange(Sh)
+
+        def one(h, L, a):
+            prev = h[jnp.maximum(L - 1, 0)]
+            nxt = jnp.roll(h, -1)  # nxt[j] = h[j+1]
+            ok = (h == prev) & (nxt == a) & (idx < L - 1) & (L > 0)
+            found = ok.any()
+            j = jnp.argmax(jnp.where(ok, idx, -1))
+            didx = j + 2 + jnp.arange(G)
+            valid = found & (didx < L)
+            return jnp.where(valid, h[jnp.clip(didx, 0, Sh - 1)], 0)
+
+        return jax.vmap(one)(hist, lengths, last)
+
+    penalties_on = cfg.enable_penalties
+
+    def make_decode_fn(decode_kernel: str):
+        """Decode step builder, parameterized by the CONCRETE paged-
+        attention kernel ("ragged" | "dedicated") baked into the
+        trace. Rank 0 resolves EngineConfig.decode_kernel once and
+        broadcasts the resolution with every decode op; a follower
+        whose own config disagrees compiles the broadcast flavor
+        (gang lockstep: all ranks must run the same program)."""
+        return partial(decode_fn, _decode_kernel=decode_kernel)
+
+    def decode_fn(params, cache, tables, hist, lengths, last_tokens, keys, active, temp, top_p, top_k, presence, frequency, gen_start, bias_ids, bias_vals, adm_mask, adm_len, adm_seed, adm_toks, adm_hist=None, lora=None, lora_rows=None, _decode_kernel="ragged"):
+        """K fused decode steps, each verifying up to G drafts.
+        Returns (drafts [K, B, G], corr [K, B], accepted [K, B]) —
+        the host emits drafts[:a] + [corr] per slot per step, where
+        corr is THE device-chosen next token (greedy: the model's
+        argmax after the accepted drafts; sampled: the sampled
+        token — never substitute argmax, the device decodes from
+        corr so emission must match it). G=0 reduces exactly to
+        one-token-per-step decoding.
+
+        Slots admitted since the last dispatch are REBASED in-graph
+        (adm_mask/adm_len/adm_seed numpy from the host; adm_toks the
+        device staging vector the prefill scattered its sample into)
+        — admission therefore requires zero eager device mutation
+        and the dispatch never waits on a first-token host sync."""
+        B = lengths.shape[0]
+        adm_keys = jax.vmap(
+            lambda s: jax.random.fold_in(jax.random.key(s), 1)
+        )(adm_seed)
+        # *keys* arrives as raw uint32 key data (see mk_device_arrays)
+        # and is wrapped here; returned as raw data again below.
+        keys = jax.random.wrap_key_data(
+            jnp.where(
+                adm_mask[:, None],
+                jax.random.key_data(adm_keys),
+                keys,
+            )
+        )
+        lengths = jnp.where(adm_mask, adm_len, lengths)
+        last_tokens = jnp.where(adm_mask, adm_toks, last_tokens)
+        if G > 0:
+            hist = jnp.where(adm_mask[:, None], adm_hist, hist)
+
+        def body(carry, _):
+            cache, hist, lengths, last, keys = carry
+            if G > 0:
+                drafts = ngram_drafts(hist, lengths, last)
+            else:
+                drafts = jnp.zeros((B, 0), jnp.int32)
+            inputs = jnp.concatenate([last[:, None], drafts], axis=1)
+            # Record the inputs this step WRITES into KV at positions
+            # lengths..lengths+G (history width covers overshoot) —
+            # BEFORE the penalty window is read, so position
+            # `lengths` (= the previously emitted token, this step's
+            # input) is already in the history when penalties count
+            # it (ADVICE r5: computing penalties first lagged them
+            # one token — the most recent token's first immediate
+            # repeat went unpenalized, off OpenAI/vLLM semantics).
+            pos = lengths[:, None] + jnp.arange(G + 1, dtype=jnp.int32)
+            hist = hist.at[jnp.arange(B)[:, None], pos].set(
+                jnp.where(active[:, None], inputs, jnp.take_along_axis(hist, pos, axis=1))
+            )
+            logits, cache = llama.decode_speculative_paged(
+                params, mc, inputs, cache, tables, lengths,
+                lora=lora, lora_rows=lora_rows,
+                decode_kernel=_decode_kernel,
+            )
+            logits = mask_pad(logits)  # [B, G+1, V]
+            if penalties_on:
+                # OpenAI presence/frequency penalties over the
+                # GENERATED window of the device token history —
+                # [gen_start, lengths] INCLUSIVE: position `lengths`
+                # holds this step's input (the token emitted last
+                # step, just scattered above), so the full output so
+                # far counts. Unaccepted-draft overshoot sits at
+                # positions > lengths, outside the window. Applied
+                # to position 0 (the token being chosen this step);
+                # penalty slots never accept drafts (below), so
+                # positions 1..G stay penalty-free verify lanes.
+                # The penalized view steers CHOICE only (argmax /
+                # sampling); reported logprobs stay the model's raw
+                # log p(token | prefix), matching how temperature /
+                # top_p shape choice without reshaping logprobs.
+                w_idx = jnp.arange(hist.shape[1], dtype=jnp.int32)[None, :]
+                pen_valid = (w_idx >= gen_start[:, None]) & (
+                    w_idx <= lengths[:, None]
+                )
+                pen0 = apply_penalties(
+                    logits[:, 0], hist, pen_valid, presence, frequency
+                )
+            else:
+                pen0 = logits[:, 0]
+            pen0 = apply_logit_bias(pen0, bias_ids, bias_vals)
+            # Chosen-token logprob = raw logit - logsumexp: avoids
+            # materializing a normalized [B, G+1, V] tensor in the
+            # hottest loop just to gather G+1 entries.
+            lse = jax.scipy.special.logsumexp(logits, axis=-1)  # [B, G+1]
+            yhat = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+            yhat0_pen = jnp.argmax(pen0, axis=-1).astype(jnp.int32)
+            # Greedy slots accept the longest draft prefix the model
+            # agrees with (exactness by causality); sampled slots
+            # accept nothing and sample position 0 as before. Slots
+            # with any penalty also accept nothing: draft exactness
+            # is argmax-equivalence against the UNpenalized verify
+            # lanes, which a penalized distribution breaks.
+            greedy = temp <= 0.0
+            if G > 0:
+                matches = (yhat[:, :G] == drafts).astype(jnp.int32)
+                acc = jnp.cumprod(matches, axis=1).sum(axis=1)
+                # Penalty/bias slots accept nothing: the verify
+                # lanes (positions 1..G) are raw-argmax.
+                no_pen = (
+                    (presence == 0.0)
+                    & (frequency == 0.0)
+                    & (bias_vals == 0.0).all(axis=1)
+                )
+                acc = jnp.where(greedy & active & no_pen, acc, 0)
+            else:
+                acc = jnp.zeros((B,), jnp.int32)
+            step_keys = jax.vmap(lambda k: jax.random.split(k, 2))(keys)
+            sampled0 = sample(
+                pen0, step_keys[:, 0], temp, top_p, top_k, max_top_k=mtk
+            )
+            # Greedy: position 0 picks from the penalized view
+            # (identical to raw when penalties are zero); accepted-
+            # draft positions (acc>0, only reachable penalty-free)
+            # pick from the raw verify lanes.
+            greedy_pick = jnp.where(
+                acc > 0,
+                jnp.take_along_axis(yhat, acc[:, None], axis=1)[:, 0],
+                yhat0_pen,
+            )
+            corr = jnp.where(greedy, greedy_pick, sampled0)
+            corr = jnp.where(active, corr, last)
+            if G > 0:
+                lp_d = (
+                    jnp.take_along_axis(
+                        logits[:, :G], drafts[:, :, None], axis=2
+                    )[:, :, 0]
+                    - lse[:, :G]
+                )
+            else:
+                lp_d = jnp.zeros((B, 0), jnp.float32)
+            logits_at_a = jnp.take_along_axis(logits, acc[:, None, None], axis=1)[:, 0]
+            lp_corr = (
+                jnp.take_along_axis(logits_at_a, corr[:, None], axis=1)[:, 0]
+                - jnp.take_along_axis(lse, acc[:, None], axis=1)[:, 0]
+            )
+            # Top-N alternatives per position (raw model dist, pre-
+            # penalty/bias — same contract as the chosen logprob).
+            t_raw, t_ids = jax.lax.top_k(logits, topn)  # [B, G+1, N]
+            t_lp = t_raw - lse[..., None]
+            lengths = jnp.where(active, lengths + acc + 1, lengths)
+            return (cache, hist, lengths, corr, step_keys[:, 1]), (
+                drafts, corr, acc, lp_d, lp_corr,
+                t_ids.astype(jnp.int32), t_lp,
+            )
+
+        (cache, hist, lengths, last, keys), (
+            d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
+        ) = jax.lax.scan(
+            body, (cache, hist, lengths, last_tokens, keys), None, length=K
+        )
+        return (
+            d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq,
+            cache, hist, lengths, last, jax.random.key_data(keys),
+        )
+
+    # adm_toks (prefill arg 11 / chunk arg 12) and the cache are
+    # donated through prefill calls; decode reads adm_toks without
+    # donating it (it survives until the next prefill overwrites it).
+    # Multi-process gangs pin out_shardings explicitly: the KV pool
+    # keeps its tp sharding, everything the host reads back must be
+    # fully replicated (device_get on a cross-process-sharded array
+    # has no local copy to fetch) — single-host leaves GSPMD free.
+    shard_kw = {}
+    chunk_kw = {}
+    if multiproc:
+        from jax.sharding import NamedSharding, PartitionSpec
+
+        from kubeai_tpu.parallel.sharding import paged_cache_specs
+
+        repl = NamedSharding(mesh, PartitionSpec())
+        cache_sh = {
+            k: NamedSharding(mesh, s)
+            for k, s in paged_cache_specs().items()
+        }
+        shard_kw = {
+            "out_shardings": (repl, repl, repl, repl, repl, repl, repl, cache_sh, repl, repl, repl, repl)
+        }
+        chunk_kw = {"out_shardings": (repl, repl, repl, repl, cache_sh, repl)}
+    # tables + per-slot request state (active/temp/top_p/top_k and
+    # the adm_* merge arrays) are host-authoritative numpy uploaded
+    # per dispatch — not donated. cache/hist/lengths/last/keys are
+    # the device carries. One jit per kernel flavor, built lazily
+    # (decode_jit_for): the configured flavor compiles at warmup as
+    # before; a follower only pays for a second flavor if rank 0's
+    # broadcast actually asks for it.
+    from kubeai_tpu.ops.paged_decode_attention import resolve_decode_kernel
+
+    return StepFunctions(
+        prefill_batch_jit=jax.jit(
+            prefill_batch_fn, donate_argnums=(11, 12), **chunk_kw
+        ),
+        prefill_chunk_jit=jax.jit(
+            prefill_chunk_fn, donate_argnums=(12, 13), **chunk_kw
+        ),
+        decode_jits={},
+        make_decode_jit=lambda kernel: jax.jit(
+            make_decode_fn(kernel), donate_argnums=(1, 3, 4, 5, 6), **shard_kw
+        ),
+        decode_kernel=resolve_decode_kernel(
+            cfg.decode_kernel, 1 + cfg.speculate_tokens
+        ),
+    )
+
+
 def build_test_engine(
     engine_config: EngineConfig | None = None, seed: int = 0, model_config: ModelConfig | None = None
 ) -> Engine:
     """A tiny randomly-initialized byte-vocab engine for tests/dev — the
     in-process analogue of the reference's mock engine seam."""
+    from kubeai_tpu.engine.coldstart import setup_compile_cache
     from kubeai_tpu.engine.tokenizer import ByteTokenizer
+
+    # In-process engines honor the shared compile cache too (no-op
+    # unless KUBEAI_COMPILE_CACHE is set).
+    setup_compile_cache()
 
     tok = ByteTokenizer()
     mc = model_config or ModelConfig(

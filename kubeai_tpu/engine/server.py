@@ -47,14 +47,25 @@ RETRY_AFTER_HINT = "1"
 class EngineServer:
     def __init__(
         self,
-        engine: Engine,
+        engine: Engine | None,
         model_name: str,
         host: str = "0.0.0.0",
         port: int = 8000,
         drain_grace: float = 30.0,
     ):
+        # engine=None is a PARKED replica: the process holds warmed
+        # compiled programs (shared compile cache + --park-config) but
+        # no weights; /readyz stays 503 until a POST /v1/attach streams
+        # a model in and flips it ready. Scale-from-zero attaches to a
+        # parked pod instead of cold-spawning a process.
         self.engine = engine
         self.model_name = model_name
+        self._attach_lock = threading.Lock()
+        self._attach_state = "parked" if engine is None else "attached"
+        # Park-time --park-config warm in flight (BackgroundWarm):
+        # attach joins it first, so an early attach can't duplicate the
+        # same compilations concurrently.
+        self.park_warm = None
         self.adapters: dict[str, str] = {}  # name -> path
         self._adapters_lock = threading.Lock()
         # Graceful drain: once set, /readyz goes 503 (k8s stops routing),
@@ -74,9 +85,13 @@ class EngineServer:
         self._thread: threading.Thread | None = None
 
     def start(self):
-        self.engine.start()
+        if self.engine is not None:
+            self.engine.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
+        tl = getattr(self.engine, "cold_start_timeline", None)
+        if tl is not None:
+            tl.ready()
         log.info("engine server for %s on :%d", self.model_name, self.port)
 
     def stop(self):
@@ -93,7 +108,8 @@ class EngineServer:
             self._stopped = True
         self.draining.set()
         try:
-            self.engine.stop()
+            if self.engine is not None:
+                self.engine.stop()
         finally:
             self.httpd.shutdown()
             self.stopped_event.set()
@@ -103,6 +119,8 @@ class EngineServer:
         up to the drain budget, then stop() (which fails the rest)."""
         grace = self.drain_grace if grace is None else grace
         self.draining.set()
+        if self.engine is None:
+            return self.stop()  # parked: nothing in flight by definition
         log.info(
             "engine draining: %d active slots, %d queued, grace %.1fs",
             self.engine.active_slots(), self.engine.queue_depth(), grace,
@@ -129,6 +147,59 @@ class EngineServer:
             threading.Thread(target=self.drain, daemon=True).start()
 
         signal.signal(signal.SIGTERM, _on_term)
+
+    def attach(self, args_list: list[str], warmup: bool | None = None) -> tuple[bool, str]:
+        """Attach a model to a parked replica: parse engine-server args
+        (the Model's pod args), stream the weights in on a worker
+        thread, warm up, and flip /readyz — the scale-from-zero path
+        that skips process spawn + jax init + (with a warm cache)
+        compilation. Returns (accepted, message); the load itself is
+        asynchronous, readiness is the completion signal."""
+        with self._attach_lock:
+            if self.engine is not None:
+                return False, f"model {self.model_name!r} already attached"
+            if self._attach_state == "attaching":
+                return False, "attach already in progress"
+            self._attach_state = "attaching"
+        if warmup is None:
+            # Warm up by default: the parked pod exists to make ready
+            # mean ready — with a park-warmed cache the warmup is reads.
+            warmup = os.environ.get("KUBEAI_ATTACH_WARMUP", "1") == "1"
+
+        def run():
+            try:
+                if self.park_warm is not None:
+                    # Let the park-time warm finish writing the cache:
+                    # building now would re-compile the same programs
+                    # concurrently instead of reading them.
+                    self.park_warm.join()
+                parser = make_engine_arg_parser(require_model=True)
+                a, unknown = parser.parse_known_args(args_list)
+                if unknown:
+                    log.info("attach ignoring unknown args: %s", unknown)
+                if a.model is None:
+                    raise ValueError("attach args must include --model")
+                engine, name = build_engine_from_args(a, warmup=warmup)
+                engine.start()
+                with self._attach_lock:
+                    self.model_name = name
+                    self.engine = engine
+                    self._attach_state = "attached"
+                tl = getattr(engine, "cold_start_timeline", None)
+                if tl is not None:
+                    tl.ready()
+                log.info("parked replica attached model %s", name)
+            except BaseException as e:  # incl. argparse SystemExit
+                log.exception("attach failed")
+                with self._attach_lock:
+                    # Failed attaches are retryable: the pod stays
+                    # not-ready (visible in /readyz + /health), the
+                    # controller/operator decides whether to retry the
+                    # attach or delete the pod.
+                    self._attach_state = f"failed: {e}"
+
+        threading.Thread(target=run, name="engine-attach", daemon=True).start()
+        return True, "attaching"
 
     _ADAPTER_NAME_RE = re.compile(r"^[A-Za-z0-9][A-Za-z0-9._-]{0,128}$")
 
@@ -214,14 +285,21 @@ def _make_handler(srv: EngineServer):
         def do_GET(self):
             path, _, query = self.path.partition("?")
             if path in ("/health", "/healthz"):
-                self._json(200, {"status": "ok", "model": srv.model_name})
+                body = {"status": "ok", "model": srv.model_name}
+                if srv.engine is None:
+                    body["parked"] = True
+                    body["attach"] = srv._attach_state
+                self._json(200, body)
             elif path == "/readyz":
                 # Readiness is distinct from liveness: not-ready until
                 # the engine's scheduler loop is accepting work, so k8s
                 # probes stop routing to pods whose engine is down — and
                 # 503 the moment a drain starts, so routing stops BEFORE
                 # the pod disappears.
-                if srv.draining.is_set():
+                if srv.engine is None:
+                    # Parked (or mid-attach): alive but serving nothing.
+                    self._json(503, {"status": "parked", "attach": srv._attach_state})
+                elif srv.draining.is_set():
                     self._json(503, {"status": "draining", "model": srv.model_name})
                 elif srv.engine.is_ready():
                     self._json(200, {"status": "ok", "model": srv.model_name})
@@ -311,6 +389,21 @@ def _make_handler(srv: EngineServer):
                 # Drain admission stop: in-flight work finishes, new work
                 # goes elsewhere (the proxy retries another replica).
                 return self._saturated("server is draining")
+            if path == "/v1/attach":
+                # Parked-replica attach: args are the engine pod's CLI
+                # args (Model.spec.args included); model/served_model_name
+                # are accepted as conveniences for hand-driven attaches.
+                args_list = [str(x) for x in (body.get("args") or [])]
+                if body.get("model") and "--model" not in args_list:
+                    args_list = ["--model", str(body["model"])] + args_list
+                if body.get("served_model_name") and "--served-model-name" not in args_list:
+                    args_list += ["--served-model-name", str(body["served_model_name"])]
+                ok, msg = srv.attach(args_list)
+                return self._json(202 if ok else 409, {"status": msg})
+            if srv.engine is None and path.startswith("/v1/"):
+                return self._error(
+                    503, "no model attached (parked replica)", "service_unavailable"
+                )
             try:
                 if path == "/v1/completions":
                     self._completions(
@@ -907,10 +1000,14 @@ def _resolve_model_path(model: str) -> str:
     )
 
 
-def build_engine_from_args(args, publisher=None) -> tuple[Engine, str]:
-    from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+def engine_config_from_args(args):
+    """EngineConfig from a parsed engine-server arg namespace. Shared
+    with the cold-start warm compiler (loader --warm-compile-cache,
+    parked --park-config) so warmed shapes can never drift from what a
+    serving pod started with the same args would run."""
+    from kubeai_tpu.engine.core import EngineConfig
 
-    ec = EngineConfig(
+    return EngineConfig(
         max_slots=args.max_slots,
         max_seq_len=args.max_seq_len,
         page_size=getattr(args, "page_size", 64),
@@ -920,19 +1017,31 @@ def build_engine_from_args(args, publisher=None) -> tuple[Engine, str]:
         kv_cache_dtype=getattr(args, "kv_cache_dtype", ""),
         decode_kernel=getattr(args, "decode_kernel", "ragged"),
     )
+
+
+def build_engine_from_args(args, publisher=None, warmup: bool | None = None) -> tuple[Engine, str]:
+    from kubeai_tpu.engine.core import build_test_engine
+
+    ec = engine_config_from_args(args)
     if args.model.startswith("test:"):
         eng = build_test_engine(engine_config=ec)
         return eng, args.served_model_name or args.model
     # Real checkpoint path: HF-format directory with config.json +
     # safetensors weights; remote URLs are staged to local disk first.
+    from kubeai_tpu.engine.coldstart import ColdStartTimeline
     from kubeai_tpu.engine.weights import load_engine_from_path
 
+    timeline = ColdStartTimeline().install()
+    with timeline.phase("stage"):
+        local_path = _resolve_model_path(args.model)
     eng = load_engine_from_path(
-        _resolve_model_path(args.model),
+        local_path,
         ec,
         tp=args.tensor_parallel_size,
         quantization=args.quantization,
         publisher=publisher,
+        timeline=timeline,
+        warmup=warmup,
     )
     return eng, args.served_model_name or args.model
 
@@ -1045,30 +1154,17 @@ def run_follower(args, hosts: list[str]) -> None:
     log.info("gang follower exiting")
 
 
-def main(argv=None):
-    # Honor JAX_PLATFORMS explicitly: plugin registration can override the
-    # env var, and config only works before the first backend query.
-    import os
-
-    want = os.environ.get("JAX_PLATFORMS")
-    if want:
-        import jax
-
-        jax.config.update("jax_platforms", want)
-    cache_dir = os.environ.get("KUBEAI_COMPILE_CACHE")
-    if cache_dir:
-        # Persistent XLA compilation cache: replicas of the same model
-        # shape skip recompilation (big cold-start cut when the cache dir
-        # is a shared mount; harmless otherwise).
-        import jax
-
-        os.makedirs(cache_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", cache_dir)
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1)
-    gang_hosts = maybe_init_distributed()
-
+def make_engine_arg_parser(require_model: bool = True) -> argparse.ArgumentParser:
+    """The engine pod's CLI parser. Also consumed (require_model=False)
+    by the loader's --warm-compile-cache step and the parked replica's
+    attach path, which both parse Model.spec.args with it — one parser,
+    so engine-shape defaults can never drift between warming and
+    serving."""
     parser = argparse.ArgumentParser("kubeai-tpu-engine")
-    parser.add_argument("--model", required=True, help="checkpoint dir or test:tiny")
+    parser.add_argument(
+        "--model", required=require_model, default=None,
+        help="checkpoint dir or test:tiny",
+    )
     parser.add_argument("--served-model-name", default=None)
     parser.add_argument("--host", default="0.0.0.0")
     parser.add_argument("--port", type=int, default=8000)
@@ -1110,8 +1206,85 @@ def main(argv=None):
         help="seconds SIGTERM lets in-flight generations finish before "
              "the hard stop (keep below terminationGracePeriodSeconds)",
     )
+    parser.add_argument(
+        "--warmup", action="store_true",
+        default=os.environ.get("KUBEAI_ENGINE_WARMUP", "0") == "1",
+        help="pre-dispatch every step-function shape before serving "
+             "(cheap with a warm KUBEAI_COMPILE_CACHE; the first real "
+             "request then never pays a compile)",
+    )
+    parser.add_argument(
+        "--parked", action="store_true",
+        help="start with NO model: hold compiled programs (via "
+             "--park-config + the shared compile cache) and wait for a "
+             "POST /v1/attach to stream weights in — scale-from-zero "
+             "attaches to a parked pod instead of cold-spawning",
+    )
+    parser.add_argument(
+        "--park-config", default=os.environ.get("KUBEAI_PARK_CONFIG", ""),
+        help="checkpoint dir (config.json + tokenizer) whose shapes a "
+             "parked replica AOT-compiles at park time",
+    )
+    return parser
+
+
+def main(argv=None):
+    # Honor JAX_PLATFORMS explicitly: plugin registration can override the
+    # env var, and config only works before the first backend query.
+    import os
+
+    want = os.environ.get("JAX_PLATFORMS")
+    if want:
+        import jax
+
+        jax.config.update("jax_platforms", want)
+    # Persistent XLA compilation cache: replicas of the same model shape
+    # skip recompilation (big cold-start cut when the cache dir is a
+    # shared mount; harmless otherwise). Shared helper — the follower
+    # path, bench harnesses, and in-process engines use the same one.
+    from kubeai_tpu.engine.coldstart import setup_compile_cache
+
+    setup_compile_cache()
+    gang_hosts = maybe_init_distributed()
+
+    parser = make_engine_arg_parser(require_model=False)
     args = parser.parse_args(argv)
+    if not args.parked and not args.model:
+        parser.error("--model is required (unless --parked)")
     logging.basicConfig(level=logging.INFO)
+
+    if args.parked:
+        if gang_hosts:
+            parser.error("--parked is not supported on multi-host gangs")
+        srv = EngineServer(
+            None, "(parked)", host=args.host, port=args.port,
+            drain_grace=args.drain_grace,
+        )
+        if args.park_config:
+            # Park-time warm: AOT-compile the expected model's step
+            # functions so the eventual attach (and every sibling
+            # replica sharing the compile cache) pays disk reads, not
+            # XLA. Background — the attach endpoint is live meanwhile —
+            # but registered BEFORE the server accepts attaches, so an
+            # early attach joins it instead of racing the compiles.
+            import sys
+
+            from kubeai_tpu.engine.coldstart import BackgroundWarm, warm_from_checkpoint
+
+            raw = argv if argv is not None else sys.argv[1:]
+            park_args = [a for a in raw if a != "--parked"]
+            srv.park_warm = BackgroundWarm(
+                lambda: warm_from_checkpoint(args.park_config, park_args)
+            )
+        srv.install_signal_handlers()
+        srv.start()
+        log.info("parked replica on :%d (awaiting /v1/attach)", srv.port)
+        try:
+            while not srv.stopped_event.is_set():
+                srv.stopped_event.wait(3600)
+        except KeyboardInterrupt:
+            srv.stop()
+        return
 
     publisher = None
     if gang_hosts and args.model.startswith("test:"):
@@ -1133,7 +1306,9 @@ def main(argv=None):
             len(gang_hosts) - 1, port=_gang_port(), secret=_gang_secret()
         )
 
-    engine, name = build_engine_from_args(args, publisher=publisher)
+    engine, name = build_engine_from_args(
+        args, publisher=publisher, warmup=args.warmup
+    )
     if publisher is not None:
         # Gang assembly: block until every follower is wired up before
         # serving (a dispatch before that would strand the followers).

@@ -89,6 +89,169 @@ def quantize_model_params(params: dict, config: ModelConfig) -> dict:
     return out
 
 
+def apply_backend_flags(config: ModelConfig) -> ModelConfig:
+    """Backend-dependent serving flags (TPU: flash prefill + paged
+    kernel). Shared by load_engine_from_path AND the AOT warm compiler
+    (coldstart.warm_from_checkpoint) — a warmer that skipped these
+    would trace different programs on TPU and every warmed cache entry
+    would silently miss."""
+    if jax.default_backend() == "tpu":
+        return config.replace(
+            use_flash_prefill=True,
+            use_paged_kernel=config.sliding_window == 0,
+        )
+    return config
+
+
+class SafetensorsSource:
+    """Random-access view over a checkpoint's *.safetensors shards:
+    opens every shard (header reads only — tensor data stays on disk
+    until asked for) and serves tensors by name. The streaming loader's
+    read side: one parameter group's tensors are materialized at a
+    time, so peak host memory is one stacked group, not the model."""
+
+    def __init__(self, path: str):
+        from safetensors import safe_open
+
+        self.files = sorted(glob.glob(os.path.join(path, "*.safetensors")))
+        if not self.files:
+            raise FileNotFoundError(f"no *.safetensors under {path}")
+        self._readers = [safe_open(f, framework="np") for f in self.files]
+        self._index = {
+            name: r for r in self._readers for name in r.keys()
+        }
+
+    def __contains__(self, name: str) -> bool:
+        return name in self._index
+
+    def get(self, name: str) -> np.ndarray:
+        return self._index[name].get_tensor(name)
+
+    def names(self):
+        return self._index.keys()
+
+
+def stream_params_from_hf(
+    source: "SafetensorsSource",
+    config: ModelConfig,
+    tp: int = 1,
+    quantization: str = "",
+    mesh=None,
+) -> tuple[dict, ModelConfig]:
+    """Streaming counterpart of params_from_hf + pad_vocab +
+    quantize_model_params: each parameter group (one stacked-layer
+    weight, the embedding, the head) is read, converted, vocab-padded,
+    quantized, and device_put with its target sharding BEFORE the next
+    group is touched — host memory peaks at one group instead of the
+    whole state dict + the converted tree + the pad copy coexisting,
+    and HBM starts filling while the tail of the checkpoint is still
+    being read. Returns (device params, config with the padded vocab).
+
+    Single-process only (a gang rank must assemble global arrays from
+    the full host tree — load_engine_from_path falls back there)."""
+    from jax.sharding import NamedSharding
+
+    from kubeai_tpu.ops.quant import quantize, quantize_rows
+
+    from kubeai_tpu.engine.coldstart import padded_vocab_size
+
+    dtype = jnp.dtype(config.dtype)
+    L = config.num_layers
+    V = config.vocab_size
+    pad = padded_vocab_size(V, tp) - V
+    out_config = config.replace(vocab_size=V + pad) if pad else config
+    specs = llama_param_specs(out_config) if mesh is not None else None
+    quant_dense = ("wq", "wk", "wv", "wo") + (
+        () if config.num_experts > 0 else ("wg", "wu", "wd")
+    )
+
+    def put(host, *key_path):
+        """Convert + (maybe) quantize + device_put ONE group, with its
+        target sharding when a tp mesh is given."""
+        if quantization == "int8":
+            if key_path == ("embed",):
+                host = quantize_rows(host)
+            elif key_path == ("lm_head",) or (
+                len(key_path) == 2 and key_path[1] in quant_dense
+            ):
+                host = quantize(host, contract_axis=-2)
+        if mesh is not None:
+            spec = specs
+            for k in key_path:
+                spec = spec[k]
+            return jax.device_put(host, NamedSharding(mesh, spec))
+        return jax.device_put(host)
+
+    def conv(a):
+        return np.asarray(a, dtype)
+
+    def stack(fmt, transpose=True):
+        ws = [np.asarray(source.get(fmt.format(i))) for i in range(L)]
+        return conv(np.stack([w.T if transpose else w for w in ws]))
+
+    embed = conv(np.asarray(source.get("model.embed_tokens.weight")))
+    if pad:
+        embed = np.pad(embed, ((0, pad), (0, 0)))
+    params: dict = {
+        "embed": put(embed, "embed"),
+        "final_norm": put(conv(np.asarray(source.get("model.norm.weight"))), "final_norm"),
+    }
+    del embed
+    layers: dict = {}
+
+    def put_layer(key, fmt, transpose=True):
+        layers[key] = put(stack(fmt, transpose=transpose), "layers", key)
+
+    put_layer("ln1", "model.layers.{}.input_layernorm.weight", transpose=False)
+    put_layer("wq", "model.layers.{}.self_attn.q_proj.weight")
+    put_layer("wk", "model.layers.{}.self_attn.k_proj.weight")
+    put_layer("wv", "model.layers.{}.self_attn.v_proj.weight")
+    put_layer("wo", "model.layers.{}.self_attn.o_proj.weight")
+    if config.qkv_bias:
+        put_layer("bq", "model.layers.{}.self_attn.q_proj.bias", transpose=False)
+        put_layer("bk", "model.layers.{}.self_attn.k_proj.bias", transpose=False)
+        put_layer("bv", "model.layers.{}.self_attn.v_proj.bias", transpose=False)
+    if config.post_norms:
+        put_layer("ln1b", "model.layers.{}.post_attention_layernorm.weight", transpose=False)
+        put_layer("ln2", "model.layers.{}.pre_feedforward_layernorm.weight", transpose=False)
+        put_layer("ln2b", "model.layers.{}.post_feedforward_layernorm.weight", transpose=False)
+    else:
+        put_layer("ln2", "model.layers.{}.post_attention_layernorm.weight", transpose=False)
+    if config.num_experts > 0:
+        E = config.num_experts
+
+        def stack_experts(which):
+            out = []
+            for li in range(L):
+                per = [
+                    np.asarray(
+                        source.get(
+                            f"model.layers.{li}.block_sparse_moe.experts.{e}.{which}.weight"
+                        )
+                    ).T
+                    for e in range(E)
+                ]
+                out.append(np.stack(per))
+            return conv(np.stack(out))
+
+        put_layer("wr", "model.layers.{}.block_sparse_moe.gate.weight")
+        layers["wg"] = put(stack_experts("w1"), "layers", "wg")
+        layers["wu"] = put(stack_experts("w3"), "layers", "wu")
+        layers["wd"] = put(stack_experts("w2"), "layers", "wd")
+    else:
+        put_layer("wg", "model.layers.{}.mlp.gate_proj.weight")
+        put_layer("wu", "model.layers.{}.mlp.up_proj.weight")
+        put_layer("wd", "model.layers.{}.mlp.down_proj.weight")
+    params["layers"] = layers
+    if not out_config.tie_word_embeddings:
+        head = conv(np.asarray(source.get("lm_head.weight")).T)
+        if pad:
+            head = np.pad(head, ((0, 0), (0, pad)))
+        params["lm_head"] = put(head, "lm_head")
+        del head
+    return params, out_config
+
+
 def load_engine_from_path(
     path: str,
     engine_config: EngineConfig | None = None,
@@ -96,50 +259,83 @@ def load_engine_from_path(
     dtype: str = "bfloat16",
     quantization: str = "",
     publisher=None,
+    timeline=None,
+    stream: bool | None = None,
+    overlap: bool | None = None,
+    warmup: bool | None = None,
 ) -> Engine:
     """Build an Engine from an HF-format checkpoint directory.
+
+    Cold-start fast path (single-process): safetensors tensors are
+    converted and device_put per-parameter as they are read
+    (stream_params_from_hf) while the step functions AOT-compile on a
+    background thread (engine/coldstart.py), so start costs
+    ~max(load, compile) instead of their sum. Phase stamps land on
+    *timeline* (a fresh one is created and installed at /debug/engine
+    when omitted). Knobs: KUBEAI_STREAM_WEIGHTS=0 restores the
+    whole-checkpoint load; KUBEAI_COLDSTART_OVERLAP is auto (overlap
+    when a persistent compile cache is enabled — the only regime where
+    the background compile pays), 1 forces, 0 disables;
+    KUBEAI_ENGINE_WARMUP=1 pre-dispatches every step shape before
+    returning (and the *warmup* arg overrides the env).
 
     When the process is one rank of a multi-host gang
     (jax.process_count() > 1), the tp mesh spans the GLOBAL device set:
     every rank loads the checkpoint, contributes its addressable weight
-    shards (shard_tree), and the Engine allocates global device state.
+    shards (shard_tree), and the Engine allocates global device state —
+    the serial path; streaming/overlap apply to single-process starts.
     Rank 0 additionally passes *publisher* (engine/gang.py) so its
     dispatches fan out to the follower ranks."""
     # Failpoint: chaos tests make cold starts fail/stall here (the
     # crashloop-at-weight-load scenario the controller must absorb).
+    from kubeai_tpu.engine.coldstart import (
+        ColdStartTimeline,
+        setup_compile_cache,
+        start_background_warm,
+    )
     from kubeai_tpu.faults import fault
 
     fault("weights.load")
+    cache_dir = setup_compile_cache() or jax.config.jax_compilation_cache_dir
     if quantization:
         if quantization != "int8":
             raise ValueError(f"unsupported quantization {quantization!r} (supported: int8)")
         if tp > 1:
             raise ValueError("int8 quantization currently supports tensor-parallel-size 1")
-    config = ModelConfig.from_json_file(path).replace(dtype=dtype)
-    if jax.default_backend() == "tpu":
-        config = config.replace(
-            use_flash_prefill=True,
-            use_paged_kernel=config.sliding_window == 0,
-        )
-    sd = load_state_dict(path)
-    if "lm_head.weight" not in sd and not config.tie_word_embeddings:
-        config = config.replace(tie_word_embeddings=True)
-    multiproc = jax.process_count() > 1
-    # int8: build + quantize on host so full-precision weights never touch
-    # HBM, then device_put the int8 tree ONCE (leaving it numpy would
-    # re-upload the model on every jitted step). Multi-process: stay on
-    # host until shard_tree assembles the global arrays.
-    params = llama.params_from_hf(
-        sd, config, to_device=quantization != "int8" and not multiproc
+    timeline = (timeline or ColdStartTimeline()).install()
+    config = apply_backend_flags(
+        ModelConfig.from_json_file(path).replace(dtype=dtype)
     )
-    params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
-    if quantization == "int8":
-        params = quantize_model_params(params, config)
-        params = jax.device_put(params)
-
+    multiproc = jax.process_count() > 1
+    if stream is None:
+        stream = os.environ.get("KUBEAI_STREAM_WEIGHTS", "1") != "0"
+    if overlap is None:
+        # "auto": overlap only pays off through the persistent compile
+        # cache (the AOT executables themselves are not reused by the
+        # engine's jit calls) — without one, a background compile would
+        # burn CPU and delay readiness for nothing. "1" forces it on
+        # (e.g. to validate compilability), "0" off.
+        knob = os.environ.get("KUBEAI_COLDSTART_OVERLAP", "auto")
+        overlap = knob == "1" or (knob != "0" and bool(cache_dir))
+    if warmup is None:
+        warmup = os.environ.get("KUBEAI_ENGINE_WARMUP", "0") == "1"
     ec = engine_config or EngineConfig()
     tokenizer = load_tokenizer(path)
 
+    # Open the safetensors shard index even when streaming is off:
+    # header reads are ~free and resolve tie_word_embeddings BEFORE the
+    # warm compiler launches (a warmer guessing the wrong param-tree
+    # structure would trace programs that can never hit).
+    source = None
+    try:
+        source = SafetensorsSource(path)
+    except FileNotFoundError:
+        source = None  # pytorch_model.bin checkpoints take the old path
+    use_stream = stream and not multiproc and source is not None
+    if source is not None and "lm_head.weight" not in source and not config.tie_word_embeddings:
+        config = config.replace(tie_word_embeddings=True)
+
+    mesh = None
     if tp > 1 or multiproc:
         if multiproc:
             # The gang mesh must take tp/num_processes devices from EACH
@@ -167,12 +363,81 @@ def load_engine_from_path(
             mesh = make_mesh(tp=tp, devices=devs)
         else:
             mesh = make_mesh(tp=tp)
-        params = shard_tree(params, llama_param_specs(config), mesh)
+
+    warmer = None
+    if overlap and not multiproc and tp == 1 and source is not None:
+        # The padded config the engine will serve with is fully known
+        # before any tensor data is read — kick off AOT compilation of
+        # the step functions NOW, concurrent with the weight stream.
+        # tp==1 only: the warmer lowers unsharded programs, which can
+        # never match a tp-sharded engine's executables (pure waste).
+        # Safetensors only: a .bin checkpoint can't resolve
+        # tie_word_embeddings (the param-tree structure) until the full
+        # torch load, so a warm launched now could trace the wrong tree.
+        from kubeai_tpu.engine.coldstart import padded_vocab_size
+
+        warm_config = config.replace(
+            vocab_size=padded_vocab_size(config.vocab_size, tp)
+        )
+        warmer = start_background_warm(
+            warm_config, ec,
+            quantization=quantization,
+            n_valid_vocab=getattr(tokenizer, "vocab_size", config.vocab_size),
+            timeline=timeline,
+        )
+
+    with timeline.phase("load"):
+        if use_stream:
+            params, config = stream_params_from_hf(
+                source, config, tp=tp, quantization=quantization, mesh=mesh
+            )
+        else:
+            sd = load_state_dict(path)
+            if "lm_head.weight" not in sd and not config.tie_word_embeddings:
+                config = config.replace(tie_word_embeddings=True)
+            # int8: build + quantize on host so full-precision weights
+            # never touch HBM, then device_put the int8 tree ONCE
+            # (leaving it numpy would re-upload the model on every
+            # jitted step). Multi-process: stay on host until
+            # shard_tree assembles the global arrays.
+            params = llama.params_from_hf(
+                sd, config, to_device=quantization != "int8" and not multiproc
+            )
+            params, config = pad_vocab(params, config, multiple=max(tp * 128, 128))
+            if quantization == "int8":
+                params = quantize_model_params(params, config)
+                params = jax.device_put(params)
+            if mesh is not None:
+                params = shard_tree(params, llama_param_specs(config), mesh)
+
+    if warmer is not None:
+        # Engine construction and warmup must not race the background
+        # compiles (duplicate compilation of the same programs); by now
+        # the warm has had the whole load to run, so on real checkpoints
+        # this wait is ~max(load, compile) - load.
+        stats = warmer.join()
+        if stats:
+            timeline.attrs["warm_compile"] = stats
+
+    def build(m=None):
+        # Engine construction (device-state allocation + jit wrapper
+        # setup) gets its own stamp so the phase timeline has no
+        # unattributed gap between compile and warmup.
+        timeline.begin("build")
+        eng = Engine(config, params, tokenizer, ec, mesh=m, publisher=publisher)
+        timeline.end("build")
+        if warmup and not multiproc:
+            with timeline.phase("warmup"):
+                timeline.attrs["warmup"] = eng.warmup()
+        eng.cold_start_timeline = timeline
+        return eng
+
+    if mesh is not None:
         # Cache + step functions inherit shardings via XLA propagation from
         # the params; the engine jits inside this mesh context.
         with mesh:
-            return Engine(config, params, tokenizer, ec, mesh=mesh, publisher=publisher)
-    return Engine(config, params, tokenizer, ec)
+            return build(mesh)
+    return build()
 
 
 def save_tiny_test_checkpoint(path: str, seed: int = 0, num_heads: int = 4, num_kv_heads: int = 2) -> "ModelConfig":

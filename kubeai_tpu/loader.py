@@ -7,11 +7,20 @@ and the adapter loader sidecar.
 
     python -m kubeai_tpu.loader <src-url> <dest-dir>
     python -m kubeai_tpu.loader --evict <dir>
+    python -m kubeai_tpu.loader --warm-compile-cache <src-url> <dest-dir> [engine args...]
 
 Schemes: file:// and pvc:// copy locally; hf:// uses huggingface_hub;
 s3:// gs:// oss:// shell out to their CLIs when present. Destination is
 written atomically (tmp dir + rename) so a crashed load never looks
 complete.
+
+--warm-compile-cache additionally AOT-compiles the engine's step
+functions against the staged checkpoint's shapes (config.json +
+tokenizer only — no weights are loaded) into the shared
+KUBEAI_COMPILE_CACHE, so the cache is hot BEFORE the first replica ever
+starts. Trailing engine-server args (e.g. the Model's spec.args:
+``--max-seq-len 512 --max-slots 4``) pin the warmed shapes to what the
+serving pods will actually run.
 """
 
 from __future__ import annotations
@@ -89,18 +98,50 @@ def stage_remote(url: str, base_dir: str, prefix: str = "") -> str:
     return url
 
 
+def warm_compile_cache(dest: str, engine_args: list[str] | None = None) -> dict | None:
+    """Loader-side compile-cache warm: requires KUBEAI_COMPILE_CACHE
+    (warming a process-local cache would benefit nobody). Never raises —
+    a warm failure must not fail the staging Job that gates pod
+    creation."""
+    from kubeai_tpu.engine.coldstart import setup_compile_cache, warm_from_checkpoint
+
+    if setup_compile_cache() is None:
+        print("KUBEAI_COMPILE_CACHE is not set; skipping compile warm")
+        return None
+    try:
+        stats = warm_from_checkpoint(dest, engine_args)
+    except Exception as e:
+        print(f"compile warm failed (non-fatal): {e}")
+        return None
+    print(f"warmed compile cache for {dest}: {stats}")
+    return stats
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser("kubeai-tpu-loader")
     parser.add_argument("--evict", action="store_true")
+    parser.add_argument(
+        "--warm-compile-cache", action="store_true",
+        help="after staging, AOT-compile the engine step functions for "
+             "the checkpoint's shapes into KUBEAI_COMPILE_CACHE; "
+             "trailing engine-server args pin the warmed shapes",
+    )
     parser.add_argument("src_or_dir")
     parser.add_argument("dest", nargs="?")
-    args = parser.parse_args(argv)
+    args, engine_args = parser.parse_known_args(argv)
+    if engine_args and not args.warm_compile_cache:
+        # Trailing args are ONLY the warm step's engine flags; without
+        # it they are typos (a misspelled --evict must not silently
+        # turn into a staging run).
+        parser.error(f"unrecognized arguments: {' '.join(engine_args)}")
     if args.evict:
         evict(args.src_or_dir)
     else:
         if not args.dest:
             parser.error("dest required")
         load(args.src_or_dir, args.dest)
+        if args.warm_compile_cache:
+            warm_compile_cache(args.dest, engine_args)
 
 
 if __name__ == "__main__":

@@ -67,11 +67,20 @@ class Manager:
         self.adapter_reconciler = AdapterReconciler(
             self.store, allow_override=self.system.allow_pod_address_override or local_runtime
         )
+        # Parked-replica pool (cold-start fast path): scale-from-zero
+        # attaches models to pre-warmed parked pods; attach decisions
+        # are recorded in the autoscaler's decision audit below.
+        self.parked_pool = None
+        if self.system.parked_replicas > 0:
+            from kubeai_tpu.controller.parked import ParkedPool
+
+            self.parked_pool = ParkedPool(self.store, self.system, namespace)
         self.reconciler = ModelReconciler(
             self.store,
             self.system,
             cache_reconciler=self.cache_reconciler,
             adapter_reconciler=self.adapter_reconciler,
+            parked_pool=self.parked_pool,
         )
         # One scrape per engine endpoint per autoscaler tick, shared by
         # the scaling signal and the /debug/fleet plane; the debug cache
@@ -105,6 +114,8 @@ class Manager:
             # non-leaders must not export vacuously green gauges.
             election=self.election,
         )
+        if self.parked_pool is not None:
+            self.parked_pool.decision_log = self.autoscaler.decisions
         self.proxy = ModelProxy(self.model_client, self.lb)
         self.api = OpenAIServer(self.proxy, self.model_client, host=host, port=port)
         self.api.decision_log = self.autoscaler.decisions
@@ -126,6 +137,8 @@ class Manager:
 
     def start(self):
         self.lb.start()
+        if self.parked_pool is not None:
+            self.parked_pool.start()
         self.reconciler.start()
         self.election.start()
         self.autoscaler.start()
@@ -154,6 +167,8 @@ class Manager:
         self.autoscaler.stop()
         self.election.stop()
         self.reconciler.stop()
+        if self.parked_pool is not None:
+            self.parked_pool.stop()
         self.lb.stop()
 
 

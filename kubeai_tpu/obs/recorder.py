@@ -246,6 +246,17 @@ default_recorder = FlightRecorder()
 
 DEBUG_PATHS = ("/debug/requests", "/debug/engine", "/debug/trace")
 
+# Extra named sections merged into the /debug/engine payload (e.g. the
+# cold-start phase timeline). Providers are zero-arg callables returning
+# JSON-able values; latest registration per key wins, and a failing
+# provider drops only its own section — the debug plane must never 500
+# because one data source broke.
+_engine_debug_sections: dict[str, object] = {}
+
+
+def register_engine_debug_section(key: str, fn) -> None:
+    _engine_debug_sections[key] = fn
+
 
 def handle_debug_request(
     path: str, query: str = "", recorder: FlightRecorder | None = None
@@ -273,7 +284,16 @@ def handle_debug_request(
         body = json.dumps({"requests": tls}).encode()
         return 200, "application/json", body
     if path == "/debug/engine":
-        body = json.dumps({"steps": rec.engine_steps(intq("limit", 100))}).encode()
+        payload = {"steps": rec.engine_steps(intq("limit", 100))}
+        # Snapshot: install() can register a section from another
+        # thread (a parked replica's attach) mid-GET — iterating the
+        # live dict would raise "changed size during iteration".
+        for key, fn in list(_engine_debug_sections.items()):
+            try:
+                payload[key] = fn()
+            except Exception:
+                pass
+        body = json.dumps(payload).encode()
         return 200, "application/json", body
     if path == "/debug/trace":
         body = json.dumps(rec.chrome_trace(intq("limit", 200))).encode()

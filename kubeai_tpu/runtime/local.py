@@ -384,19 +384,43 @@ class LocalRuntime:
                         self._procs.pop(lp.pod_name, None)
                     self._on_pod_exit(lp)
                     continue
-                if lp.ready:
-                    continue
-                try:
-                    with urllib.request.urlopen(
-                        f"http://127.0.0.1:{lp.port}/health", timeout=1
-                    ) as resp:
-                        if resp.status == 200:
-                            lp.ready = True
-                            self._set_status(
-                                lp.pod_name, ready=True, pod_ip="127.0.0.1", port=lp.port
-                            )
-                except Exception:
-                    pass
+                ready = self._probe_ready(lp.port)
+                if ready and not lp.ready:
+                    lp.ready = True
+                    self._set_status(
+                        lp.pod_name, ready=True, pod_ip="127.0.0.1", port=lp.port
+                    )
+                elif lp.ready and ready is False:
+                    # Readiness is CONTINUOUS (the kubelet's contract),
+                    # not sticky: a parked pod adopted by a model, a
+                    # draining engine, or a degraded gang must flip back
+                    # to not-ready so the balancer routes around it.
+                    lp.ready = False
+                    self._set_status(lp.pod_name, ready=False)
+
+    @staticmethod
+    def _probe_ready(port: int) -> bool | None:
+        """One readiness probe: /readyz when the server has one (the
+        engine's is real readiness — parked/loading/draining read 503),
+        falling back to /health for servers without a readiness route.
+        None = unreachable (no status change; the exit poller owns
+        process death)."""
+        import urllib.error
+        import urllib.request
+
+        for path in ("/readyz", "/health"):
+            try:
+                with urllib.request.urlopen(
+                    f"http://127.0.0.1:{port}{path}", timeout=1
+                ) as resp:
+                    return resp.status == 200
+            except urllib.error.HTTPError as e:
+                if e.code == 404:
+                    continue  # no such route; try the next probe
+                return False
+            except Exception:
+                return None
+        return None
 
     def _on_pod_exit(self, lp: LocalProcess) -> None:
         """A pod subprocess died. With restarts enabled and the pod

@@ -34,7 +34,7 @@ def test_tpu_pod_manifest_shape():
     server = doc["spec"]["containers"][0]
     assert server["resources"]["limits"]["google.com/tpu"] == "4"
     assert doc["spec"]["nodeSelector"]["cloud.google.com/gke-tpu-topology"] == "2x2"
-    assert server["readinessProbe"]["httpGet"]["path"] == "/health"
+    assert server["readinessProbe"]["httpGet"]["path"] == "/readyz"
     assert any(e["name"] == "PYTHONUNBUFFERED" for e in server["env"])
     # HF secret becomes envFrom.
     assert any("secretRef" in e for e in server.get("envFrom", []))

@@ -48,3 +48,61 @@ def test_sweep_smoke_emits_full_table():
 
     # The env knob must not leak out of the sweep.
     assert "KUBEAI_PAGED_KERNEL_BLOCK" not in os.environ
+
+
+def test_sweep_resume_skips_completed_cells(tmp_path):
+    """--resume (ROADMAP item 1 prep): per-cell results persist
+    incrementally, and a restart reuses completed cells verbatim
+    instead of re-measuring — a flaky device mid-grid costs one cell,
+    not the run."""
+    from benchmarks.profile_engine import run_sweep
+
+    out = str(tmp_path / "sweep.json")
+    doc1 = run_sweep(
+        slots_list=(2,), blocks=("default",), smoke=True, out_path=out
+    )
+    with open(out) as f:
+        on_disk = json.load(f)
+    assert on_disk["results"] == json.loads(json.dumps(doc1["results"]))
+    assert len(doc1["results"]) == 2  # dedicated + ragged default
+
+    # Simulate a crash mid-grid: drop the ragged cell from the file.
+    on_disk["results"] = [
+        r for r in on_disk["results"] if r["kernel"] == "dedicated"
+    ]
+    with open(out, "w") as f:
+        json.dump(on_disk, f)
+
+    doc2 = run_sweep(
+        slots_list=(2, 4), blocks=("default",), smoke=True,
+        out_path=out, resume=True,
+    )
+    rows = {(r["kernel"], r["slots"]): r for r in doc2["results"]}
+    assert set(rows) == {
+        ("dedicated", 2), ("ragged", 2), ("dedicated", 4), ("ragged", 4)
+    }
+    # The completed cell was reused VERBATIM (identical measurement),
+    # the dropped + new cells were measured fresh.
+    kept = next(r for r in on_disk["results"] if r["kernel"] == "dedicated")
+    assert rows[("dedicated", 2)]["latency_ms"] == kept["latency_ms"]
+    for key in (("ragged", 2), ("dedicated", 4), ("ragged", 4)):
+        assert rows[key]["latency_ms"] is not None and rows[key]["latency_ms"] > 0
+    # And the file on disk holds the final full document.
+    with open(out) as f:
+        final = json.load(f)
+    assert len(final["results"]) == 4
+
+
+def test_sweep_resume_ignores_corrupt_file(tmp_path):
+    from benchmarks.profile_engine import run_sweep
+
+    out = str(tmp_path / "sweep.json")
+    with open(out, "w") as f:
+        f.write("{not json")
+    doc = run_sweep(
+        slots_list=(2,), blocks=("default",), smoke=True,
+        out_path=out, resume=True,
+    )
+    assert len(doc["results"]) == 2
+    with open(out) as f:
+        assert len(json.load(f)["results"]) == 2
