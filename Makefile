@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot perf-gate
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -22,6 +22,13 @@ bench:
 
 bench-tiny:
 	$(PY) bench.py --tiny
+
+BENCH ?=
+perf-gate: ## schema-validate a bench JSON + compare vs best prior BENCH_r*.json
+	@# Usage: make perf-gate [BENCH=path.json] — default gates the newest
+	@# BENCH_r*.json against the rest. Exits 1 on tok/s / MFU / TTFT
+	@# regression, 2 on schema violation (see benchmarks/BENCH_SCHEMA.md).
+	$(PY) benchmarks/perf_gate.py $(BENCH)
 
 cold-start: ## scale-from-zero SLO: serial vs streamed+warmed vs parked attach
 	JAX_PLATFORMS=cpu $(PY) benchmarks/cold_start.py --json BENCH_cold_start.json

@@ -50,23 +50,10 @@ COMPILE_CACHE_DIR = os.path.join(
     os.path.dirname(os.path.abspath(__file__)), ".jax_compile_cache"
 )
 
-# Peak bf16 matmul FLOP/s per chip by TPU generation (public specs), for
-# the MFU estimate. CPU runs report no MFU.
-PEAK_FLOPS = {
-    "v4": 275e12,
-    "v5 lite": 197e12,
-    "v5e": 197e12,
-    "v5p": 459e12,
-    "v6 lite": 918e12,
-    "v6e": 918e12,
-}
-
 PRESETS = ("8b-int8", "1.3b", "tiny")
 # Per-preset subprocess deadline (s). Generous on first compile; the
 # persistent compile cache makes retries much cheaper.
 PRESET_DEADLINE = {"8b-int8": 900, "1.3b": 420, "tiny": 240}
-# Approximate active parameter counts for FLOPs/token ~= 2*N.
-PRESET_PARAMS = {"8b-int8": 8.03e9, "1.3b": 1.24e9, "tiny": 1.1e6}
 
 
 def log(msg: str) -> None:
@@ -481,16 +468,33 @@ def run_worker(args) -> None:
         extras["sampling"] = "greedy"
         if drafted:
             extras["spec_acceptance_pct"] = round(100 * accepted / drafted, 1)
-    peak = PEAK_FLOPS.get(
-        next((k for k in PEAK_FLOPS if k in str(dev_kind).lower()), ""), None
-    )
+    # Roofline/MFU from the SHARED accounting (kubeai_tpu/obs/perf.py —
+    # the same math the engine's kubeai_engine_mfu gauge and the sweep
+    # JSON use; previously hand-maintained constants here): FLOPs/token
+    # analytic from the model config, weight bytes measured off the
+    # live param tree, device constants from the shared tables. The
+    # roofline block ships in every BENCH JSON so stored numbers carry
+    # their own interpretation.
+    from kubeai_tpu.obs.perf import device_constants
+
+    env = device_constants(str(dev_kind))
+    pm = eng.perf
+    roof = pm.roofline_tokens_per_sec(eng.cfg.max_slots, env.hbm_gbps)
+    extras["roofline"] = {
+        "flops_per_token": pm.flops_per_token,
+        "weight_bytes": pm.weight_bytes,
+        "slots": eng.cfg.max_slots,
+        "device": str(dev_kind),
+        "peak_flops": env.peak_flops,
+        "hbm_gbps": env.hbm_gbps,
+        "roofline_toks_per_sec": round(roof, 1) if roof else None,
+        "roofline_fraction": round(toks_per_sec / roof, 4) if roof else None,
+    }
     # Note: the real TPU registers as platform "axon" here, so gate on
-    # device kind (peak found) rather than backend name.
-    if peak and backend != "cpu":
-        # Decode-dominated MFU estimate: ~2 FLOPs per active param per
-        # generated token (attention adds a few % at seq<=1k; ignored).
-        mfu = toks_per_sec * 2 * PRESET_PARAMS[preset] / peak
-        extras["mfu_pct"] = round(mfu * 100, 2)
+    # device kind (constants resolved) rather than backend name. CPU
+    # runs report no MFU.
+    if env.peak_flops and backend != "cpu":
+        extras["mfu_pct"] = round(pm.mfu(toks_per_sec, env.peak_flops) * 100, 2)
     log(
         f"phase=measure done: {n_requests} reqs x {max_tokens} max_tokens, "
         f"prompt={prompt_len}, elapsed={elapsed:.1f}s, "

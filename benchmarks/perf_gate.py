@@ -1,0 +1,249 @@
+"""Perf regression gate: validate a fresh bench JSON and compare it
+against the best prior ``BENCH_r*.json`` with tolerances.
+
+The start of a TRACKED perf trajectory: instead of eyeballing JSON
+diffs between rounds, ``make perf-gate`` (or the driver) runs
+
+    python benchmarks/perf_gate.py [NEW.json] [--baseline-glob 'BENCH_r*.json']
+
+which
+
+1. **schema-validates** the candidate (shape documented in
+   benchmarks/BENCH_SCHEMA.md; a malformed or failed run exits 2 — a
+   bench that emitted garbage must not silently "pass" the gate), then
+2. **compares** tok/s, MFU, and TTFT against the best comparable prior
+   round — same preset, a real number (no ``error`` field, no
+   CPU-fallback ``note``, value > 0) — exiting 1 on regression:
+
+   - output tok/s below ``(1 - --tol-toks)`` x best prior,
+   - mfu_pct below ``(1 - --tol-mfu)`` x best prior (when both carry it),
+   - rate-controlled p50 TTFT above ``(1 + --tol-ttft)`` x best prior
+     (only the rate-controlled phase is compared — the saturated phase's
+     TTFT measures queue depth by design, see docs/benchmarks.md).
+
+Exit codes: 0 pass, 1 regression, 2 schema-invalid / no candidate.
+Both the driver's wrapped format ({"parsed": {...}}) and a raw bench
+output line are accepted.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+EXPECTED_METRIC = "engine_output_tokens_per_sec_per_chip"
+
+# Default tolerances: run-to-run variance on the real chip has been a
+# few percent (BENCH_r03 1202 -> r04 1225); 10% catches real
+# regressions without flagging noise. TTFT is noisier — 25%.
+TOL_TOKS = 0.10
+TOL_MFU = 0.15
+TOL_TTFT = 0.25
+
+
+def load_bench(path: str) -> dict:
+    """A bench document from disk: either the raw JSON line bench.py
+    emits, or the round driver's wrapper whose ``parsed`` key holds it."""
+    with open(path) as f:
+        doc = json.load(f)
+    if isinstance(doc, dict) and isinstance(doc.get("parsed"), dict):
+        doc = doc["parsed"]
+    if not isinstance(doc, dict):
+        raise ValueError(f"{path}: bench JSON must be an object")
+    return doc
+
+
+def validate(doc: dict) -> list[str]:
+    """Schema errors for a candidate bench document (empty = valid).
+    See benchmarks/BENCH_SCHEMA.md for the documented shape."""
+    errors: list[str] = []
+
+    def num(key, required=False):
+        v = doc.get(key)
+        if v is None:
+            if required:
+                errors.append(f"missing required field {key!r}")
+            return None
+        if isinstance(v, bool) or not isinstance(v, (int, float)):
+            errors.append(f"{key!r} must be a number, got {type(v).__name__}")
+            return None
+        return v
+
+    if doc.get("metric") != EXPECTED_METRIC:
+        errors.append(
+            f"metric must be {EXPECTED_METRIC!r}, got {doc.get('metric')!r}"
+        )
+    value = num("value", required=True)
+    num("vs_baseline", required=True)
+    if doc.get("unit") != "tok/s":
+        errors.append(f"unit must be 'tok/s', got {doc.get('unit')!r}")
+    if not isinstance(doc.get("preset"), str) or not doc.get("preset"):
+        errors.append("preset must be a non-empty string")
+    if doc.get("error"):
+        errors.append(f"candidate is a failed run: error={doc['error']!r}")
+    elif value is not None and value <= 0:
+        errors.append("value must be > 0 for a successful run")
+    num("p50_ttft_ms")
+    num("mfu_pct")
+    for key in ("slo", "roofline", "rate_controlled"):
+        if key in doc and not isinstance(doc[key], dict):
+            errors.append(f"{key!r} must be an object when present")
+    return errors
+
+
+def comparable(doc: dict, preset: str) -> bool:
+    """Whether a prior round is a legitimate baseline for *preset*:
+    same preset, a real measurement (no error, positive value), and not
+    an honestly-labeled CPU fallback."""
+    if doc.get("preset") != preset:
+        return False
+    if doc.get("error") or not isinstance(doc.get("value"), (int, float)):
+        return False
+    if doc["value"] <= 0:
+        return False
+    note = str(doc.get("note", ""))
+    if "CPU fallback" in note or "not a TPU number" in note:
+        return False
+    return True
+
+
+def _rc_ttft(doc: dict) -> float | None:
+    rc = doc.get("rate_controlled")
+    if isinstance(rc, dict) and isinstance(rc.get("p50_ttft_ms"), (int, float)):
+        return float(rc["p50_ttft_ms"])
+    return None
+
+
+def gate(
+    candidate: dict,
+    baselines: list[dict],
+    tol_toks: float = TOL_TOKS,
+    tol_mfu: float = TOL_MFU,
+    tol_ttft: float = TOL_TTFT,
+) -> tuple[bool, dict]:
+    """(passed, report). With no comparable baseline the gate passes on
+    schema alone — the first tracked round SETS the trajectory."""
+    preset = candidate["preset"]
+    priors = [b for b in baselines if comparable(b, preset)]
+    report: dict = {"preset": preset, "baselines_considered": len(priors)}
+    if not priors:
+        report["verdict"] = "pass (no comparable prior round — baseline set)"
+        return True, report
+    regressions: list[str] = []
+    checks: dict = {}
+
+    best = max(priors, key=lambda b: b["value"])
+    floor = best["value"] * (1 - tol_toks)
+    checks["toks_per_sec"] = {
+        "new": candidate["value"], "best_prior": best["value"],
+        "floor": round(floor, 2), "tolerance": tol_toks,
+    }
+    if candidate["value"] < floor:
+        regressions.append(
+            f"tok/s regressed: {candidate['value']} < {round(floor, 2)} "
+            f"({best['value']} best prior - {tol_toks:.0%})"
+        )
+
+    mfus = [b["mfu_pct"] for b in priors if isinstance(b.get("mfu_pct"), (int, float))]
+    if mfus and isinstance(candidate.get("mfu_pct"), (int, float)):
+        best_mfu = max(mfus)
+        mfu_floor = best_mfu * (1 - tol_mfu)
+        checks["mfu_pct"] = {
+            "new": candidate["mfu_pct"], "best_prior": best_mfu,
+            "floor": round(mfu_floor, 3), "tolerance": tol_mfu,
+        }
+        if candidate["mfu_pct"] < mfu_floor:
+            regressions.append(
+                f"MFU regressed: {candidate['mfu_pct']}% < {round(mfu_floor, 3)}%"
+            )
+
+    prior_ttfts = [t for t in (_rc_ttft(b) for b in priors) if t is not None]
+    new_ttft = _rc_ttft(candidate)
+    if prior_ttfts and new_ttft is not None:
+        best_ttft = min(prior_ttfts)
+        ceil = best_ttft * (1 + tol_ttft)
+        checks["rate_controlled_p50_ttft_ms"] = {
+            "new": new_ttft, "best_prior": best_ttft,
+            "ceiling": round(ceil, 1), "tolerance": tol_ttft,
+        }
+        if new_ttft > ceil:
+            regressions.append(
+                f"rate-controlled p50 TTFT regressed: {new_ttft}ms > {round(ceil, 1)}ms"
+            )
+
+    report["checks"] = checks
+    if regressions:
+        report["verdict"] = "REGRESSION"
+        report["regressions"] = regressions
+        return False, report
+    report["verdict"] = "pass"
+    return True, report
+
+
+def _round_number(path: str) -> int:
+    m = re.search(r"BENCH_r(\d+)", os.path.basename(path))
+    return int(m.group(1)) if m else -1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument(
+        "candidate", nargs="?", default=None,
+        help="bench JSON to gate (default: the newest BENCH_r*.json)",
+    )
+    parser.add_argument(
+        "--baseline-glob", default="BENCH_r*.json",
+        help="prior rounds to compare against (the candidate file is "
+             "excluded automatically)",
+    )
+    parser.add_argument("--tol-toks", type=float, default=TOL_TOKS)
+    parser.add_argument("--tol-mfu", type=float, default=TOL_MFU)
+    parser.add_argument("--tol-ttft", type=float, default=TOL_TTFT)
+    args = parser.parse_args(argv)
+
+    baseline_paths = sorted(glob.glob(args.baseline_glob), key=_round_number)
+    candidate_path = args.candidate
+    if candidate_path is None:
+        if not baseline_paths:
+            print(f"perf-gate: no files match {args.baseline_glob!r}", file=sys.stderr)
+            return 2
+        candidate_path = baseline_paths[-1]
+    baseline_paths = [
+        p for p in baseline_paths
+        if os.path.abspath(p) != os.path.abspath(candidate_path)
+    ]
+
+    try:
+        candidate = load_bench(candidate_path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"perf-gate: cannot load {candidate_path}: {e}", file=sys.stderr)
+        return 2
+    errors = validate(candidate)
+    if errors:
+        print(f"perf-gate: {candidate_path} failed schema validation:", file=sys.stderr)
+        for e in errors:
+            print(f"  - {e}", file=sys.stderr)
+        return 2
+
+    baselines = []
+    for p in baseline_paths:
+        try:
+            baselines.append(load_bench(p))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"perf-gate: skipping unreadable baseline {p}: {e}", file=sys.stderr)
+
+    ok, report = gate(
+        candidate, baselines,
+        tol_toks=args.tol_toks, tol_mfu=args.tol_mfu, tol_ttft=args.tol_ttft,
+    )
+    report["candidate"] = candidate_path
+    print(json.dumps(report, indent=2))
+    return 0 if ok else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -37,7 +37,10 @@ from functools import partial
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-HBM_GBPS = {"v5 lite": 819, "v5e": 819, "v5p": 2765, "v6e": 1640, "v4": 1228}
+# Device constant tables live in the shared perf accounting now
+# (kubeai_tpu/obs/perf.py) — one source for bench.py, the engine's live
+# MFU/roofline gauges, and this harness.
+from kubeai_tpu.obs.perf import HBM_GBPS, device_constants  # noqa: E402
 
 
 def log(msg):
@@ -121,6 +124,32 @@ def run_sweep(
     max_pages = seq // page
     dtype = jnp.float32 if degraded else jnp.bfloat16
 
+    # Shared roofline accounting (kubeai_tpu/obs/perf.py): project each
+    # measured attention cell onto the FULL-model decode step of the
+    # 8b-int8 flagship (the config the 96-slot cliff was measured on) —
+    # step = weight-read floor + measured attention x num_layers — so
+    # the cliff analysis reads directly off the sweep output as mfu /
+    # roofline_fraction columns. Unknown devices (CPU smoke) assume v5e
+    # constants, labeled `assumed_device` — trend-only, like the rest
+    # of a degraded run.
+    from kubeai_tpu.models.base import ModelConfig
+    from kubeai_tpu.obs.perf import PerfModel, device_constants
+
+    flagship_layers = 32
+    pm = PerfModel.from_model_config(
+        ModelConfig(
+            vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+            num_layers=flagship_layers, num_heads=32, num_kv_heads=8,
+            rope_theta=500000.0, dtype="bfloat16",
+        ),
+        quantization="int8",
+    )
+    env = device_constants(str(kind))
+    assumed_device = env.hbm_gbps is None or env.peak_flops is None
+    hbm_gbps = env.hbm_gbps or HBM_GBPS["v5e"]
+    peak_flops = env.peak_flops or 197e12
+    floor_ms = pm.step_floor_seconds(hbm_gbps) * 1e3
+
     def make_doc(rows):
         return {
             "metric": "paged_decode_attention_sweep",
@@ -134,6 +163,21 @@ def run_sweep(
             "shapes": {
                 "H": H, "Kv": Kv, "head_dim": h, "page": page, "seq": seq,
                 "dtype": str(dtype.__name__ if hasattr(dtype, "__name__") else dtype),
+            },
+            # The constants behind each row's mfu/roofline_fraction —
+            # the sweep JSON carries its own interpretation.
+            "roofline": {
+                "basis": (
+                    "8b-int8 flagship; projected full-model step = "
+                    "weight-read floor + measured attention x num_layers"
+                ),
+                "flops_per_token": pm.flops_per_token,
+                "weight_bytes": pm.weight_bytes,
+                "num_layers": flagship_layers,
+                "hbm_gbps": hbm_gbps,
+                "peak_flops": peak_flops,
+                "step_floor_ms": round(floor_ms, 3),
+                "assumed_device": assumed_device,
             },
             "results": rows,
         }
@@ -242,6 +286,17 @@ def run_sweep(
             except Exception as e:  # pragma: no cover - TPU-side compile loss
                 ms = None
                 err = str(e)[:200]
+            if ms is not None:
+                # Projection onto the flagship's full decode step: the
+                # measured per-layer attention call x num_layers added
+                # to the weight-read floor (see doc["roofline"]).
+                step_ms = floor_ms + ms * flagship_layers
+                projected = B * qlen / (step_ms / 1e3)
+                mfu = round(pm.mfu(projected, peak_flops), 4)
+                roofline_fraction = round(floor_ms / step_ms, 4)
+                projected_toks = round(projected, 1)
+            else:
+                mfu = roofline_fraction = projected_toks = None
             row = {
                 "kernel": kernel,
                 "block": blk,
@@ -254,6 +309,9 @@ def run_sweep(
                 "grid_programs": programs,
                 "q_rows_per_program": q_rows,
                 "kv_mb_walked": round(kv_mb, 2),
+                "projected_toks_per_sec": projected_toks,
+                "mfu": mfu,
+                "roofline_fraction": roofline_fraction,
             }
             if err:
                 row["error"] = err
@@ -391,7 +449,7 @@ def main():
     page = 64
     max_pages = S // page
     P = B * max_pages + 1
-    bw = next((v for k, v in HBM_GBPS.items() if k in str(kind).lower()), None)
+    bw = device_constants(str(kind)).hbm_gbps
     if bw:
         floor_ms = wbytes / (bw * 1e9) * 1e3
         print(f"roofline_step_ms {floor_ms:.2f}  (weights {wbytes/1e9:.2f} GB / {bw} GB/s)")

@@ -20,6 +20,7 @@ import threading
 import time
 
 from kubeai_tpu.metrics.registry import default_registry, parse_prometheus_text
+from kubeai_tpu.obs.perf import TokenRateWindow
 
 # Engine-side gauges/counters the collector reads off each endpoint's
 # /metrics page (all exported by kubeai_tpu/engine/core.py).
@@ -167,8 +168,12 @@ class FleetCollector:
         self._collect_lock = threading.Lock()
         self._last: dict[str, dict] = {}
         self._last_at: float | None = None
-        # addr -> (generated_tokens_total, t) for tokens/sec derivation.
-        self._prev_tokens: dict[str, tuple[float, float]] = {}
+        # addr -> TokenRateWindow over the generated-token counter: the
+        # SAME sliding-window implementation backing the engine-side
+        # kubeai_engine_tokens_per_second gauge (obs/perf.py), so the
+        # two derivations agree by construction — counter resets
+        # (engine restart) re-anchor instead of going negative.
+        self._prev_tokens: dict[str, TokenRateWindow] = {}
         # addr -> full parsed /metrics page from the last collect — the
         # SLO monitor's remote source (engine histograms live in engine
         # processes; the operator only sees them through these scrapes).
@@ -201,11 +206,16 @@ class FleetCollector:
             return sum(v for _, v in parsed.get(name, []))
 
         tokens_total = val(_GEN_TOKENS)
-        prev = self._prev_tokens.get(addr)
-        tps = 0.0
-        if prev is not None and now > prev[1] and tokens_total >= prev[0]:
-            tps = (tokens_total - prev[0]) / (now - prev[1])
-        self._prev_tokens[addr] = (tokens_total, now)
+        win = self._prev_tokens.get(addr)
+        if win is None:
+            # span=0 keeps exactly the anchor pair (the previous scrape
+            # and this one): rate = delta since the last collect, so an
+            # endpoint that goes idle reads 0 on its very next scrape —
+            # matching the engine gauge, which resets on idle — instead
+            # of decaying an old burst across a longer window.
+            win = self._prev_tokens[addr] = TokenRateWindow(span=0.0)
+        win.observe_total(tokens_total, now)
+        tps = win.rate(now)
         return {
             "address": addr,
             "ok": True,

@@ -34,7 +34,6 @@ import logging
 import queue
 import threading
 import time
-from collections import deque
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from functools import partial
@@ -56,6 +55,11 @@ from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.models import llama
 from kubeai_tpu.models.base import ModelConfig
 from kubeai_tpu.obs import default_recorder
+from kubeai_tpu.obs import perf as perf_obs
+from kubeai_tpu.obs.recorder import (
+    register_engine_debug_section,
+    unregister_engine_debug_section,
+)
 from kubeai_tpu.obs.trace import RequestTrace, TraceContext
 
 log = logging.getLogger("kubeai_tpu.engine")
@@ -423,7 +427,10 @@ class Engine:
             "included; growth after warmup means shape churn)",
         )
         self._jit_entries_seen = 0
-        self._rate_window: deque[tuple[float, int]] = deque()
+        # Shared sliding-window rate (obs/perf.py): the same
+        # implementation the fleet collector's counter-delta tok/s uses,
+        # so the two can no longer disagree during idle→busy transitions.
+        self._rate_window = perf_obs.TokenRateWindow(span=10.0)
         self.m_gang_reforms = default_registry.counter(
             "kubeai_gang_reforms_total",
             "gang re-formations: a lost follower reconnected and rank 0 "
@@ -455,8 +462,121 @@ class Engine:
         self.m_param_global.set(g_bytes)
         self.m_param_local.set(l_bytes)
 
+        # Live roofline/MFU accounting (obs/perf.py — the deduped
+        # docs/benchmarks.md math): FLOPs/token analytic from the
+        # config, weight bytes MEASURED off the actual param tree (so
+        # int8 trees and their scales are costed as stored), device
+        # peak/bandwidth from the shared constant tables. Callback
+        # gauges so /metrics always reflects the current rate window.
+        self.perf = perf_obs.PerfModel.from_model_config(
+            model_config, weight_bytes=g_bytes
+        )
+        self.perf_env = perf_obs.detect_device()
+        # Whole-deployment denominators: on a sharded mesh every device
+        # streams its own weight shard concurrently and contributes its
+        # own peak FLOPs, so the single-chip constants scale by the
+        # mesh's device count (a mesh-less engine runs on one device —
+        # extra local devices sit idle and must not inflate the peak).
+        self._perf_devices = (
+            max(1, self._mesh.devices.size) if self._mesh is not None else 1
+        )
+        self._stall = perf_obs.PipelineStallTracker()
+        mfu_fn = lambda: self._mfu()  # noqa: E731
+        roofline_fn = lambda: self._roofline_fraction()  # noqa: E731
+        self.m_mfu = default_registry.callback_gauge(
+            "kubeai_engine_mfu",
+            "model FLOPs utilization (fraction of device peak) at the "
+            "current decode rate window; 0 on unknown devices/CPU",
+            mfu_fn,
+        )
+        self.m_roofline = default_registry.callback_gauge(
+            "kubeai_engine_roofline_fraction",
+            "current decode rate as a fraction of the weight-read "
+            "roofline at the configured slot count; 0 on unknown devices",
+            roofline_fn,
+        )
+        self._gauge_callbacks += [
+            (self.m_mfu, mfu_fn),
+            (self.m_roofline, roofline_fn),
+        ]
+        # ONE bound-method object kept for register/unregister identity
+        # (each `self._perf_debug_section` access builds a fresh bound
+        # method — `is` checks would never match across accesses).
+        self._perf_section_fn = self._perf_debug_section
+        register_engine_debug_section("perf", self._perf_section_fn)
+
         self._init_device_state()
         self._build_step_fns()
+
+    # -- perf X-ray --------------------------------------------------------
+
+    def _perf_constants(self) -> tuple[float | None, float | None]:
+        """(peak_flops, hbm_gbps) aggregated over the serving devices."""
+        env = self.perf_env
+        n = self._perf_devices
+        return (
+            env.peak_flops * n if env.peak_flops else None,
+            env.hbm_gbps * n if env.hbm_gbps else None,
+        )
+
+    def _mfu(self) -> float:
+        peak, _ = self._perf_constants()
+        return self.perf.mfu(self.m_tok_rate.value(), peak)
+
+    def _roofline_fraction(self) -> float:
+        _, hbm = self._perf_constants()
+        roof = self.perf.roofline_tokens_per_sec(self.cfg.max_slots, hbm)
+        return self.m_tok_rate.value() / roof if roof else 0.0
+
+    def _perf_debug_section(self) -> dict:
+        """The ``perf`` block of /debug/engine: live rate, MFU, roofline
+        context, and the windowed stall summary — one place where an
+        on-chip bench's numbers come pre-interpreted."""
+        env = self.perf_env
+        peak, hbm = self._perf_constants()
+        roof = self.perf.roofline_tokens_per_sec(self.cfg.max_slots, hbm)
+        return {
+            "tokens_per_second": self.m_tok_rate.value(),
+            "mfu": round(self._mfu(), 5),
+            "roofline_fraction": round(self._roofline_fraction(), 5),
+            "roofline_toks_per_sec": round(roof, 1) if roof else None,
+            "flops_per_token": self.perf.flops_per_token,
+            "weight_bytes": self.perf.weight_bytes,
+            "device": env.kind,
+            "devices": self._perf_devices,
+            "peak_flops": peak,
+            "hbm_gbps": hbm,
+            "stall": self._stall.report(),
+        }
+
+    def pipeline_report(self) -> dict:
+        """The GET /debug/pipeline payload: windowed stall attribution
+        plus the live MFU/roofline context (the numbers that say whether
+        the attributed stalls matter)."""
+        report = self._stall.report()
+        report["mfu"] = round(self._mfu(), 5)
+        report["roofline_fraction"] = round(self._roofline_fraction(), 5)
+        report["tokens_per_second"] = self.m_tok_rate.value()
+        return report
+
+    def broadcast_profile(self, seconds: float, out_dir: str) -> int:
+        """Gang leader: fan a profiler capture out to followers over the
+        dispatch control channel (ordered through the scheduler thread —
+        the publisher is only safe to use from there). Returns the
+        follower count notified; 0 single-host."""
+        if self._publisher is None:
+            return 0
+
+        def do():
+            self._bcast(
+                "profile", scalars={"seconds": float(seconds), "dir": out_dir}
+            )
+
+        if self._running:
+            self._await_aux(self._submit_aux(do), what="profile broadcast")
+        else:
+            do()
+        return int(getattr(self._publisher, "n_followers", 0))
 
     # -- device state ------------------------------------------------------
 
@@ -617,9 +737,11 @@ class Engine:
             return
         self._running = True
         # (Re)bind the occupancy callbacks: a stop() unbinds them, and
-        # the most recently started engine should own the gauges.
+        # the most recently started engine should own the gauges (and
+        # the /debug/engine perf section).
         for gauge, fn in self._gauge_callbacks:
             gauge.set_callback(fn)
+        register_engine_debug_section("perf", self._perf_section_fn)
         self._thread = threading.Thread(target=self._loop, name="engine-loop", daemon=True)
         self._thread.start()
 
@@ -725,11 +847,13 @@ class Engine:
             self._publisher.close()  # sends the followers "stop"
         # Fail anything still in flight so callers never hang on shutdown.
         self._fail_inflight("engine shutting down")
-        # Unbind this engine's callback gauges (only where it is still
-        # the current owner): the process-global registry must not pin
-        # the stopped engine's KV pool and jit caches for process life.
+        # Unbind this engine's callback gauges and its /debug/engine
+        # perf section (only where it is still the current owner): the
+        # process-global registries must not pin the stopped engine's
+        # KV pool and jit caches for process life.
         for gauge, fn in self._gauge_callbacks:
             gauge.clear_callback(fn)
+        unregister_engine_debug_section("perf", self._perf_section_fn)
 
     def _fail_inflight(self, message: str) -> None:
         """Error out every slotted and queued request and reset counters
@@ -1264,6 +1388,16 @@ class Engine:
                 if self._adapters is not None:
                     self._adapters.unload(sc["name"])
                 continue
+            if op == "profile":
+                # Rank 0's /debug/profile fan-out: capture the same
+                # window on a background thread so the replay loop keeps
+                # executing (the replayed dispatches ARE the trace's
+                # subject). Best-effort — never kills the follower.
+                sc = sc or {}
+                perf_obs.start_background_capture(
+                    float(sc.get("seconds", 2.0)), sc.get("dir") or None
+                )
+                continue
             if op == "decode":
                 lora_args = self._follower_lora(ar)
                 adm_hist = (
@@ -1345,10 +1479,18 @@ class Engine:
                 # First-token sync AFTER the dispatch: the chunk reads
                 # its first tokens from the device staging vector, so
                 # this host round-trip overlaps device compute.
+                t_host = time.monotonic()
                 self._emit_admitted(admitted)
                 self._run_aux()
+                # The host_overlap stall segment is measured HERE, as
+                # exactly the work between this iteration's dispatch and
+                # its fetch — deriving it as t_fetch - t_disp would span
+                # the previous chunk's whole _process_chunk (its fetch
+                # wait + emit) plus the next dispatch, double-counting
+                # segments other causes already record.
+                host_ms = (time.monotonic() - t_host) * 1000
                 if pending is not None:
-                    self._process_chunk(*pending)
+                    self._process_chunk(*pending, host_overlap_ms=host_ms)
                 pending = dispatched
                 self._update_recompile_counter()
                 if (
@@ -1356,9 +1498,10 @@ class Engine:
                     and self._aux.empty()
                 ):
                     # Idle: the goodput gauge must read 0, not the last
-                    # busy chunk's rate.
-                    if self._rate_window:
-                        self._rate_window.clear()
+                    # busy chunk's rate — and the window re-anchors so
+                    # the next busy chunk doesn't span the idle gap.
+                    if len(self._rate_window):
+                        self._rate_window.reset()
                         self.m_tok_rate.set(0.0)
                     self._wake.wait(timeout=0.05)
                     self._wake.clear()
@@ -1828,6 +1971,7 @@ class Engine:
         self._register(slot_idx, req, seed, lora_row, reuse)
         dur = time.monotonic() - t_disp
         self.m_step.observe(dur, labels={"phase": "prefill_chunked"})
+        self._stall.record_prefill("prefill_chunked", dur * 1000)
         if pad_tokens:
             self.m_pad_prefill.inc(pad_tokens)
         default_recorder.record_step(
@@ -1999,6 +2143,7 @@ class Engine:
         # prompt tokens (bucket tail pad + duplicated batch-pad rows).
         pad_tokens = n_pad * bucket - real_tokens
         self.m_step.observe(dur, labels={"phase": "prefill_group"})
+        self._stall.record_prefill("prefill_group", dur * 1000)
         if pad_tokens > 0:
             self.m_pad_prefill.inc(pad_tokens)
         default_recorder.record_step(
@@ -2017,6 +2162,7 @@ class Engine:
         because the host mutates the originals while the transfer may
         still alias them). The admission merge arrays are consumed by
         exactly this dispatch and cleared."""
+        t_start = time.monotonic()
         lora_args = {}
         if self._adapters is not None:
             lora_args = {"lora": self._adapters.bank, "lora_rows": self._h_lora_rows.copy()}
@@ -2076,9 +2222,15 @@ class Engine:
         snapshot = [
             (i, s, self._slot_epoch[i]) for i, s in enumerate(self._slots) if s is not None
         ]
-        return (d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq), snapshot, time.monotonic()
+        # (t_start, t_dispatched) bound the dispatch segment of the
+        # chunk's stall breakdown; the loop measures the overlapped
+        # host segment itself (see _loop's host_ms).
+        return (
+            (d_seq, c_seq, a_seq, lpd_seq, lpc_seq, tid_seq, tlp_seq),
+            snapshot, t_start, time.monotonic(),
+        )
 
-    def _process_chunk(self, payload, snapshot, t_disp=None):
+    def _process_chunk(self, payload, snapshot, t_start=None, t_disp=None, host_overlap_ms=0.0):
         # The top-N alternative arrays are fetched only when some slot in
         # this chunk's snapshot asked for logprobs: the device compute is
         # part of the static graph either way, but the host transfer
@@ -2108,7 +2260,8 @@ class Engine:
         # Emission below delivers terminal events — a client unblocked
         # by one must already see these observations.
         K_steps = int(acc.shape[0])
-        dur = (time.monotonic() - t_disp) if t_disp is not None else 0.0
+        t_fetched = time.monotonic()
+        dur = (t_fetched - t_disp) if t_disp is not None else 0.0
         self.m_step.observe(dur, labels={"phase": "decode_chunk"})
         self.m_slot_steps.inc(K_steps * len(snapshot), labels={"state": "active"})
         idle = K_steps * (self.cfg.max_slots - len(snapshot))
@@ -2161,17 +2314,27 @@ class Engine:
                     if self._slots[i] is slot_obj:
                         self._emit_token(i, tok, lp, top)
                         n_emitted += 1
-        # Goodput gauge: emitted tokens over a sliding ~10s of chunks.
+        # Goodput gauge: emitted tokens over a sliding ~10s window
+        # (shared TokenRateWindow — counter-delta semantics, so it
+        # agrees with the fleet collector's derivation by construction).
         now = time.monotonic()
-        self._rate_window.append((now, n_emitted))
-        cutoff = now - 10.0
-        while len(self._rate_window) > 1 and self._rate_window[0][0] < cutoff:
-            self._rate_window.popleft()
-        span = now - self._rate_window[0][0]
-        if span > 0:
-            self.m_tok_rate.set(
-                round(sum(n for _, n in self._rate_window) / span, 3)
-            )
+        self._rate_window.add(n_emitted, now)
+        self.m_tok_rate.set(round(self._rate_window.rate(now), 3))
+        # Uniform stall breakdown for this chunk (obs/perf.py causes):
+        # dispatch (argument upload + broadcast + async jit call), host
+        # overlap (emit_admitted + aux work the loop measured between
+        # its dispatch and this fetch — successfully pipelined; passed
+        # in so segments stay disjoint), fetch wait (pure host block in
+        # device_get), emit (detokenize/stop-check/delivery above).
+        emit_ms = (now - fetch_wait - t_fetch) * 1000
+        dispatch_ms = ((t_disp - t_start) if t_start is not None else 0.0) * 1000
+        self._stall.record_decode(
+            dispatch_ms=dispatch_ms,
+            host_overlap_ms=host_overlap_ms,
+            fetch_wait_ms=fetch_wait * 1000,
+            emit_ms=emit_ms,
+            now=now,
+        )
         # Flight-recorder step record: what the scheduler dispatched and
         # what came back (the /debug/engine view — batch composition,
         # token counts, kernel flavor, pages in use).
@@ -2188,6 +2351,11 @@ class Engine:
             # Pure host block inside device_get — dur_ms minus this is
             # the loop work the pipelining successfully overlapped.
             "fetch_wait_ms": round(fetch_wait * 1000, 3),
+            # The rest of the uniform stall breakdown (/debug/pipeline
+            # aggregates these over a sliding window).
+            "dispatch_ms": round(dispatch_ms, 3),
+            "host_overlap_ms": round(max(host_overlap_ms, 0.0), 3),
+            "emit_ms": round(max(emit_ms, 0.0), 3),
         }
         if G:
             step["spec_drafted"] = spec_drafted

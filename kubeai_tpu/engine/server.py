@@ -37,6 +37,7 @@ from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.faults import FaultError, fault, handle_faults_request
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs import extract_context, handle_debug_request
+from kubeai_tpu.obs.perf import handle_perf_request
 
 log = logging.getLogger("kubeai_tpu.engine.server")
 
@@ -306,7 +307,14 @@ def _make_handler(srv: EngineServer):
                 else:
                     self._json(503, {"status": "engine not ready", "model": srv.model_name})
             elif path.startswith("/debug/"):
-                resp = handle_faults_request(path, query) or handle_debug_request(path, query)
+                # Perf X-ray routes get the live engine (stall window,
+                # gang profile fan-out); the shared recorder routes and
+                # failpoints are process-global.
+                resp = (
+                    handle_faults_request(path, query)
+                    or handle_perf_request(path, query, engine=srv.engine)
+                    or handle_debug_request(path, query)
+                )
                 if resp is None:
                     return self._error(404, f"no route {path}")
                 code, ctype, body = resp

@@ -166,6 +166,29 @@ class FlightRecorder:
                     "dur": round(dur_ms * 1000, 1),
                     "args": args,
                 })
+                # Counter tracks: stalls and occupancy visible INLINE on
+                # the timeline (Perfetto renders "C" events as graphs),
+                # not only in the /debug/pipeline aggregate.
+                ts_end = round(st["t_ms"] * 1000, 1)
+                if st.get("kind") != "decode_chunk":
+                    continue
+                slots = st.get("slots")
+                if isinstance(slots, (list, tuple)):
+                    events.append({
+                        "ph": "C", "pid": 1, "name": "slot occupancy",
+                        "ts": ts_end, "args": {"active": len(slots)},
+                    })
+                if "pages_total" in st and "pages_used" in st:
+                    events.append({
+                        "ph": "C", "pid": 1, "name": "free KV pages",
+                        "ts": ts_end,
+                        "args": {"free": st["pages_total"] - st["pages_used"]},
+                    })
+                if "fetch_wait_ms" in st:
+                    events.append({
+                        "ph": "C", "pid": 1, "name": "fetch_wait_ms",
+                        "ts": ts_end, "args": {"ms": st["fetch_wait_ms"]},
+                    })
         return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
@@ -256,6 +279,15 @@ _engine_debug_sections: dict[str, object] = {}
 
 def register_engine_debug_section(key: str, fn) -> None:
     _engine_debug_sections[key] = fn
+
+
+def unregister_engine_debug_section(key: str, fn) -> None:
+    """Remove *fn* IF it is still the current provider for *key* — the
+    seam a dying owner (a stopped engine) uses so this process-global
+    dict stops pinning it, without clobbering a newer owner's
+    registration (mirrors CallbackGauge.clear_callback)."""
+    if _engine_debug_sections.get(key) is fn:
+        _engine_debug_sections.pop(key, None)
 
 
 def handle_debug_request(

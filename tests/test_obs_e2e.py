@@ -156,11 +156,15 @@ def test_perfetto_export_and_engine_steps(served):
     events = trace["traceEvents"]
     assert events
     for ev in events:
-        assert ev["ph"] in ("X", "M")
+        assert ev["ph"] in ("X", "M", "C")
         if ev["ph"] == "X":
             assert isinstance(ev["ts"], (int, float))
             assert isinstance(ev["dur"], (int, float))
     assert any(ev["name"] == "decode" for ev in events)
+    # Counter tracks ride alongside the step lane (stalls + occupancy
+    # visible inline on the Perfetto timeline).
+    counters = {ev["name"] for ev in events if ev["ph"] == "C"}
+    assert {"slot occupancy", "free KV pages", "fetch_wait_ms"} <= counters
 
 
 def test_phase_histograms_and_outcome_labels(served):

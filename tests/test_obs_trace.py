@@ -115,18 +115,28 @@ def test_chrome_trace_export_is_valid():
     tr.tok()
     tr.finish("ok")
     rec.submit(tr)
-    rec.record_step(kind="decode_chunk", steps=8, tokens=5, kernel="ragged")
+    rec.record_step(
+        kind="decode_chunk", steps=8, tokens=5, kernel="ragged",
+        slots=[0, 1], pages_used=3, pages_total=10, fetch_wait_ms=1.5,
+    )
     doc = json.loads(json.dumps(rec.chrome_trace()))
     events = doc["traceEvents"]
     assert events, "no trace events"
     for ev in events:
-        assert ev["ph"] in ("X", "M")
-        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+        assert ev["ph"] in ("X", "M", "C")
+        assert isinstance(ev["pid"], int)
         if ev["ph"] == "X":
             assert isinstance(ev["ts"], (int, float))
             assert isinstance(ev["dur"], (int, float))
     names = {e["name"] for e in events}
     assert "prefill" in names and "decode_chunk" in names
+    # Counter tracks (ph=C) for occupancy/stall visibility on the lane.
+    counters = {
+        e["name"]: e["args"] for e in events if e["ph"] == "C"
+    }
+    assert counters["slot occupancy"] == {"active": 2}
+    assert counters["free KV pages"] == {"free": 7}
+    assert counters["fetch_wait_ms"] == {"ms": 1.5}
 
 
 def test_debug_endpoints_route_and_filter():

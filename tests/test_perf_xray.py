@@ -1,0 +1,569 @@
+"""Perf X-ray suite (kubeai_tpu/obs/perf.py + engine wiring):
+
+- MFU/roofline formulas vs the hand-computed 8b-int8 numbers from
+  docs/benchmarks.md (the doc's prose math is now code — these tests
+  pin the two to each other),
+- stall-attribution math on fake-clock scripted step records (exact
+  /debug/pipeline percentages),
+- the shared TokenRateWindow: the engine gauge and the fleet
+  collector's counter-delta tok/s agree by construction, including the
+  idle→busy transition where the old deque implementation spiked,
+- profiler-capture smoke on CPU (403 when ungated, single-flight 409,
+  artifact on disk, gang fan-out op),
+- perf_gate pass / regress / schema-invalid, API and CLI.
+"""
+
+import json
+import os
+import sys
+import threading
+import time
+
+import pytest
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.models.base import ModelConfig
+from kubeai_tpu.obs import perf as perf_obs
+from kubeai_tpu.obs.perf import (
+    PerfModel,
+    PipelineStallTracker,
+    ProfilerBusy,
+    TokenRateWindow,
+    default_profiler,
+    device_constants,
+    handle_perf_request,
+    param_counts,
+)
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+FLAGSHIP_8B = ModelConfig(
+    vocab_size=128256, hidden_size=4096, intermediate_size=14336,
+    num_layers=32, num_heads=32, num_kv_heads=8, rope_theta=500000.0,
+    dtype="bfloat16",
+)
+
+
+# ---------------------------------------------------------------------------
+# Roofline / MFU accounting vs docs/benchmarks.md hand-computed values.
+
+
+class TestPerfModel:
+    def test_8b_int8_matches_docs(self):
+        """docs/benchmarks.md: ~8.03e9 params, ~8.0 GB int8 weights,
+        ~9.8 ms weight-read step floor at 819 GB/s, ~4.7-4.9k tok/s
+        roofline at 48 slots, MFU ~10% at the measured 1,225 tok/s."""
+        pm = PerfModel.from_model_config(FLAGSHIP_8B, quantization="int8")
+        assert 7.9e9 < pm.param_count < 8.2e9
+        assert pm.flops_per_token == 2 * pm.active_params
+        assert 7.9e9 < pm.weight_bytes < 8.2e9
+        floor_ms = pm.step_floor_seconds(819) * 1e3
+        assert 9.5 < floor_ms < 10.1
+        roof = pm.roofline_tokens_per_sec(48, 819)
+        assert 4400 < roof < 5100
+        mfu = pm.mfu(1225.0, 197e12)
+        assert 0.095 < mfu < 0.105  # the doc's "MFU ~10%" at r4
+
+    def test_dense_total_equals_active(self):
+        total, active = param_counts(FLAGSHIP_8B)
+        assert total == active
+
+    def test_moe_active_below_total(self):
+        mc = ModelConfig(
+            vocab_size=32000, hidden_size=1024, intermediate_size=4096,
+            num_layers=4, num_heads=8, num_kv_heads=8,
+            num_experts=8, num_experts_per_tok=2,
+        )
+        total, active = param_counts(mc)
+        assert active < total
+        pm = PerfModel.from_model_config(mc)
+        assert pm.flops_per_token == 2 * active
+        # Weight-read roofline costs every RESIDENT expert.
+        assert pm.weight_bytes == total * 2  # bf16
+
+    def test_tied_embeddings_counted_once(self):
+        tied = ModelConfig(vocab_size=1000, hidden_size=64, tie_word_embeddings=True)
+        untied = ModelConfig(vocab_size=1000, hidden_size=64)
+        assert param_counts(tied)[0] == param_counts(untied)[0] - 1000 * 64
+
+    def test_measured_weight_bytes_override(self):
+        pm = PerfModel.from_model_config(FLAGSHIP_8B, weight_bytes=5e9)
+        assert pm.weight_bytes == 5e9
+
+    def test_device_constants(self):
+        env = device_constants("TPU v5 lite")
+        assert env.peak_flops == 197e12 and env.hbm_gbps == 819
+        env = device_constants("TPU v5p chip")
+        assert env.peak_flops == 459e12 and env.hbm_gbps == 2765
+        env = device_constants("cpu")
+        assert env.peak_flops is None and env.hbm_gbps is None
+        # Unknown device: MFU/roofline read 0, never a made-up number.
+        pm = PerfModel.from_model_config(FLAGSHIP_8B)
+        assert pm.mfu(1000.0, env.peak_flops) == 0.0
+        assert pm.roofline_tokens_per_sec(48, env.hbm_gbps) is None
+
+
+# ---------------------------------------------------------------------------
+# Stall attribution: scripted fake-clock records -> exact percentages.
+
+
+class TestStallTracker:
+    def test_scripted_fractions_exact(self):
+        clock = FakeClock()
+        tr = PipelineStallTracker(window=60.0, clock=clock)
+        counter = tr._counter
+        base = counter.value(labels={"cause": "fetch_wait"})
+        for _ in range(10):
+            tr.record_decode(
+                dispatch_ms=1.0, host_overlap_ms=2.0,
+                fetch_wait_ms=6.0, emit_ms=1.0,
+            )
+            clock.advance(1.0)
+        tr.record_prefill("prefill_group", 10.0)
+        rep = tr.report()
+        assert rep["accounted_ms"] == pytest.approx(110.0)
+        causes = rep["causes"]
+        assert causes["dispatch"]["ms"] == pytest.approx(10.0)
+        assert causes["host_overlap"]["ms"] == pytest.approx(20.0)
+        assert causes["fetch_wait"]["ms"] == pytest.approx(60.0)
+        assert causes["emit"]["ms"] == pytest.approx(10.0)
+        assert causes["prefill"]["ms"] == pytest.approx(10.0)
+        # The acceptance shape: per-cause fractions sum to ~1.0 and
+        # match the scripted scenario exactly.
+        assert causes["fetch_wait"]["fraction"] == pytest.approx(60 / 110, abs=1e-3)
+        assert causes["host_overlap"]["fraction"] == pytest.approx(20 / 110, abs=1e-3)
+        assert sum(c["fraction"] for c in causes.values()) == pytest.approx(1.0, abs=1e-3)
+        assert rep["dominant_cause"] == "fetch_wait"
+        assert rep["interpretation"].startswith("55% fetch_wait")
+        assert rep["steps"] == {"decode_chunk": 10, "prefill_group": 1}
+        # The fleet-visible counter saw the same seconds.
+        assert counter.value(labels={"cause": "fetch_wait"}) - base == pytest.approx(0.060)
+
+    def test_window_prunes(self):
+        clock = FakeClock()
+        tr = PipelineStallTracker(window=30.0, clock=clock)
+        tr.record_decode(1.0, 1.0, 1.0, 1.0)
+        clock.advance(31.0)
+        assert tr.report()["accounted_ms"] == 0.0
+        assert "dominant_cause" not in tr.report()
+
+    def test_empty_report_shape(self):
+        tr = PipelineStallTracker(window=10.0, clock=FakeClock())
+        rep = tr.report()
+        assert rep["accounted_ms"] == 0.0
+        assert set(rep["causes"]) == set(perf_obs.STALL_CAUSES)
+        assert all(c["fraction"] == 0.0 for c in rep["causes"].values())
+
+
+# ---------------------------------------------------------------------------
+# Shared token-rate window: engine gauge vs fleet counter-delta.
+
+
+class TestTokenRateWindow:
+    def test_idle_to_busy_agrees_with_counter_delta(self):
+        """The regression this class exists to fix: after idle, the old
+        engine deque attributed the first chunk's tokens to ~zero
+        elapsed time (a spike); the fleet's counter-delta never did.
+        Both views now share one implementation and must agree at every
+        sample point."""
+        clock = FakeClock()
+        eng = TokenRateWindow(span=10.0, clock=clock)  # engine: increments
+        fleet = TokenRateWindow(span=0.0, clock=clock)  # fleet: per-scrape delta
+        total = 0
+        eng.add(500)
+        total += 500
+        fleet.observe_total(total)
+        assert eng.rate() == 0.0  # first sample anchors — no spike
+        assert fleet.rate() == 0.0
+        for _ in range(5):
+            clock.advance(1.0)
+            eng.add(100)
+            total += 100
+            fleet.observe_total(total)
+            assert eng.rate() == pytest.approx(fleet.rate())
+        assert eng.rate() == pytest.approx(100.0)
+
+    def test_counter_reset_reanchors(self):
+        clock = FakeClock()
+        w = TokenRateWindow(span=60.0, clock=clock)
+        w.observe_total(1000)
+        clock.advance(5)
+        w.observe_total(200)  # engine restarted: counter went backwards
+        assert w.rate() == 0.0
+        clock.advance(5)
+        w.observe_total(300)
+        assert w.rate() == pytest.approx(20.0)
+
+    def test_prune_keeps_anchor_pair(self):
+        clock = FakeClock()
+        w = TokenRateWindow(span=10.0, clock=clock)
+        for _ in range(20):
+            clock.advance(1.0)
+            w.add(50)
+        # Window spans ~10s of samples (anchor + 10-11 in-window).
+        assert len(w) <= 12
+        assert w.rate() == pytest.approx(50.0)
+        w.reset()
+        assert w.rate() == 0.0 and len(w) == 0
+
+    def test_fleet_collector_uses_shared_window(self):
+        from kubeai_tpu.autoscaler import fleet
+
+        assert fleet.TokenRateWindow is TokenRateWindow
+
+    def test_fleet_scrape_idle_busy_no_spike(self):
+        """Fleet-side view of the same transition: a first scrape after
+        a burst anchors instead of reporting the burst over dt=0."""
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        class StubLB:
+            def get_all_addresses(self, model):
+                return ["a:1"]
+
+        page = (
+            "kubeai_engine_queue_depth 0\nkubeai_engine_active_slots 1\n"
+            "kubeai_engine_slots_total 8\nkubeai_engine_kv_pages_used 5\n"
+            "kubeai_engine_kv_pages_cached 0\nkubeai_engine_kv_pages_total 100\n"
+            "kubeai_engine_generated_tokens_total {gt}\n"
+        )
+        clock = FakeClock()
+        texts = {"a:1": page.format(gt=5000)}
+        col = FleetCollector(
+            StubLB(), clock=clock, fetch=lambda addr: texts[addr]
+        )
+        agg = col.collect(["m1"])["m1"]["aggregate"]
+        assert agg["tokens_per_second"] == 0.0  # anchor only
+        texts["a:1"] = page.format(gt=5300)
+        clock.advance(10)
+        agg = col.collect(["m1"])["m1"]["aggregate"]
+        assert agg["tokens_per_second"] == 30.0
+        # busy -> idle: the very next scrape reads 0 (per-collect delta
+        # semantics — the engine gauge resets on idle, and the fleet
+        # view must not decay the old burst across a longer window).
+        clock.advance(10)
+        agg = col.collect(["m1"])["m1"]["aggregate"]
+        assert agg["tokens_per_second"] == 0.0
+
+
+# ---------------------------------------------------------------------------
+# Engine wiring e2e (CPU, tiny model): enriched step records, the
+# /debug/pipeline report, and the MFU/roofline gauges on /metrics.
+
+
+class TestEngineWiring:
+    def test_pipeline_report_and_enriched_steps(self):
+        from kubeai_tpu.engine.core import build_test_engine
+        from kubeai_tpu.engine.sampling import SamplingParams
+        from kubeai_tpu.obs import default_recorder
+
+        eng = build_test_engine()
+        assert isinstance(eng._rate_window, TokenRateWindow)
+        eng.start()
+        try:
+            ids, text, fin = eng.generate(
+                list(b"hello there"), SamplingParams(temperature=0.0, max_tokens=6),
+                timeout=120,
+            )
+            assert fin.completion_tokens > 0
+            # The "done" event is delivered BEFORE the chunk's stall
+            # record lands (emission precedes accounting by design —
+            # clients must not wait on bookkeeping): poll briefly.
+            deadline = time.monotonic() + 5.0
+            while time.monotonic() < deadline:
+                rep = eng.pipeline_report()
+                if rep["steps"].get("decode_chunk", 0) >= 1:
+                    break
+                time.sleep(0.01)
+            assert rep["accounted_ms"] > 0
+            assert sum(
+                c["fraction"] for c in rep["causes"].values()
+            ) == pytest.approx(1.0, abs=1e-3)
+            assert rep["steps"].get("decode_chunk", 0) >= 1
+            for key in ("mfu", "roofline_fraction", "tokens_per_second"):
+                assert key in rep
+            # Step records carry the uniform breakdown.
+            chunk = next(
+                s for s in default_recorder.engine_steps()
+                if s["kind"] == "decode_chunk"
+            )
+            for key in ("dispatch_ms", "host_overlap_ms", "fetch_wait_ms", "emit_ms"):
+                assert key in chunk, key
+            # HTTP route (the engine server wires srv.engine through).
+            code, ctype, body = handle_perf_request("/debug/pipeline", "", engine=eng)
+            assert code == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert "causes" in doc and "mfu" in doc
+        finally:
+            eng.stop()
+
+    def test_mfu_roofline_gauges_on_metrics_page(self):
+        from kubeai_tpu.engine.core import build_test_engine
+
+        eng = build_test_engine()
+        text = default_registry.render()
+        assert "kubeai_engine_mfu" in text
+        assert "kubeai_engine_roofline_fraction" in text
+        assert "kubeai_engine_stall_seconds_total" in text
+        # CPU: constants unresolved -> honest zeros, never invented.
+        assert eng._mfu() == 0.0
+        assert eng._roofline_fraction() == 0.0
+        section = eng._perf_debug_section()
+        assert section["flops_per_token"] == 2 * param_counts(eng.model_config)[1]
+        assert section["weight_bytes"] > 0
+        assert "stall" in section
+
+    def test_stop_unregisters_perf_section(self):
+        """stop() must unpin the engine from the process-global debug
+        registry (it holds the KV pool + jit caches via the bound
+        method) — without clobbering a newer engine's registration."""
+        from kubeai_tpu.engine.core import build_test_engine
+        from kubeai_tpu.obs.recorder import _engine_debug_sections
+
+        eng = build_test_engine()
+        assert _engine_debug_sections.get("perf") is eng._perf_section_fn
+        eng.stop()
+        assert _engine_debug_sections.get("perf") is None
+        eng2 = build_test_engine()
+        eng.stop()  # stale owner's repeat stop must not evict eng2
+        assert _engine_debug_sections.get("perf") is eng2._perf_section_fn
+        eng2.stop()
+
+    def test_pipeline_without_engine(self):
+        code, _, body = handle_perf_request("/debug/pipeline", "", engine=None)
+        assert code == 200
+        assert json.loads(body) == {"available": False, "reason": "no engine attached"}
+
+
+# ---------------------------------------------------------------------------
+# On-demand profiler capture (CPU smoke).
+
+
+class TestProfilerCapture:
+    def test_403_when_ungated(self, monkeypatch):
+        monkeypatch.delenv("KUBEAI_DEBUG_PROFILE", raising=False)
+        code, _, body = handle_perf_request("/debug/profile", "seconds=0.05", engine=None)
+        assert code == 403
+        assert "KUBEAI_DEBUG_PROFILE" in json.loads(body)["error"]["message"]
+
+    def test_smoke_capture_writes_artifacts(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KUBEAI_DEBUG_PROFILE", "1")
+        monkeypatch.setattr(default_profiler, "root", str(tmp_path))
+        code, _, body = handle_perf_request("/debug/profile", "seconds=0.05", engine=None)
+        assert code == 200
+        doc = json.loads(body)
+        assert doc["trace_dir"].startswith(str(tmp_path))
+        assert os.path.isdir(doc["trace_dir"])
+        assert doc["files"] >= 1 and doc["bytes"] > 0
+        assert doc["gang_fanout"] == 0
+
+    def test_bad_seconds_400(self, monkeypatch):
+        monkeypatch.setenv("KUBEAI_DEBUG_PROFILE", "1")
+        code, _, _ = handle_perf_request("/debug/profile", "seconds=banana", engine=None)
+        assert code == 400
+
+    def test_single_flight_409(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KUBEAI_DEBUG_PROFILE", "1")
+        monkeypatch.setattr(default_profiler, "root", str(tmp_path))
+        started = threading.Event()
+        results = {}
+
+        orig_capture = default_profiler.capture
+
+        def slow_capture(seconds, engine=None, out_dir=None):
+            # Signal once the lock is held, without burning a real trace
+            # for the whole window.
+            started.set()
+            return orig_capture(seconds, engine=engine, out_dir=out_dir)
+
+        monkeypatch.setattr(default_profiler, "capture", slow_capture)
+
+        def first():
+            results["first"] = handle_perf_request(
+                "/debug/profile", "seconds=0.8", engine=None
+            )
+
+        t = threading.Thread(target=first, daemon=True)
+        t.start()
+        assert started.wait(timeout=30)
+        time.sleep(0.1)  # let the first capture take the lock
+        code, _, body = handle_perf_request("/debug/profile", "seconds=0.05", engine=None)
+        t.join(timeout=30)
+        assert code == 409
+        assert results["first"][0] == 200
+
+    def test_gang_leader_fans_out(self):
+        """Rank 0 broadcasts a 'profile' op over the dispatch control
+        channel before starting its own trace."""
+        from kubeai_tpu.engine.core import build_test_engine
+
+        eng = build_test_engine()
+        published = []
+
+        class StubPublisher:
+            n_followers = 2
+
+            def publish(self, op, scalars, arrays):
+                published.append((op, scalars))
+
+        eng._publisher = StubPublisher()
+        try:
+            n = eng.broadcast_profile(1.5, "/tmp/trace-dir")
+            assert n == 2
+            assert published == [
+                ("profile", {"seconds": 1.5, "dir": "/tmp/trace-dir"})
+            ]
+        finally:
+            eng._publisher = None
+
+    def test_follower_capture_dir_suffixed_by_rank(self, monkeypatch):
+        """Followers suffix the broadcast dir with their rank so ranks
+        sharing a host/mount can't clobber each other's artifacts."""
+        captured = {}
+
+        def fake_capture(seconds, engine=None, out_dir=None):
+            captured["dir"] = out_dir
+            captured["done"] = threading.Event()
+            captured["done"].set()
+            return {}
+
+        monkeypatch.setattr(default_profiler, "capture", fake_capture)
+        perf_obs.start_background_capture(0.1, "/tmp/shared/profile-x")
+        deadline = time.monotonic() + 10
+        while "dir" not in captured and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert captured["dir"] == "/tmp/shared/profile-x-rank0"
+
+    def test_follower_profile_op(self, monkeypatch):
+        """A follower receiving the fan-out op starts a background
+        capture and keeps replaying (the next op still executes)."""
+        from kubeai_tpu.engine.core import build_test_engine
+
+        eng = build_test_engine()
+        calls = []
+        monkeypatch.setattr(
+            perf_obs, "start_background_capture",
+            lambda seconds, out_dir: calls.append((seconds, out_dir)),
+        )
+
+        class FakeFollower:
+            def __init__(self):
+                self.ops = [
+                    ("profile", {"seconds": 2.5, "dir": "/tmp/d"}, {}),
+                    ("stop", {}, {}),
+                ]
+
+            def recv(self):
+                return self.ops.pop(0)
+
+        eng.run_follower(FakeFollower())
+        assert calls == [(2.5, "/tmp/d")]
+
+
+# ---------------------------------------------------------------------------
+# Perf regression gate.
+
+from benchmarks.perf_gate import (  # noqa: E402
+    EXPECTED_METRIC,
+    gate,
+    load_bench,
+    main as perf_gate_main,
+    validate,
+)
+
+
+def bench_doc(value, preset="8b-int8", **kw):
+    doc = {
+        "metric": EXPECTED_METRIC,
+        "value": value,
+        "unit": "tok/s",
+        "vs_baseline": round(value / 285.25, 3),
+        "preset": preset,
+    }
+    doc.update(kw)
+    return doc
+
+
+class TestPerfGate:
+    def test_schema_valid(self):
+        assert validate(bench_doc(1225.18, mfu_pct=9.99)) == []
+
+    def test_schema_invalid_cases(self):
+        assert any("metric" in e for e in validate({"value": 1.0}))
+        assert any("unit" in e for e in validate(bench_doc(1.0) | {"unit": "rps"}))
+        assert any("value" in e for e in validate(bench_doc(1.0) | {"value": "fast"}))
+        assert any("preset" in e for e in validate(bench_doc(1.0, preset="")))
+        assert any("failed run" in e for e in validate(bench_doc(0.0) | {"error": "boom"}))
+        assert any("> 0" in e for e in validate(bench_doc(0.0)))
+
+    def test_pass_within_tolerance(self):
+        ok, report = gate(bench_doc(1150), [bench_doc(1225)])
+        assert ok and report["verdict"] == "pass"
+
+    def test_20pct_toks_regression_fails(self):
+        ok, report = gate(bench_doc(980), [bench_doc(1225)])
+        assert not ok
+        assert any("tok/s regressed" in r for r in report["regressions"])
+
+    def test_mfu_regression_fails(self):
+        ok, report = gate(
+            bench_doc(1220, mfu_pct=6.0), [bench_doc(1225, mfu_pct=10.0)]
+        )
+        assert not ok
+        assert any("MFU regressed" in r for r in report["regressions"])
+
+    def test_rate_controlled_ttft_regression_fails(self):
+        ok, report = gate(
+            bench_doc(1220, rate_controlled={"p50_ttft_ms": 900.0}),
+            [bench_doc(1225, rate_controlled={"p50_ttft_ms": 400.0})],
+        )
+        assert not ok
+        assert any("TTFT regressed" in r for r in report["regressions"])
+
+    def test_cpu_fallback_and_other_presets_excluded(self):
+        baselines = [
+            bench_doc(5000, note="accelerator init hung; CPU fallback (not a TPU number)"),
+            bench_doc(4000, preset="1.3b"),
+            bench_doc(0.0) | {"error": "all presets failed"},
+        ]
+        ok, report = gate(bench_doc(100), baselines)
+        assert ok  # nothing comparable -> baseline-setting pass
+        assert report["baselines_considered"] == 0
+
+    def test_cli_synthetic_pair(self, tmp_path):
+        """`make perf-gate` semantics on a synthetic pair: pass, then an
+        injected 20% tok/s regression exits nonzero, then schema-invalid
+        exits 2. Both envelope shapes (driver wrapper + raw line)."""
+        base = tmp_path / "BENCH_r01.json"
+        base.write_text(json.dumps(
+            {"n": 1, "parsed": bench_doc(1000.0, mfu_pct=10.0)}
+        ))
+        good = tmp_path / "BENCH_r02.json"
+        good.write_text(json.dumps(bench_doc(950.0, mfu_pct=9.5)))
+        glob_arg = str(tmp_path / "BENCH_r*.json")
+        assert perf_gate_main([str(good), "--baseline-glob", glob_arg]) == 0
+        # No explicit candidate: the newest round is gated vs the rest.
+        assert perf_gate_main(["--baseline-glob", glob_arg]) == 0
+
+        good.write_text(json.dumps(bench_doc(790.0)))  # -21% injected
+        assert perf_gate_main(["--baseline-glob", glob_arg]) == 1
+
+        bad = tmp_path / "BENCH_r03.json"
+        bad.write_text(json.dumps({"metric": "wrong", "value": 100}))
+        assert perf_gate_main([str(bad), "--baseline-glob", glob_arg]) == 2
+
+    def test_load_bench_unwraps_driver_envelope(self, tmp_path):
+        p = tmp_path / "b.json"
+        p.write_text(json.dumps({"n": 4, "rc": 0, "parsed": bench_doc(1225.18)}))
+        assert load_bench(str(p))["value"] == 1225.18

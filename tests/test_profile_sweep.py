@@ -31,6 +31,14 @@ def test_sweep_smoke_emits_full_table():
         assert ("dedicated", "slotwise", slots) in combos
         assert ("ragged", "default", slots) in combos
         assert ("ragged", "2:8", slots) in combos
+    # The sweep JSON carries the roofline constants its columns used
+    # (shared accounting, kubeai_tpu/obs/perf.py) — self-interpreting.
+    roof = doc["roofline"]
+    assert roof["assumed_device"] is True  # CPU: v5e constants, labeled
+    assert roof["flops_per_token"] > 1e10 and roof["weight_bytes"] > 1e9
+    assert roof["hbm_gbps"] > 0 and roof["peak_flops"] > 0
+    assert roof["step_floor_ms"] > 0
+
     for r in rows:
         # Every config measured (CPU reference path must never fail).
         assert r.get("error") is None, r
@@ -40,6 +48,12 @@ def test_sweep_smoke_emits_full_table():
         assert r["grid_programs"] >= 1
         assert r["q_rows_per_program"] >= 1
         assert r["kv_mb_walked"] > 0
+        # Per-cell projected MFU / roofline fraction from the shared
+        # accounting: floor/(floor + attention) is in (0, 1] and a
+        # SLOWER attention cell always projects a smaller fraction.
+        assert r["projected_toks_per_sec"] > 0
+        assert 0 < r["roofline_fraction"] <= 1
+        assert 0 < r["mfu"] <= 1
 
     # The dedicated kernel's grid must scale with slots (the design
     # property that distinguishes it from the collapsed ragged grid).
