@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot perf-gate
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -32,6 +32,12 @@ perf-gate: ## schema-validate a bench JSON + compare vs best prior BENCH_r*.json
 
 cold-start: ## scale-from-zero SLO: serial vs streamed+warmed vs parked attach
 	JAX_PLATFORMS=cpu $(PY) benchmarks/cold_start.py --json BENCH_cold_start.json
+
+disagg-bench: ## unified vs disaggregated A/B at mixed prompt lengths -> BENCH_disagg.json
+	@# Decode TPOT p95 for short streams while long prefills arrive;
+	@# comparison block schema: benchmarks/BENCH_SCHEMA.md (perf_gate.py
+	@# validates it). See docs/disaggregation.md.
+	JAX_PLATFORMS=cpu $(PY) benchmarks/disagg_bench.py --json BENCH_disagg.json
 
 OPERATOR_URL ?= http://localhost:8000
 fleet-snapshot: ## dump /debug/fleet + /debug/autoscaler + /debug/slo (runbook capture)

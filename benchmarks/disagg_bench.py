@@ -1,0 +1,334 @@
+"""Disaggregated-serving A/B: unified vs prefill/decode pools at mixed
+prompt lengths.
+
+The claim under test (ISSUE 8 / "Taming the Chaos", arxiv 2508.19559):
+once prefill stops competing with decode for the same batch, a burst of
+long prompts no longer degrades decode TPOT — the engine's single
+scheduler can't be stalled mid-decode by someone else's prefill.
+
+Topology (equal replica budget, in-process real engines):
+
+- **unified** — one model, 3 unified replicas; every request lands
+  wherever LeastLoad puts it, so long prefills share schedulers with
+  active decodes.
+- **disagg** — the same model disaggregated: 1 prefill replica
+  (handoff budget K) + 2 decode replicas; long prompts burn the
+  prefill replica while handed-off decodes stream from the decode pool.
+
+Load is two concurrent client classes against the operator proxy:
+
+- *decode-heavy*: short prompt, ``max_tokens`` big enough to stream
+  well past the handoff point — their steady-state inter-token
+  latencies (measured AFTER the handoff window so the one cutover gap
+  is not confused with decode pace) are the metric.
+- *long-prefill*: conversation-unique multi-KB prompts, tiny
+  ``max_tokens`` — pure prefill pressure arriving mid-run.
+
+Emits one JSON document (default ``BENCH_disagg.json``) whose
+``comparison`` block is schema-checked by ``benchmarks/perf_gate.py``
+(see benchmarks/BENCH_SCHEMA.md). Run via ``make disagg-bench``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import statistics
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HANDOFF_TOKENS = 4
+
+
+def pct(values, p):
+    if not values:
+        return None
+    s = sorted(values)
+    return s[min(len(s) - 1, int(len(s) * p / 100))]
+
+
+def build_stack(mode: str, replicas: int = 3):
+    """An operator stack (store/reconciler/LB/proxy/API) over REAL
+    in-process test engines: `replicas` unified engines, or 1 prefill +
+    (replicas-1) decode engines for mode="disagg". Returns (api_port,
+    metrics_fn, cleanup)."""
+    from kubeai_tpu.api import model_types as mt
+    from kubeai_tpu.api.core_types import KIND_POD
+    from kubeai_tpu.api.model_types import Disaggregation, Model, ModelSpec
+    from kubeai_tpu.config.system import System
+    from kubeai_tpu.controller.controller import ModelReconciler
+    from kubeai_tpu.disagg import ROLE_PREFILL
+    from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+    from kubeai_tpu.engine.sampling import SamplingParams
+    from kubeai_tpu.engine.server import EngineServer
+    from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+    from kubeai_tpu.proxy.handler import ModelProxy
+    from kubeai_tpu.proxy.modelclient import ModelClient
+    from kubeai_tpu.proxy.server import OpenAIServer
+    from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+    ec = EngineConfig(
+        max_slots=4, max_seq_len=512, prefill_buckets=(16, 64, 256),
+        decode_chunk=2,
+    )
+
+    def mk_engine(role="", budget=0):
+        eng = build_test_engine(engine_config=ec)
+        srv = EngineServer(
+            eng, "ab", host="127.0.0.1", port=0, role=role, handoff_budget=budget
+        )
+        srv.start()
+        eng.generate(
+            eng.tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=300,
+        )
+        return srv
+
+    if mode == "disagg":
+        servers = [mk_engine(role="prefill", budget=HANDOFF_TOKENS)] + [
+            mk_engine(role="decode") for _ in range(replicas - 1)
+        ]
+        dz = Disaggregation(
+            enabled=True,
+            prefill_replicas=1,
+            decode_replicas=replicas - 1,
+            handoff_tokens=HANDOFF_TOKENS,
+        )
+    else:
+        servers = [mk_engine() for _ in range(replicas)]
+        dz = Disaggregation(enabled=False)
+
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=30)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    store.create(
+        mt.KIND_MODEL,
+        Model(
+            meta=ObjectMeta(name="ab"),
+            spec=ModelSpec(
+                url="hf://org/ab", resource_profile="cpu:1",
+                replicas=replicas, min_replicas=replicas,
+                autoscaling_disabled=not dz.enabled,
+                disaggregation=dz,
+            ),
+        ),
+    )
+
+    deadline = time.time() + 10
+    pods = []
+    while time.time() < deadline:
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "ab"})
+        if len(pods) == replicas:
+            break
+        time.sleep(0.05)
+    if len(pods) != replicas:
+        raise RuntimeError(f"expected {replicas} pods, have {len(pods)}")
+    # Map prefill pod -> prefill server, decode/unified pods -> the rest.
+    pre_srvs = [s for s in servers if s.role == "prefill"]
+    other_srvs = [s for s in servers if s.role != "prefill"]
+    for p in sorted(pods, key=lambda p: p.meta.name):
+        pool = pre_srvs if p.meta.labels.get(mt.LABEL_ROLE) == ROLE_PREFILL else other_srvs
+        srv = pool.pop(0)
+
+        def mutate(pp, port=srv.port):
+            pp.status.ready = True
+            pp.status.pod_ip = "127.0.0.1"
+            pp.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+            pp.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(port)
+
+        store.mutate(KIND_POD, p.meta.name, mutate)
+    while time.time() < deadline:
+        if len(lb.get_all_addresses("ab")) == replicas:
+            break
+        time.sleep(0.05)
+
+    def metrics_fn():
+        from kubeai_tpu.metrics import default_registry
+
+        c = default_registry.counter("kubeai_disagg_handoffs_total")
+        return {"handoffs_ok": c.value(labels={"outcome": "ok"})}
+
+    def cleanup():
+        api.stop()
+        lb.stop()
+        rec.stop()
+        for s in servers:
+            s.stop()
+
+    return api.port, metrics_fn, cleanup
+
+
+def stream_itls(port: int, prompt: str, max_tokens: int) -> list[float] | None:
+    """One streamed completion; returns inter-event latencies (seconds)
+    or None on failure."""
+    body = {
+        "model": "ab", "prompt": prompt, "stream": True,
+        "temperature": 0, "max_tokens": max_tokens,
+    }
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    itls: list[float] = []
+    last = None
+    try:
+        with urllib.request.urlopen(req, timeout=300) as resp:
+            buf = b""
+            while True:
+                chunk = resp.read(1)
+                if not chunk:
+                    break
+                buf += chunk
+                if buf.endswith(b"\n\n"):
+                    if buf.strip().startswith(b"data:"):
+                        now = time.monotonic()
+                        if last is not None:
+                            itls.append(now - last)
+                        last = now
+                    buf = b""
+    except Exception:
+        return None
+    return itls
+
+
+def long_prompt(seed: int, chars: int) -> str:
+    rng = random.Random(seed)
+    words = ("alpha", "bravo", "delta", "echo", "golf", "hotel", "kilo", "lima")
+    out = []
+    n = 0
+    while n < chars:
+        w = rng.choice(words)
+        out.append(w)
+        n += len(w) + 1
+    return " ".join(out)
+
+
+def run_phase(port: int, decode_streams: int, long_prefills: int, max_tokens: int, long_chars: int, seed: int) -> dict:
+    """Concurrent decode-heavy streams + long-prefill arrivals; returns
+    steady-decode TPOT stats for the decode-heavy class."""
+    steady: list[float] = []
+    failures = [0]
+    lock = threading.Lock()
+
+    def decode_client(i):
+        itls = stream_itls(port, f"short chat {seed}-{i}", max_tokens)
+        if itls is None:
+            with lock:
+                failures[0] += 1
+            return
+        # Skip the handoff window (+1 for the cutover gap itself) so the
+        # metric is decode PACE, identical in both modes.
+        tail = itls[HANDOFF_TOKENS + 1:]
+        with lock:
+            steady.extend(tail)
+
+    def prefill_client(i):
+        # Unique long prompt (prefix cache can't help), tiny decode.
+        itls = stream_itls(
+            port, long_prompt(seed * 1000 + i, long_chars), 2
+        )
+        if itls is None:
+            with lock:
+                failures[0] += 1
+
+    threads = [
+        threading.Thread(target=decode_client, args=(i,), daemon=True)
+        for i in range(decode_streams)
+    ]
+    for t in threads:
+        t.start()
+    time.sleep(0.2)  # decode streams reach steady state first
+    lthreads = [
+        threading.Thread(target=prefill_client, args=(i,), daemon=True)
+        for i in range(long_prefills)
+    ]
+    for t in lthreads:
+        t.start()
+    for t in threads + lthreads:
+        t.join()
+    return {
+        "decode_streams": decode_streams,
+        "long_prefills": long_prefills,
+        "failures": failures[0],
+        "steady_itl_samples": len(steady),
+        "decode_tpot_ms": {
+            "p50": round(pct(steady, 50) * 1000, 2) if steady else None,
+            "p95": round(pct(steady, 95) * 1000, 2) if steady else None,
+            "mean": round(statistics.mean(steady) * 1000, 2) if steady else None,
+        },
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--json", default="BENCH_disagg.json")
+    parser.add_argument("--decode-streams", type=int, default=4)
+    parser.add_argument("--long-prefills", type=int, default=4)
+    parser.add_argument("--max-tokens", type=int, default=48)
+    parser.add_argument("--long-chars", type=int, default=1200)
+    parser.add_argument("--replicas", type=int, default=3)
+    parser.add_argument("--seed", type=int, default=0)
+    args = parser.parse_args(argv)
+
+    results: dict = {
+        "bench": "disagg_ab",
+        "config": {
+            "replicas": args.replicas,
+            "handoff_tokens": HANDOFF_TOKENS,
+            "decode_streams": args.decode_streams,
+            "long_prefills": args.long_prefills,
+            "max_tokens": args.max_tokens,
+            "long_prompt_chars": args.long_chars,
+        },
+    }
+    for mode in ("unified", "disagg"):
+        port, metrics_fn, cleanup = build_stack(mode, replicas=args.replicas)
+        before = metrics_fn()
+        try:
+            results[mode] = run_phase(
+                port, args.decode_streams, args.long_prefills,
+                args.max_tokens, args.long_chars, args.seed,
+            )
+        finally:
+            after = metrics_fn()
+            cleanup()
+        results[mode]["handoffs_ok"] = round(
+            after["handoffs_ok"] - before["handoffs_ok"]
+        )
+        print(json.dumps({mode: results[mode]}), file=sys.stderr)
+
+    uni = results["unified"]["decode_tpot_ms"]["p95"]
+    dis = results["disagg"]["decode_tpot_ms"]["p95"]
+    results["comparison"] = {
+        "metric": "steady_decode_tpot_p95_ms",
+        "decode_tpot_p95_ms_unified": uni,
+        "decode_tpot_p95_ms_disagg": dis,
+        "improvement_pct": (
+            round(100.0 * (uni - dis) / uni, 2) if uni and dis else None
+        ),
+        "handoffs_ok": results["disagg"]["handoffs_ok"],
+    }
+    with open(args.json, "w") as f:
+        json.dump(results, f, indent=1)
+    print(json.dumps(results["comparison"], indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,9 +89,34 @@ def validate(doc: dict) -> list[str]:
         errors.append("value must be > 0 for a successful run")
     num("p50_ttft_ms")
     num("mfu_pct")
-    for key in ("slo", "roofline", "rate_controlled"):
+    for key in ("slo", "roofline", "rate_controlled", "disagg"):
         if key in doc and not isinstance(doc[key], dict):
             errors.append(f"{key!r} must be an object when present")
+    errors.extend(validate_disagg_block(doc.get("disagg")))
+    return errors
+
+
+def validate_disagg_block(block) -> list[str]:
+    """Schema check for the disaggregation A/B comparison block
+    (benchmarks/disagg_bench.py; documented in BENCH_SCHEMA.md). The
+    block may ride inside a round's bench line (``disagg`` key) or be
+    the ``comparison`` object of a standalone BENCH_disagg.json."""
+    if block is None or not isinstance(block, dict):
+        return []
+    comp = block.get("comparison", block)
+    errors: list[str] = []
+    if not isinstance(comp, dict):
+        return ["disagg.comparison must be an object"]
+    for key in ("decode_tpot_p95_ms_unified", "decode_tpot_p95_ms_disagg"):
+        v = comp.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+            errors.append(f"disagg comparison {key!r} must be a positive number")
+    hand = comp.get("handoffs_ok")
+    if isinstance(hand, bool) or not isinstance(hand, (int, float)) or hand < 1:
+        errors.append(
+            "disagg comparison ran zero successful handoffs — the "
+            "disaggregated arm never actually disaggregated"
+        )
     return errors
 
 

@@ -46,6 +46,9 @@ LABEL_FEATURE_PREFIX = "features.kubeai.org/"
 LABEL_ADAPTER_PREFIX = "adapter.kubeai.org/"
 ANNOTATION_MODEL_POD_IP = "model-pod-ip"
 ANNOTATION_MODEL_POD_PORT = "model-pod-port"
+# Phase role of a disaggregated serving pod (kubeai_tpu/disagg):
+# "prefill" | "decode"; absent on unified pods.
+LABEL_ROLE = "kubeai.org/role"
 
 _ADAPTER_NAME_RE = re.compile(r"^[a-z0-9]+(?:[-._][a-z0-9]+)*$")
 _RESOURCE_PROFILE_RE = re.compile(r"^[a-zA-Z0-9][a-zA-Z0-9-_.]*:\d+$")
@@ -62,6 +65,34 @@ class PrefixHash:
 class LoadBalancing:
     strategy: str = LEAST_LOAD_STRATEGY
     prefix_hash: PrefixHash = field(default_factory=PrefixHash)
+
+
+@dataclass
+class Disaggregation:
+    """Disaggregated prefill/decode serving (docs/disaggregation.md):
+    the model runs as TWO pod pools — prefill replicas that serve the
+    prompt phase and the first ``handoff_tokens`` stream events, and
+    decode replicas that take over via replay-based handoff. Pools are
+    scaled independently (prefill on queue-wait pressure, decode on
+    slot/KV occupancy), so a burst of long prompts can no longer
+    degrade decode TPOT by stealing decode batch slots."""
+
+    enabled: bool = False
+    # Per-pool replica counts — mutated by the autoscaler's per-pool
+    # decisions (ModelClient.scale_pool), never by spec.replicas.
+    prefill_replicas: int = 1
+    decode_replicas: int = 1
+    max_prefill_replicas: int | None = None
+    max_decode_replicas: int | None = None
+    # K: stream events served from the prefill pool before the proxy
+    # hands the request off to a decode replica (engine-side the
+    # prefill replica caps generation at this budget).
+    handoff_tokens: int = 8
+    # Autoscaling targets, one per pool — deliberately DIFFERENT
+    # signals: queued-work-per-replica for prefill (TTFT pressure),
+    # percent slot/KV occupancy for decode (TPOT pressure).
+    prefill_target_queue: int = 4
+    decode_target_occupancy_pct: int = 80
 
 
 @dataclass
@@ -93,6 +124,7 @@ class ModelSpec:
     target_requests: int = 100
     scale_down_delay_seconds: int = 30
     load_balancing: LoadBalancing = field(default_factory=LoadBalancing)
+    disaggregation: Disaggregation = field(default_factory=Disaggregation)
     files: list[File] = field(default_factory=list)
     priority_class_name: str = ""
     owner: str = ""
@@ -168,6 +200,29 @@ def validate_model(m: Model, prev: Model | None = None) -> None:
     ph = s.load_balancing.prefix_hash
     if not (100 <= ph.mean_load_percentage):
         raise ValidationError("prefixHash.meanLoadPercentage must be >= 100")
+    dz = s.disaggregation
+    if dz.enabled:
+        if s.engine != ENGINE_TPU:
+            raise ValidationError(
+                "disaggregation requires the TPUEngine (role-aware serving)"
+            )
+        if dz.handoff_tokens < 1:
+            raise ValidationError("disaggregation.handoffTokens must be >= 1")
+        # Pools never scale to zero: an empty prefill pool would turn
+        # every new stream into a cold start, and an empty decode pool
+        # would strand every handoff.
+        if dz.prefill_replicas < 1 or dz.decode_replicas < 1:
+            raise ValidationError("disaggregation pool replicas must be >= 1")
+        if dz.max_prefill_replicas is not None and dz.max_prefill_replicas < dz.prefill_replicas:
+            raise ValidationError("maxPrefillReplicas must be >= prefillReplicas")
+        if dz.max_decode_replicas is not None and dz.max_decode_replicas < dz.decode_replicas:
+            raise ValidationError("maxDecodeReplicas must be >= decodeReplicas")
+        if dz.prefill_target_queue < 1:
+            raise ValidationError("disaggregation.prefillTargetQueue must be >= 1")
+        if not (1 <= dz.decode_target_occupancy_pct <= 100):
+            raise ValidationError(
+                "disaggregation.decodeTargetOccupancyPct must be in [1, 100]"
+            )
     # Immutability (CEL parity: url/engine immutable post-create).
     if prev is not None:
         if s.url != prev.spec.url:

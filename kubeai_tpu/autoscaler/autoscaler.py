@@ -254,6 +254,19 @@ class Autoscaler:
             fleet_view = self.fleet.collect([m.meta.name for m in models])
         for model in enabled:
             name = model.meta.name
+            dz = getattr(model.spec, "disaggregation", None)
+            if dz is not None and dz.enabled and self._has_role_endpoints(name):
+                # Disaggregated: one decision per phase-role pool, each
+                # on its own signal (prefill queue-wait vs decode
+                # occupancy) — the whole point of the split.
+                self._tick_disagg(model, fleet_view, peer_failures)
+                continue
+            # Unified path — including disagg-SPECCED models whose pods
+            # carry no role labels (the controller ignores the mode on
+            # multi-host gangs, or a mode flip hasn't rolled yet): they
+            # must keep scaling on spec.replicas, not sit unmanaged
+            # while per-pool ticks hold on no_pool_telemetry forever.
+            self._clear_pool_series(name)
             avg = self._averages.get(name)
             if avg is None:
                 avg = SimpleMovingAverage([0.0] * self.window)
@@ -324,6 +337,138 @@ class Autoscaler:
             M_SIGNAL.set(signal, labels={**labels, "source": "combined"})
         self._save_state()
         M_TICK.observe(time.monotonic() - t0)
+
+    def _has_role_endpoints(self, name: str) -> bool:
+        """Whether the model's serving pods are actually role-planned:
+        at least one endpoint carries a phase-role label. The spec can
+        ASK for disaggregation while the controller serves unified
+        (multi-host gangs; a mode flip mid-rollout) — the endpoint
+        labels are the ground truth of what is deployed. A model with
+        no endpoints at all reads unified too: disagg pools are floored
+        at 1, so a genuinely disaggregated model is only transiently
+        endpoint-less, and the unified tick's spec.replicas mutation is
+        a no-op for the role planner."""
+        roles_fn = getattr(self.lb, "get_endpoint_roles", None)
+        if not callable(roles_fn):
+            return False
+        try:
+            return any(roles_fn(name).values())
+        except Exception:
+            return False
+
+    def _clear_pool_series(self, name: str) -> None:
+        """Drop per-pool gauge series for a model served unified this
+        tick — a model flipped back from disaggregated must not export
+        its final pre-flip pool saturation forever."""
+        for role in ("prefill", "decode"):
+            labels = {"model": name, "pool": role}
+            M_DESIRED.remove(labels=labels)
+            for source in ("prefill_queue_wait", "decode_occupancy"):
+                M_SIGNAL.remove(labels={**labels, "source": source})
+
+    def _tick_disagg(self, model, fleet_view, peer_failures: list[str]) -> None:
+        """Per-pool decisions for a disaggregated model: prefill scales
+        on queue-wait pressure, decode on slot/KV-page occupancy
+        (kubeai_tpu/disagg/signals.py), each through its own moving-
+        average window (state key ``model#role``) and its own
+        consecutive-scale-down gate. Emits one DecisionLog record per
+        pool per tick with the phase signal breakdown — the audit
+        answers "why did THIS pool scale" independently. A pool with no
+        reachable telemetry this tick holds (recorded, not guessed)."""
+        from kubeai_tpu.disagg import ROLE_DECODE, ROLE_PREFILL, pool_replicas
+        from kubeai_tpu.disagg import signals as dsig
+
+        name = model.meta.name
+        dz = model.spec.disaggregation
+        # The mirror of _clear_pool_series: a model that just flipped
+        # unified → disaggregated must not keep exporting its final
+        # pre-flip model-level desired/signal values forever.
+        M_DESIRED.remove(labels={"model": name})
+        for source in ("proxy", "engine", "combined"):
+            M_SIGNAL.remove(labels={"model": name, "source": source})
+        view = fleet_view.get(name) if fleet_view is not None else None
+        pools = (view or {}).get("pools") or {}
+        for role in (ROLE_PREFILL, ROLE_DECODE):
+            key = f"{name}#{role}"
+            avg = self._averages.get(key)
+            if avg is None:
+                avg = SimpleMovingAverage([0.0] * self.window)
+                self._averages[key] = avg
+            engine_failures = [
+                e["address"]
+                for e in (view or {}).get("endpoints", [])
+                if e.get("role") == role and not e.get("ok", True)
+            ]
+            record = {
+                "t": self._clock(),
+                "model": name,
+                "pool": role,
+                "scrape_failures": {
+                    "peers": peer_failures,
+                    "engines": engine_failures,
+                },
+            }
+            agg = pools.get(role)
+            if agg is None or not agg.get("endpoints"):
+                # No reachable pool telemetry: holding is a decision
+                # too — record it so a silent pool is visible in the
+                # audit instead of reading as "never considered".
+                record.update(
+                    {
+                        "signal": None,
+                        "window_avg": None,
+                        "desired": None,
+                        "applied": False,
+                        "reason": "no_pool_telemetry",
+                        "current": pool_replicas(dz, role),
+                    }
+                )
+                self.decisions.append(record)
+                continue
+            if role == ROLE_PREFILL:
+                sig = dsig.prefill_signal(agg)
+                target = max(dz.prefill_target_queue, 1)
+                avg.next(sig["combined"])
+                mean = avg.calculate()
+                desired = dsig.desired_prefill(mean, dz)
+                source = "prefill_queue_wait"
+            else:
+                sig = dsig.decode_signal(agg)
+                target = max(min(dz.decode_target_occupancy_pct, 100), 1)
+                avg.next(sig["combined"])
+                mean = avg.calculate()
+                # Proportional control scales the pool size the
+                # occupancy was MEASURED over — the reachable endpoints
+                # — not the spec size: with 2 of 4 replicas alive at
+                # 95%, desired is ceil(2*95/80), not ceil(4*95/80).
+                live = int(agg.get("endpoints", 0)) or pool_replicas(dz, role)
+                desired = dsig.desired_decode(mean, live, dz)
+                source = "decode_occupancy"
+            # Stubbed/subclassed clients without the per-pool entry
+            # point still get an audit record.
+            scale_fn = getattr(self.model_client, "scale_pool", None)
+            outcome = scale_fn(name, role, desired) if callable(scale_fn) else {}
+            if not isinstance(outcome, dict):
+                outcome = {}
+            record.update(
+                {
+                    "signal": {**sig, "source": source},
+                    "window_avg": round(mean, 3),
+                    "target": target,
+                    "desired": desired,
+                    "clamped": outcome.get("clamped"),
+                    "current": outcome.get("current"),
+                    "applied": outcome.get("applied"),
+                    "applied_replicas": outcome.get("replicas"),
+                    "reason": outcome.get("reason"),
+                    "consecutive_scale_downs": outcome.get("consecutive_scale_downs"),
+                    "required_consecutive": outcome.get("required_consecutive"),
+                }
+            )
+            self.decisions.append(record)
+            labels = {"model": name, "pool": role}
+            M_DESIRED.set(desired, labels=labels)
+            M_SIGNAL.set(sig["combined"], labels={**labels, "source": source})
 
     def aggregate_metrics(self) -> dict[str, float]:
         """Sum active requests across every operator replica

@@ -185,6 +185,10 @@ class FleetCollector:
         # without bound.
         self._addr_seen: dict[str, float] = {}
         self.addr_ttl = 600.0
+        # model -> phase roles whose per-pool gauge series exist, so a
+        # pool that disappears (mode flipped back to unified) has its
+        # series REMOVED rather than frozen at the last pre-flip value.
+        self._pool_roles: dict[str, set[str]] = {}
 
     # -- scraping ----------------------------------------------------------
 
@@ -279,19 +283,45 @@ class FleetCollector:
         if callable(snap_fn):
             for model, eps in snap_fn().items():
                 breaker[model] = {e["address"]: e["state"] for e in eps}
+        # Phase roles (disaggregated pools) per endpoint — "" on
+        # unified pods. Guarded: tests wire fake balancers.
+        roles_fn = getattr(self.lb, "get_endpoint_roles", None)
         views: dict[str, dict] = {}
         for model in models:
+            roles = roles_fn(model) if callable(roles_fn) else {}
             eps = [rec for m, rec in scraped if m == model]
             for e in eps:
                 e["breaker_state"] = breaker.get(model, {}).get(e["address"])
+                e["role"] = roles.get(e["address"], "")
             agg = self._aggregate(eps)
             views[model] = {"endpoints": eps, "aggregate": agg}
+            # Role-dimensioned sub-aggregates: the per-pool autoscaling
+            # signals AND the /debug/fleet pool view read these.
+            role_set = sorted({e["role"] for e in eps if e.get("role")})
+            if role_set:
+                views[model]["pools"] = {
+                    role: self._aggregate([e for e in eps if e["role"] == role])
+                    for role in role_set
+                }
             labels = {"model": model}
             M_FLEET_ACTIVE.set(agg["active_slots"], labels=labels)
             M_FLEET_QUEUE.set(agg["queue_depth"], labels=labels)
             M_FLEET_FREE_PAGES.set(agg["free_pages"], labels=labels)
             M_FLEET_TPS.set(agg["tokens_per_second"], labels=labels)
             M_FLEET_HEADROOM.set(agg["headroom_requests"], labels=labels)
+            # Per-pool series (extra `pool` label) so a saturated decode
+            # pool is visible even when the prefill pool has headroom.
+            for role, pagg in views[model].get("pools", {}).items():
+                plabels = {"model": model, "pool": role}
+                M_FLEET_ACTIVE.set(pagg["active_slots"], labels=plabels)
+                M_FLEET_QUEUE.set(pagg["queue_depth"], labels=plabels)
+                M_FLEET_HEADROOM.set(pagg["headroom_requests"], labels=plabels)
+            for role in self._pool_roles.get(model, set()) - set(role_set):
+                plabels = {"model": model, "pool": role}
+                M_FLEET_ACTIVE.remove(labels=plabels)
+                M_FLEET_QUEUE.remove(labels=plabels)
+                M_FLEET_HEADROOM.remove(labels=plabels)
+            self._pool_roles[model] = set(role_set)
         with self._lock:
             self._last = views
             self._last_at = self._clock()

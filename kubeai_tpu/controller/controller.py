@@ -115,8 +115,22 @@ class ModelReconciler:
         desired = apply_json_patch_to_pod(self.system.model_server_pods.json_patches, desired)
 
         hosts = max(cfg.profile.hosts_per_replica, 1)
+        from kubeai_tpu.disagg import disagg_spec
+
+        dz = disagg_spec(model)
         if hosts > 1:
+            if dz is not None:
+                # Multi-host gangs already dedicate whole slices per
+                # replica; phase-role pools within a slice are future
+                # work — serve unified rather than half-apply.
+                log.warning(
+                    "model %s: disaggregation is ignored on multi-host "
+                    "slice gangs (hosts_per_replica=%d)",
+                    model.meta.name, hosts,
+                )
             self._execute_slice_plan(model, pods, desired, hosts)
+        elif dz is not None:
+            self._execute_disagg_plan(model, pods, desired, dz)
         else:
             plan = calculate_pod_plan(pods, model, desired, surge=self.system.model_rollouts.surge)
             if (
@@ -242,6 +256,38 @@ class ModelReconciler:
                 self.store.create(KIND_POD, pod)
             except Conflict:
                 pass
+
+    def _execute_disagg_plan(self, model: Model, pods: list[Pod], desired: Pod, dz) -> None:
+        """Disaggregated serving: one surge-rollout plan PER PHASE-ROLE
+        POOL, each with its own replica count from the disaggregation
+        spec. Role pods carry the kubeai.org/role label (routing +
+        observability) and role CLI flags (--role, --handoff-budget),
+        which feed the spec hash — flipping the mode or resizing the
+        handoff budget rolls the pods like any other spec change.
+        Unlabeled pods (a model just flipped to disaggregated) fold
+        into the decode pool's plan: their hash can't match a
+        role-stamped pod, so the rollout machinery replaces them."""
+        from kubeai_tpu.disagg import (
+            ROLE_DECODE,
+            ROLE_PREFILL,
+            pool_replicas,
+            stamp_role_pod,
+        )
+
+        by_role: dict[str, list[Pod]] = {ROLE_PREFILL: [], ROLE_DECODE: []}
+        for p in pods:
+            role = p.meta.labels.get(mt.LABEL_ROLE)
+            by_role[role if role == ROLE_PREFILL else ROLE_DECODE].append(p)
+        for role in (ROLE_PREFILL, ROLE_DECODE):
+            role_pod = stamp_role_pod(desired, role, dz)
+            plan = calculate_pod_plan(
+                by_role[role], model, role_pod,
+                surge=self.system.model_rollouts.surge,
+                replicas=pool_replicas(dz, role),
+            )
+            if plan.contains_actions():
+                plan.details.insert(0, f"{role} pool")
+            self._execute_plan(model, plan)
 
     def _execute_slice_plan(self, model: Model, pods: list[Pod], desired: Pod, hosts: int) -> None:
         """Multi-host slices: each replica is a gang of `hosts` pods with

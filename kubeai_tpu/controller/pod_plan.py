@@ -54,9 +54,12 @@ def calculate_pod_plan(
     model: Model,
     desired_pod: Pod,
     surge: int = 1,
+    replicas: int | None = None,
 ) -> PodPlan:
     """Compute creations/deletions to converge *all_pods* to the model's
-    replica count with a hash-labelled surge rollout."""
+    replica count with a hash-labelled surge rollout. *replicas*
+    overrides ``model.spec.replicas`` — disaggregated models plan each
+    phase-role pool separately with its own pool size."""
     expected_hash = pod_spec_hash(desired_pod)
     desired_pod.meta.labels[LABEL_POD_HASH] = expected_hash
     desired_pod.meta.name = ""  # name assigned per-create
@@ -73,7 +76,7 @@ def calculate_pod_plan(
         remainder.pop(p.meta.name, None)
         plan.to_delete.append(p)
 
-    desired = model.spec.replicas or 0
+    desired = (model.spec.replicas or 0) if replicas is None else replicas
     if out_of_date:
         desired += surge
     diff = len(pods) - desired

@@ -44,6 +44,23 @@ log = logging.getLogger("kubeai_tpu.engine.server")
 # Retry-After hint (seconds) on 429 backpressure responses.
 RETRY_AFTER_HINT = "1"
 
+# Disaggregated serving (docs/disaggregation.md): a prefill-role
+# replica caps streamed generations at its handoff budget and marks the
+# capped finish with this reason — the proxy's cutover signal. Decode-
+# role replicas serve uncapped and accept resumed (X-Resume-Tokens)
+# work; both are plain metadata on an otherwise identical server.
+M_HANDOFF_CAPPED = default_registry.counter(
+    "kubeai_engine_handoff_capped_total",
+    "streamed generations a prefill-role replica capped at its handoff "
+    "budget (finish_reason rewritten to 'handoff' for the proxy cutover)",
+)
+M_RESUMED = default_registry.counter(
+    "kubeai_engine_resumed_requests_total",
+    "requests arriving with X-Resume-Tokens (decode-side of a handoff, "
+    "or a mid-stream crash replay): the deterministic prefix is "
+    "regenerated here and the proxy suppresses it",
+)
+
 
 class EngineServer:
     def __init__(
@@ -53,7 +70,15 @@ class EngineServer:
         host: str = "0.0.0.0",
         port: int = 8000,
         drain_grace: float = 30.0,
+        role: str = "",
+        handoff_budget: int = 0,
     ):
+        # Disaggregated phase role ("prefill" | "decode" | "" unified).
+        # Prefill replicas cap streamed generations at handoff_budget
+        # tokens and finish them with reason "handoff" (the proxy's
+        # cutover marker); decode replicas differ only in the label.
+        self.role = role
+        self.handoff_budget = handoff_budget if role == "prefill" else 0
         # engine=None is a PARKED replica: the process holds warmed
         # compiled programs (shared compile cache + --park-config) but
         # no weights; /readyz stays 503 until a POST /v1/attach streams
@@ -287,6 +312,8 @@ def _make_handler(srv: EngineServer):
             path, _, query = self.path.partition("?")
             if path in ("/health", "/healthz"):
                 body = {"status": "ok", "model": srv.model_name}
+                if srv.role:
+                    body["role"] = srv.role
                 if srv.engine is None:
                     body["parked"] = True
                     body["attach"] = srv._attach_state
@@ -303,7 +330,10 @@ def _make_handler(srv: EngineServer):
                 elif srv.draining.is_set():
                     self._json(503, {"status": "draining", "model": srv.model_name})
                 elif srv.engine.is_ready():
-                    self._json(200, {"status": "ok", "model": srv.model_name})
+                    ready = {"status": "ok", "model": srv.model_name}
+                    if srv.role:
+                        ready["role"] = srv.role
+                    self._json(200, ready)
                 else:
                     self._json(503, {"status": "engine not ready", "model": srv.model_name})
             elif path.startswith("/debug/"):
@@ -384,9 +414,10 @@ def _make_handler(srv: EngineServer):
                     resume_tokens = max(int(rt_hdr), 0)
                 except ValueError:
                     pass
-                if resume_tokens and rid:
+                if resume_tokens:
+                    M_RESUMED.inc()
                     log.info(
-                        "request id=%s is a mid-stream replay: %d events "
+                        "request id=%s is a resumed stream: %d events "
                         "already delivered upstream", rid, resume_tokens,
                     )
             try:
@@ -539,6 +570,31 @@ def _make_handler(srv: EngineServer):
                 max_tokens = 16 if not chat else srv.engine.cfg.default_max_tokens
             elif not isinstance(max_tokens, int) or max_tokens < 1:
                 return self._error(400, "max_tokens must be a positive integer")
+            # Prefill-role replica: cap STREAMED generations at the
+            # handoff budget — a deterministic stream the proxy will
+            # cut over to a decode replica anyway must not hold a
+            # prefill-pool decode slot for its full length. The capped
+            # finish is marked finish_reason "handoff" so the proxy can
+            # tell it from a genuine length finish; a generation that
+            # completes within budget keeps its real reason and never
+            # hands off. Gated on the proxy's X-Handoff-Planned intent:
+            # a stream that reached this replica WITHOUT a planned
+            # cutover (ineligible request failing open here because the
+            # decode pool is gone, or a direct client) must serve whole
+            # — capping it would truncate the client at K tokens with a
+            # marker nobody consumes. Non-streaming bodies always pass
+            # uncapped.
+            handoff_cap = False
+            if (
+                srv.handoff_budget > 0
+                and body.get("stream")
+                and self.headers.get("X-Handoff-Planned") == "1"
+                and max_tokens > srv.handoff_budget
+            ):
+                max_tokens = srv.handoff_budget
+                handoff_cap = True
+                # (Counted at the finish rewrite, not here: a stream
+                # that stops naturally within budget was never capped.)
             def num(key, default):
                 # OpenAI documents these as "number or null": an explicit
                 # JSON null must mean the default, not float(None).
@@ -697,6 +753,7 @@ def _make_handler(srv: EngineServer):
                 self._stream_response(
                     reqs, rid, created, chat, want_logprobs, echo_text, top_n,
                     include_usage=bool(so.get("include_usage")),
+                    handoff_cap=handoff_cap,
                 )
             else:
                 self._full_response(
@@ -816,7 +873,7 @@ def _make_handler(srv: EngineServer):
                 "model": srv.model_name, "choices": choices, "usage": usage,
             })
 
-        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0, include_usage=False):
+        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0, include_usage=False, handoff_cap=False):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -875,6 +932,15 @@ def _make_handler(srv: EngineServer):
             remaining = len(reqs)
             prompt_tokens = 0
             completion_tokens = 0
+            # Budget-capped streams hold the detokenizer's text-only
+            # tail flush (ev token id -1) until the finish reason is
+            # known: a handoff finish must NOT emit it — the decode
+            # replica re-delivers those held-back bytes inside its own
+            # later chunks, so flushing here would duplicate them after
+            # the proxy's event-count suppression. A natural stop
+            # within budget forwards the held text before its finish
+            # chunk, exactly as an uncapped stream would have.
+            held_flush: dict[int, str] = {}
             try:
                 if chat:
                     # Inside the try: a client that disconnected before
@@ -907,6 +973,9 @@ def _make_handler(srv: EngineServer):
                         )
                         if not ev[2] and not has_lp:
                             continue
+                        if handoff_cap and ev[1] < 0:
+                            held_flush[idx] = held_flush.get(idx, "") + ev[2]
+                            continue
                         top = ev[4] if len(ev) > 4 else None
                         if chat:
                             choice = {"index": idx, "delta": {"content": ev[2]}, "finish_reason": None}
@@ -936,10 +1005,32 @@ def _make_handler(srv: EngineServer):
                         remaining -= 1
                         prompt_tokens = fin.prompt_tokens
                         completion_tokens += fin.completion_tokens
+                        # Budget-capped prefill finish: "length" here
+                        # means "the handoff budget ran out", not "the
+                        # client's max_tokens ran out" — the proxy keys
+                        # its cutover on the rewritten reason. A
+                        # genuine stop within budget passes through.
+                        reason = fin.reason
+                        if handoff_cap and reason == "length":
+                            reason = "handoff"
+                            M_HANDOFF_CAPPED.inc()
+                        held = held_flush.pop(idx, None)
+                        if held and reason != "handoff":
+                            send_chunk(json.dumps({
+                                "id": rid, "object": obj, "created": created,
+                                "model": srv.model_name,
+                                "choices": [
+                                    {"index": idx, "delta": {"content": held},
+                                     "finish_reason": None}
+                                    if chat
+                                    else {"index": idx, "text": held,
+                                          "finish_reason": None}
+                                ],
+                            }))
                         choice = (
-                            {"index": idx, "delta": {}, "finish_reason": fin.reason}
+                            {"index": idx, "delta": {}, "finish_reason": reason}
                             if chat
-                            else {"index": idx, "text": "", "finish_reason": fin.reason}
+                            else {"index": idx, "text": "", "finish_reason": reason}
                         )
                         payload = {
                             "id": rid, "object": obj, "created": created,
@@ -1209,6 +1300,20 @@ def make_engine_arg_parser(require_model: bool = True) -> argparse.ArgumentParse
              "auto (picked by decode query length)",
     )
     parser.add_argument(
+        "--role", default="", choices=["", "prefill", "decode"],
+        help="disaggregated phase role (docs/disaggregation.md): "
+             "prefill replicas cap streamed generations at the handoff "
+             "budget and mark the capped finish 'handoff'; decode "
+             "replicas serve uncapped and accept resumed work; empty = "
+             "unified serving",
+    )
+    parser.add_argument(
+        "--handoff-budget", type=int,
+        default=int(os.environ.get("KUBEAI_HANDOFF_BUDGET", "8")),
+        help="max tokens a prefill-role replica streams before the "
+             "capped 'handoff' finish (ignored unless --role prefill)",
+    )
+    parser.add_argument(
         "--drain-grace", type=float,
         default=float(os.environ.get("KUBEAI_DRAIN_GRACE", "30")),
         help="seconds SIGTERM lets in-flight generations finish before "
@@ -1324,10 +1429,11 @@ def main(argv=None):
     srv = EngineServer(
         engine, name, host=args.host, port=args.port,
         drain_grace=args.drain_grace,
+        role=args.role, handoff_budget=args.handoff_budget,
     )
     srv.install_signal_handlers()
     srv.start()
-    log.info("serving %s", name)
+    log.info("serving %s%s", name, f" (role={args.role})" if args.role else "")
     try:
         while not srv.stopped_event.is_set():
             srv.stopped_event.wait(3600)

@@ -40,7 +40,13 @@ def pod_endpoint(pod: Pod, allow_override: bool) -> Endpoint | None:
         for k in pod.meta.labels
         if k.startswith(mt.LABEL_ADAPTER_PREFIX)
     }
-    return Endpoint(address=f"{ip}:{port}", adapters=adapters)
+    return Endpoint(
+        address=f"{ip}:{port}",
+        adapters=adapters,
+        # Disaggregated phase role rides the controller-stamped label;
+        # "" on unified pods.
+        role=pod.meta.labels.get(mt.LABEL_ROLE, ""),
+    )
 
 
 class LoadBalancer:
@@ -172,6 +178,10 @@ class LoadBalancer:
             timeout=timeout,
             cancelled=cancelled,
             exclude=exclude,
+            # Disaggregated phase preference (set per request by the
+            # proxy; "" = no preference). A missing pool fails open to
+            # the surviving one inside get_best_addr.
+            role=getattr(req, "role", ""),
         )
         # Endpoint-pick span (duck-typed obs.SpanBuilder): this wait IS
         # the scale-from-zero cold start when no endpoint exists yet.
@@ -187,6 +197,11 @@ class LoadBalancer:
 
     def get_all_addresses(self, model_name: str) -> list[str]:
         return self.group(model_name).get_all_addrs()
+
+    def get_endpoint_roles(self, model_name: str) -> dict[str, str]:
+        """address -> phase role for the model's endpoints ("" =
+        unified) — the fleet collector's role dimension."""
+        return self.group(model_name).endpoint_roles()
 
     def get_self_ips(self) -> list[str]:
         """Ready KubeAI operator pod IPs for autoscaler peer scraping

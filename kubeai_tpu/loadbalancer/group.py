@@ -84,6 +84,10 @@ def _record_chwbl_stats(stats: dict) -> None:
 class Endpoint:
     address: str
     adapters: set[str] = field(default_factory=set)
+    # Disaggregated phase role ("prefill" | "decode") from the pod's
+    # kubeai.org/role label; "" on unified pods. Selection PREFERS a
+    # requested role but fails open across pools (see get_best_addr).
+    role: str = ""
     in_flight: int = 0
     # Passive-health circuit breaker (fed by the proxy's per-attempt
     # outcomes via EndpointGroup.report_result):
@@ -129,10 +133,17 @@ class EndpointGroup:
         timeout: float | None = None,
         cancelled: threading.Event | None = None,
         exclude: set[str] | None = None,
+        role: str = "",
     ):
         """Block until an endpoint is available and return
         ``(address, done_fn)``; ``done_fn`` must be called when the request
         completes to release the in-flight slot.
+
+        *role* is a phase-role PREFERENCE (disaggregated serving): healthy
+        same-role endpoints win, then healthy endpoints of any role —
+        a request must fall back to unified serving on the surviving
+        pool when its whole role pool is ejected, never 503 — and only
+        a total outage reaches the breaker-ignoring fail-open rungs.
 
         Raises TimeoutError on deadline, and RuntimeError if *cancelled* is
         set while waiting.
@@ -164,19 +175,31 @@ class EndpointGroup:
                 # endpoint is excluded/ejected, a total-outage group still
                 # routes (the alternative is every request blocking until
                 # the cooldown, which turns a blip into an outage).
-                name = self._choose(strategy, prefix, adapter, mean_load_factor, exclude)
-                if name is None and exclude:
-                    name = self._choose(strategy, prefix, adapter, mean_load_factor, None)
-                if name is None:
+                # Rung order with a role preference: fresh same-role >
+                # fresh any-role > already-failed endpoints > anything
+                # (breaker ignored). A fresh endpoint on the OTHER pool
+                # beats re-picking one that already failed this request
+                # — and an ejected role pool loses to the healthy other
+                # pool, so the breaker-ignoring rungs drop the role
+                # filter too.
+                rungs = [(role, exclude, False)]
+                if role:
+                    rungs.append(("", exclude, False))
+                if exclude:
+                    rungs.append((role, None, False))
+                    if role:
+                        rungs.append(("", None, False))
+                rungs.append(("", exclude, True))
+                if exclude:
+                    rungs.append(("", None, True))
+                name = None
+                for r_role, r_exclude, r_ignore in rungs:
                     name = self._choose(
-                        strategy, prefix, adapter, mean_load_factor, exclude,
-                        ignore_breaker=True,
+                        strategy, prefix, adapter, mean_load_factor, r_exclude,
+                        ignore_breaker=r_ignore, role=r_role,
                     )
-                if name is None and exclude:
-                    name = self._choose(
-                        strategy, prefix, adapter, mean_load_factor, None,
-                        ignore_breaker=True,
-                    )
+                    if name is not None:
+                        break
                 if name is None:
                     # No endpoint can serve this request (e.g. adapter not
                     # yet loaded anywhere) — wait for the endpoint set to
@@ -209,10 +232,11 @@ class EndpointGroup:
         mean_load_factor: float,
         exclude: set[str] | None = None,
         ignore_breaker: bool = False,
+        role: str = "",
     ):
-        # Single source of truth for retry exclusion + breaker ejection;
-        # None when neither applies (keeps the CHWBL fast path allocation-
-        # free in the healthy steady state).
+        # Single source of truth for retry exclusion + breaker ejection
+        # + role filtering; None when none applies (keeps the CHWBL fast
+        # path allocation-free in the healthy steady state).
         now = self._clock()
         breaker_live = (
             not ignore_breaker
@@ -223,9 +247,11 @@ class EndpointGroup:
             )
         )
         allowed = None
-        if exclude or breaker_live:
+        if exclude or breaker_live or role:
             def allowed(name):
                 ep = self._endpoints[name]
+                if role and ep.role != role:
+                    return False
                 if exclude and ep.address in exclude:
                     return False
                 if breaker_live and not self._breaker_allows(ep, now):
@@ -355,6 +381,9 @@ class EndpointGroup:
                 {
                     "name": name,
                     "address": ep.address,
+                    # Phase role so an ejected prefill replica is
+                    # attributable to its pool in every debug surface.
+                    "role": ep.role,
                     "state": ep.breaker_state,
                     "consecutive_failures": ep.consecutive_failures,
                     "in_flight": ep.in_flight,
@@ -379,9 +408,11 @@ class EndpointGroup:
                 cur = self._endpoints.get(name)
                 if cur is not None:
                     cur.adapters = set(obs.adapters)
+                    cur.role = obs.role
                 else:
                     self._endpoints[name] = Endpoint(
-                        address=obs.address, adapters=set(obs.adapters)
+                        address=obs.address, adapters=set(obs.adapters),
+                        role=obs.role,
                     )
                     self._ring.add(name)
             for name in list(self._endpoints):
@@ -401,6 +432,13 @@ class EndpointGroup:
     def get_all_addrs(self) -> list[str]:
         with self._lock:
             return [ep.address for ep in self._endpoints.values()]
+
+    def endpoint_roles(self) -> dict[str, str]:
+        """address -> phase role ("" for unified pods) — the fleet
+        collector's role dimension for /debug/fleet and the per-pool
+        autoscaling signals."""
+        with self._lock:
+            return {ep.address: ep.role for ep in self._endpoints.values()}
 
     def total_in_flight(self) -> int:
         with self._lock:

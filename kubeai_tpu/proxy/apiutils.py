@@ -44,6 +44,11 @@ class Request:
     # or X-Request-Timeout header; the proxy budgets it across await /
     # connect / stream and forwards the remainder to the engine).
     timeout: float | None = None
+    # Disaggregated phase-role routing preference ("prefill" | "decode"
+    # | ""), set by the proxy per request and FLIPPED at the handoff
+    # point — endpoint selection prefers this pool and fails open to
+    # the surviving one.
+    role: str = ""
 
     @property
     def load_balancing(self) -> mt.LoadBalancing:

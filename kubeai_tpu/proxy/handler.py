@@ -20,6 +20,7 @@ import logging
 import threading
 import time
 
+from kubeai_tpu.disagg.handoff import is_handoff_event as _is_handoff_event
 from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
@@ -159,6 +160,41 @@ class ModelProxy:
         # streaming request can be seamlessly resumed on another
         # endpoint if its replica dies mid-stream.
         replayable = replay_enabled() and request_replayable(req.body)
+        # Disaggregated routing: handoff-eligible requests (mirror of
+        # replay eligibility — the handoff IS a planned replay) start on
+        # the prefill pool and cut over at the engine's handoff marker;
+        # everything else serves unified on the decode pool, whose
+        # replicas are uncapped.
+        handoff_planned = False
+        dspec = (
+            req.model_obj.spec.disaggregation
+            if req.model_obj is not None
+            and getattr(req.model_obj.spec, "disaggregation", None) is not None
+            and req.model_obj.spec.disaggregation.enabled
+            else None
+        )
+        if dspec is not None and not self._has_role_endpoints(req.model_name):
+            # The spec ASKS for disaggregation but the deployment is
+            # unified right now — multi-host gangs (controller ignores
+            # the mode), a mode flip not yet rolled, or cold start with
+            # no endpoints. Serve unified: planning a handoff no engine
+            # will ever mark would misreport mode="handoff" forever and
+            # pin a role preference nothing can satisfy. (Same
+            # endpoint-labels-are-ground-truth rule as the autoscaler.)
+            dspec = None
+        if dspec is not None:
+            from kubeai_tpu.disagg import ROLE_DECODE, ROLE_PREFILL
+            from kubeai_tpu.disagg.handoff import M_DISAGG_REQUESTS
+
+            if replayable:
+                req.role = ROLE_PREFILL
+                handoff_planned = True
+                M_DISAGG_REQUESTS.inc(labels={"mode": "handoff"})
+            else:
+                req.role = ROLE_DECODE
+                M_DISAGG_REQUESTS.inc(labels={"mode": "unified"})
+            if req.trace is not None:
+                req.trace.attrs["disagg_mode"] = "handoff" if replayable else "unified"
         # Latency hedging eligibility: opt-in, non-streaming JSON only
         # (a hedge re-issues the whole request; streams replay instead).
         hedge_on = (
@@ -175,9 +211,19 @@ class ModelProxy:
         # the proxy's span, not onto the client's.
         headers = {
             k: v for k, v in headers.items()
-            if k.lower() not in ("x-request-id", "traceparent", "x-request-deadline")
+            if k.lower() not in (
+                "x-request-id", "traceparent", "x-request-deadline",
+                "x-handoff-planned",
+            )
         }
         headers["X-Request-ID"] = req.id
+        if handoff_planned:
+            # Prefill replicas cap ONLY streams the proxy will actually
+            # hand off: an ineligible stream that failed open onto the
+            # prefill pool (decode pool ejected) must serve WHOLE — a
+            # cap there would truncate the client at K tokens with a
+            # marker nobody consumes.
+            headers["X-Handoff-Planned"] = "1"
         if tb is not None:
             headers["traceparent"] = tb.child_traceparent()
         last_err: Exception | str | None = None
@@ -332,6 +378,7 @@ class ModelProxy:
                 body_iter = self._stream_with_replay(
                     req, path, dict(headers), body, release, cancelled, tb,
                     resp, conn, done, addr, t_conn, failed_addrs, remaining,
+                    handoff=dspec if handoff_planned else None,
                 )
             else:
                 body_iter = self._body_iter(
@@ -344,6 +391,18 @@ class ModelProxy:
             req.id, req.model_name, attempts, last_err,
         )
         raise APIError(502, f"upstream unavailable after {attempts} attempts: {last_err}")
+
+    def _has_role_endpoints(self, model_name: str) -> bool:
+        """Whether the model's deployment is actually role-planned: at
+        least one endpoint carries a phase-role label (the ground truth
+        of what the controller deployed, vs what the spec asks for)."""
+        roles_fn = getattr(self.lb, "get_endpoint_roles", None)
+        if not callable(roles_fn):
+            return False
+        try:
+            return any(roles_fn(model_name).values())
+        except Exception:
+            return False
 
     def _connect(self, addr: str, path: str, headers: dict[str, str], body: bytes, timeout: float | None = None):
         # Failpoint: chaos tests inject connect errors/delays/hangs (and
@@ -478,7 +537,7 @@ class ModelProxy:
         _, a, d, resp, conn, t_start = winner
         return resp, conn, a, d, t_start
 
-    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining):
+    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining, handoff=None):
         """Stream an SSE body with mid-stream replay: events are
         forwarded whole (a half-event from a dying upstream never
         reaches the client); when the upstream dies after N delivered
@@ -490,7 +549,17 @@ class ModelProxy:
         are bounded by max_retries, gated by the retry budget, and
         deadline-aware. When replay is impossible the original error
         propagates and the client sees the truncation, exactly as
-        before."""
+        before.
+
+        *handoff* (the model's Disaggregation spec, or None) arms the
+        PLANNED variant of the same mechanism: the first upstream is a
+        prefill replica whose budget-capped generation ends with a
+        ``finish_reason: "handoff"`` marker chunk. The marker is
+        withheld from the client; the stream cuts over to a decode
+        replica carrying the same resume cursor a crash replay would,
+        and a decode replica dying AFTER the cutover falls back to the
+        ordinary replay path (req.role keeps routing to the decode
+        pool)."""
         forwarded = 0  # data events delivered to the client (excl. [DONE])
         suppress = 0  # data events to drop from the current (replayed) stream
         replays = 0
@@ -510,8 +579,15 @@ class ModelProxy:
         try:
             while True:
                 died: Exception | None = None
+                cutover = False
                 try:
                     for ev in sse_events(reader(resp)):
+                        if handoff is not None and _is_handoff_event(ev):
+                            # The prefill engine's budget-cap marker:
+                            # never forwarded — the decode stream owns
+                            # the real finish.
+                            cutover = True
+                            break
                         if is_token_event(ev):
                             if suppress:
                                 suppress -= 1
@@ -520,6 +596,27 @@ class ModelProxy:
                         yield ev
                 except Exception as e:
                     died = e
+                if cutover:
+                    # The prefill upstream finished its whole job:
+                    # clean success for the breaker, then the planned
+                    # re-dispatch (conn/done nulled first — on a failed
+                    # cutover the finally must not double-release).
+                    self.lb.report_result(
+                        req.model_name, addr, ok=True, started_at=t_conn
+                    )
+                    try:
+                        conn.close()
+                    finally:
+                        done()
+                    conn = None
+                    done = None
+                    resp, conn, done, addr, t_conn = self._handoff_to_decode(
+                        req, path, base_headers, body, cancelled, tb,
+                        addr, failed_addrs, remaining, forwarded,
+                    )
+                    handoff = None  # one planned cutover per request
+                    suppress = forwarded
+                    continue
                 if died is None:
                     expected = getattr(resp, "length", None)
                     if expected not in (None, 0):
@@ -583,6 +680,66 @@ class ModelProxy:
                 tb.attrs["replays"] = replays
                 tb.finish(outcome, status=200)
 
+    def _handoff_to_decode(self, req, path, base_headers, body, cancelled, tb, prefill_addr, failed_addrs, remaining, forwarded):
+        """Planned prefill→decode cutover (docs/disaggregation.md): flip
+        the request's role to the decode pool, acquire a decode
+        upstream carrying the resume cursor, and account the handoff
+        (metrics + a trace record). The caller already released the
+        prefill connection. On failure the raised HandoffError
+        propagates out of the stream generator — the client sees the
+        truncation, exactly like an exhausted replay."""
+        from kubeai_tpu.disagg import ROLE_DECODE
+        from kubeai_tpu.disagg.handoff import (
+            M_HANDOFF_LATENCY,
+            M_HANDOFFS,
+            HandoffError,
+            acquire_handoff_upstream,
+        )
+
+        t_hand = time.monotonic()
+        req.role = ROLE_DECODE
+        rem = remaining()
+        if rem is not None and rem <= 0:
+            M_HANDOFFS.inc(labels={"outcome": "deadline"})
+            if tb is not None:
+                tb.add_span(
+                    "handoff", t_hand, source=prefill_addr,
+                    events=forwarded, error="deadline",
+                )
+            raise HandoffError(
+                f"deadline exceeded at handoff after {forwarded} events"
+            )
+        try:
+            resp, conn, done, addr, t_conn = acquire_handoff_upstream(
+                self, req, path, base_headers, body, cancelled,
+                failed_addrs, remaining, forwarded,
+            )
+        except HandoffError as e:
+            outcome = "deadline" if "deadline" in str(e) else "failed"
+            M_HANDOFFS.inc(labels={"outcome": outcome})
+            if tb is not None:
+                tb.add_span(
+                    "handoff", t_hand, source=prefill_addr,
+                    events=forwarded, error=str(e)[:200],
+                )
+            log.info(
+                "request id=%s handoff failed after %d events: %s",
+                req.id, forwarded, e,
+            )
+            raise
+        M_HANDOFF_LATENCY.observe(time.monotonic() - t_hand)
+        M_HANDOFFS.inc(labels={"outcome": "ok"})
+        if tb is not None:
+            tb.add_span(
+                "handoff", t_hand, source=prefill_addr, endpoint=addr,
+                events=forwarded,
+            )
+        log.info(
+            "request id=%s handed off %s -> %s at event %d",
+            req.id, prefill_addr, addr, forwarded,
+        )
+        return resp, conn, done, addr, t_conn
+
     def _acquire_replay_upstream(self, req, path, base_headers, body, cancelled, failed_addrs, remaining, forwarded, replays, died):
         """Find and connect a fresh endpoint for a mid-stream replay.
         Each attempt (including connect failures and non-200 answers)
@@ -609,39 +766,62 @@ class ModelProxy:
             except (TimeoutError, RuntimeError):
                 raise died from None
             hdrs = dict(base_headers)
-            # The resume cursor: how many stream events the client has
-            # already received — the engine logs/records it; the proxy
-            # suppresses exactly this many events of the fresh stream.
-            hdrs["X-Resume-Tokens"] = str(forwarded)
-            rem = remaining()
-            if rem is not None:
-                hdrs["X-Request-Deadline"] = f"{max(rem, 0.001):.3f}"
-            t_conn = time.monotonic()
-            try:
-                resp, conn = self._connect(addr, path, hdrs, body, timeout=rem)
-            except (ConnectionError, OSError, http.client.HTTPException) as e:
-                done()
-                self.lb.report_result(req.model_name, addr, ok=False)
-                failed_addrs.add(addr)
-                log.info("replay connect to %s failed: %s", addr, e)
-                continue
-            if resp.status != 200 or not (
-                resp.getheader("Content-Type") or ""
-            ).startswith("text/event-stream"):
-                # Only a fresh 200 SSE stream can be grafted into the
-                # open stream.
-                try:
-                    resp.read()
-                except Exception:
-                    pass
-                conn.close()
-                done()
-                if resp.status >= 500:
-                    self.lb.report_result(req.model_name, addr, ok=False)
-                failed_addrs.add(addr)
-                log.info("replay upstream %s answered %d", addr, resp.status)
+            # A replay keeps the planned-handoff intent only while the
+            # request is still on its prefill leg: a post-cutover
+            # replay that fails open onto the prefill replica must be
+            # served whole, not budget-capped a second time.
+            if getattr(req, "role", "") != "prefill":
+                hdrs.pop("X-Handoff-Planned", None)
+            resp, conn, t_conn, err = self._connect_resume_upstream(
+                req, addr, done, path, hdrs, body, remaining(),
+                failed_addrs, forwarded,
+            )
+            if resp is None:
+                log.info("replay to %s failed: %s", addr, err)
                 continue
             return resp, conn, done, addr, t_conn, replays
+
+    def _connect_resume_upstream(self, req, addr, done, path, hdrs, body, rem, failed_addrs, forwarded):
+        """The shared connect-and-validate step for RESUMED dispatches —
+        crash replays and planned handoffs both graft a fresh upstream
+        into the client's open stream, so both must stamp the resume
+        cursor + remaining deadline and accept only a 200 SSE answer.
+        One implementation keeps the two legs from drifting.
+
+        Returns ``(resp, conn, t_conn, None)`` on success, or
+        ``(None, None, None, err)`` with ALL failure bookkeeping done
+        (endpoint-pick release, breaker feedback, failed-address)."""
+        # The resume cursor: how many stream events the client has
+        # already received — the engine logs/records it; the proxy
+        # suppresses exactly this many events of the fresh stream.
+        hdrs["X-Resume-Tokens"] = str(forwarded)
+        if rem is not None:
+            hdrs["X-Request-Deadline"] = f"{max(rem, 0.001):.3f}"
+        t_conn = time.monotonic()
+        try:
+            resp, conn = self._connect(addr, path, hdrs, body, timeout=rem)
+        except (ConnectionError, OSError, http.client.HTTPException) as e:
+            done()
+            self.lb.report_result(req.model_name, addr, ok=False)
+            failed_addrs.add(addr)
+            return None, None, None, e
+        if resp.status != 200 or not (
+            resp.getheader("Content-Type") or ""
+        ).startswith("text/event-stream"):
+            # Only a fresh 200 SSE stream can be grafted into the open
+            # stream. A saturated 429 is alive-but-busy: like the main
+            # retry loop, only 5xx feeds the breaker.
+            try:
+                resp.read()
+            except Exception:
+                pass
+            conn.close()
+            done()
+            if resp.status >= 500:
+                self.lb.report_result(req.model_name, addr, ok=False)
+            failed_addrs.add(addr)
+            return None, None, None, f"resume upstream answered {resp.status}"
+        return resp, conn, t_conn, None
 
     @staticmethod
     def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None):

@@ -45,6 +45,11 @@ class ModelClient:
         autoscaling is enabled and current replicas == 0."""
         if model.spec.autoscaling_disabled:
             return
+        # Disaggregated models get the kick too: their pools are floored
+        # at 1 so the spec.replicas mutation is a harmless no-op for the
+        # role planner — but on topologies where disaggregation is
+        # ignored (multi-host slice gangs), spec.replicas IS the driver
+        # and skipping here would break scale-from-zero entirely.
         try:
             def mutate(m):
                 if (m.spec.replicas or 0) == 0:
@@ -54,69 +59,107 @@ class ModelClient:
         except NotFound:
             pass
 
-    def scale(self, model_name: str, desired: int) -> dict:
-        """Autoscaler-driven scale (ref: scale.go:43-100): scale-up applies
-        immediately; scale-down only after N consecutive decisions; always
-        clamped to [minReplicas, maxReplicas]. Returns the decision detail
-        the autoscaler's audit log records — desired vs clamped, the
-        replica count before/after, and applied-or-skipped with a reason
-        (existing callers that ignore the return value are unaffected)."""
+    @staticmethod
+    def _decision(desired: int, applied: bool, reason: str, clamped=None, current=None, replicas=None, n=None, required=None) -> dict:
+        """The audit-record shape every scale decision returns — one
+        builder so unified and per-pool records can never drift."""
+        return {
+            "desired": desired,
+            "clamped": clamped,
+            "current": current,
+            "replicas": replicas if replicas is not None else current,
+            "applied": applied,
+            "reason": reason,
+            "consecutive_scale_downs": n,
+            "required_consecutive": required,
+        }
 
-        def decision(applied: bool, reason: str, clamped=None, current=None, replicas=None, n=None, required=None) -> dict:
-            return {
-                "desired": desired,
-                "clamped": clamped,
-                "current": current,
-                "replicas": replicas if replicas is not None else current,
-                "applied": applied,
-                "reason": reason,
-                "consecutive_scale_downs": n,
-                "required_consecutive": required,
-            }
-
-        try:
-            model = self.store.get(mt.KIND_MODEL, model_name, self.namespace)
-        except NotFound:
-            return decision(False, "model_not_found")
-        s = model.spec
-        clamped = max(desired, s.min_replicas)
-        if s.max_replicas is not None:
-            clamped = min(clamped, s.max_replicas)
-        current = s.replicas or 0
-
+    def _gated_apply(self, gate_key: str, model: mt.Model, desired: int, clamped: int, current: int, mutate) -> dict:
+        """The shared scale policy (ref: scale.go:43-100): scale-up
+        applies immediately; scale-down only after N consecutive
+        decisions (check-then-increment — it fires on the (required+1)th
+        and keeps firing until a non-scale-down decision resets the
+        counter, keyed by *gate_key* so pools gate independently)."""
         n = required = None
         if clamped < current:
-            # Check-then-increment (ref: scale.go:56-66): the scale-down
-            # fires on the (required+1)th consecutive decision and keeps
-            # firing until a non-scale-down decision resets the counter.
             with self._lock:
-                n = self._consecutive_scale_downs.get(model_name, 0)
+                n = self._consecutive_scale_downs.get(gate_key, 0)
                 required = self._required_consecutive(model)
                 if n < required:
-                    self._consecutive_scale_downs[model_name] = n + 1
-                    return decision(
-                        False, "scale_down_deferred",
+                    self._consecutive_scale_downs[gate_key] = n + 1
+                    return self._decision(
+                        desired, False, "scale_down_deferred",
                         clamped=clamped, current=current,
                         n=n + 1, required=required,
                     )
         else:
             with self._lock:
-                self._consecutive_scale_downs[model_name] = 0
+                self._consecutive_scale_downs[gate_key] = 0
             if clamped == current:
-                return decision(
-                    False, "no_change", clamped=clamped, current=current
+                return self._decision(
+                    desired, False, "no_change", clamped=clamped, current=current
                 )
+        try:
+            self.store.mutate(mt.KIND_MODEL, model.meta.name, mutate, self.namespace)
+        except NotFound:
+            return self._decision(
+                desired, False, "model_not_found", clamped=clamped, current=current
+            )
+        return self._decision(
+            desired, True,
+            "scaled_down" if clamped < current else "scaled_up",
+            clamped=clamped, current=current, replicas=clamped,
+            n=n, required=required,
+        )
+
+    def scale(self, model_name: str, desired: int) -> dict:
+        """Autoscaler-driven scale (ref: scale.go:43-100): clamped to
+        [minReplicas, maxReplicas], applied through the shared gate.
+        Returns the decision detail the autoscaler's audit log records
+        (existing callers that ignore the return value are unaffected)."""
+        try:
+            model = self.store.get(mt.KIND_MODEL, model_name, self.namespace)
+        except NotFound:
+            return self._decision(desired, False, "model_not_found")
+        s = model.spec
+        clamped = max(desired, s.min_replicas)
+        if s.max_replicas is not None:
+            clamped = min(clamped, s.max_replicas)
 
         def mutate(m):
             m.spec.replicas = clamped
 
+        return self._gated_apply(
+            model_name, model, desired, clamped, s.replicas or 0, mutate
+        )
+
+    def scale_pool(self, model_name: str, role: str, desired: int) -> dict:
+        """Per-pool scale for a disaggregated model: the same gate as
+        scale() keyed per pool (a draining decode pool cannot reset the
+        prefill pool's counter), clamped to [1, maxPool] and applied to
+        the disaggregation spec fields the controller plans each pool
+        from."""
+        from kubeai_tpu.disagg import ROLE_PREFILL, pool_max_replicas, pool_replicas
+
         try:
-            self.store.mutate(mt.KIND_MODEL, model_name, mutate, self.namespace)
+            model = self.store.get(mt.KIND_MODEL, model_name, self.namespace)
         except NotFound:
-            return decision(False, "model_not_found", clamped=clamped, current=current)
-        return decision(
-            True,
-            "scaled_down" if clamped < current else "scaled_up",
-            clamped=clamped, current=current, replicas=clamped,
-            n=n, required=required,
+            return self._decision(desired, False, "model_not_found")
+        dz = model.spec.disaggregation
+        if not dz.enabled:
+            return self._decision(desired, False, "not_disaggregated")
+        clamped = max(desired, 1)  # pools never scale to zero (v1)
+        cap = pool_max_replicas(dz, role)
+        if cap is not None:
+            clamped = min(clamped, cap)
+
+        def mutate(m):
+            if role == ROLE_PREFILL:
+                m.spec.disaggregation.prefill_replicas = clamped
+            else:
+                m.spec.disaggregation.decode_replicas = clamped
+
+        return self._gated_apply(
+            f"{model_name}/{role}", model, desired, clamped,
+            pool_replicas(dz, role), mutate,
         )

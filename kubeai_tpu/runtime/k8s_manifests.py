@@ -251,6 +251,23 @@ def model_manifest(model: Model) -> dict[str, Any]:
                 "prefixCharLength": ph.prefix_char_length,
             },
         }
+    from kubeai_tpu.api.model_types import Disaggregation
+
+    if s.disaggregation != Disaggregation():
+        dz = s.disaggregation
+        dz_doc: dict[str, Any] = {
+            "enabled": dz.enabled,
+            "prefillReplicas": dz.prefill_replicas,
+            "decodeReplicas": dz.decode_replicas,
+            "handoffTokens": dz.handoff_tokens,
+            "prefillTargetQueue": dz.prefill_target_queue,
+            "decodeTargetOccupancyPct": dz.decode_target_occupancy_pct,
+        }
+        if dz.max_prefill_replicas is not None:
+            dz_doc["maxPrefillReplicas"] = dz.max_prefill_replicas
+        if dz.max_decode_replicas is not None:
+            dz_doc["maxDecodeReplicas"] = dz.max_decode_replicas
+        spec["disaggregation"] = dz_doc
     if s.adapters:
         spec["adapters"] = [{"name": a.name, "url": a.url} for a in s.adapters]
     if s.files:

@@ -1117,9 +1117,23 @@ class TestReplayEligibility:
 class ScriptedSSEEngine:
     """Streams a scripted SSE event sequence; the first *die_after*-armed
     request is severed (socket slam) after that many events. Records the
-    X-Resume-Tokens header of every request."""
+    X-Resume-Tokens header of every request.
 
-    def __init__(self, events: list[str], die_after: int | None = None):
+    *die_on_resume* scopes the death to requests whose X-Resume-Tokens
+    header equals it ("" = requests WITHOUT a resume cursor) — the
+    deterministic seam for disaggregated chaos, where several identical
+    replicas must die at a specific hop of the handoff/replay chain
+    regardless of which replica the balancer picks. *delay_before*
+    ({event_index: seconds}) sleeps before writing an event, for
+    deadline-expiry scenarios."""
+
+    def __init__(
+        self,
+        events: list[str],
+        die_after: int | None = None,
+        die_on_resume: str | None = None,
+        delay_before: dict[int, float] | None = None,
+    ):
         outer = self
         self.resume_headers: list[str | None] = []
         self.die_remaining = 1 if die_after is not None else 0
@@ -1135,8 +1149,11 @@ class ScriptedSSEEngine:
 
                 n = int(self.headers.get("Content-Length", 0))
                 self.rfile.read(n)
-                outer.resume_headers.append(self.headers.get("X-Resume-Tokens"))
-                die_here = outer.die_remaining > 0
+                resume = self.headers.get("X-Resume-Tokens")
+                outer.resume_headers.append(resume)
+                die_here = outer.die_remaining > 0 and (
+                    die_on_resume is None or (resume or "") == die_on_resume
+                )
                 if die_here:
                     outer.die_remaining -= 1
                 self.send_response(200)
@@ -1147,6 +1164,8 @@ class ScriptedSSEEngine:
                     if die_here and i >= die_after:
                         self.connection.shutdown(_socket.SHUT_RDWR)
                         return
+                    if delay_before and i in delay_before:
+                        time.sleep(delay_before[i])
                     data = f"data: {ev}\n\n".encode()
                     self.wfile.write(
                         f"{len(data):x}\r\n".encode() + data + b"\r\n"
@@ -1283,6 +1302,162 @@ class TestMidStreamReplay:
         )
         drain_engine(eng)
         assert eng._pool.used() == 0
+
+
+class TestDisaggChaos:
+    """Deterministic disaggregated-serving chaos (ISSUE 8 satellite):
+    replica death at every hop of the prefill→decode handoff chain, and
+    deadline enforcement at the cutover point. Scripted engines keep
+    the scenarios balancer-pick-independent: death is keyed on the
+    X-Resume-Tokens hop, not on which replica got picked first."""
+
+    TOK = [
+        '{"choices": [{"index": 0, "text": "tok%d", "finish_reason": null}]}' % i
+        for i in range(5)
+    ]
+    FULL = TOK + [
+        '{"choices": [{"index": 0, "text": "", "finish_reason": "stop"}]}',
+        "[DONE]",
+    ]
+    # A prefill replica with handoff budget 2: two token events, then
+    # the budget-cap marker (never forwarded to clients), then DONE.
+    PREFILL = TOK[:2] + [
+        '{"choices": [{"index": 0, "text": "", "finish_reason": "handoff"}]}',
+        "[DONE]",
+    ]
+
+    def setup_disagg(self, stack, prefill_engines, decode_engines, handoff_tokens=2):
+        store, rec, lb, mc, api, engines = stack
+        engines.extend(prefill_engines + decode_engines)
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name="dz1"),
+                spec=ModelSpec(
+                    url="hf://org/model", resource_profile="cpu:1",
+                    min_replicas=0,
+                    disaggregation=mt.Disaggregation(
+                        enabled=True,
+                        prefill_replicas=len(prefill_engines),
+                        decode_replicas=len(decode_engines),
+                        handoff_tokens=handoff_tokens,
+                    ),
+                ),
+            ),
+        )
+        want = len(prefill_engines) + len(decode_engines)
+        pods = await_pods(store, "dz1", want)
+        by_role = {"prefill": [], "decode": []}
+        for p in sorted(pods, key=lambda p: p.meta.name):
+            by_role[p.meta.labels[mt.LABEL_ROLE]].append(p)
+        for pod, eng in zip(by_role["prefill"], prefill_engines):
+            forge_ready(store, pod.meta.name, eng)
+        for pod, eng in zip(by_role["decode"], decode_engines):
+            forge_ready(store, pod.meta.name, eng)
+        _await(
+            lambda: len(lb.get_all_addresses("dz1")) == want,
+            msg="role endpoints converged",
+        )
+        return store, lb, api
+
+    BODY = {"model": "dz1", "prompt": "x", "stream": True, "temperature": 0}
+
+    def test_decode_killed_mid_handoff_redispatches_with_cursor(self, stack):
+        """The decode replica that accepted the handoff (resume=2) dies
+        one event past the cutover; the stream re-dispatches to the
+        OTHER decode replica with the advanced cursor (resume=3) intact
+        — the client sees every event exactly once."""
+        from kubeai_tpu.disagg.handoff import M_HANDOFFS
+
+        prefill = ScriptedSSEEngine(self.PREFILL)
+        # Whichever decode replica takes the handoff dies after writing
+        # 3 events (2 suppressed + 1 forwarded); the re-dispatch lands
+        # on the other (resume=3 ≠ "2" → it serves to completion).
+        d1 = ScriptedSSEEngine(self.FULL, die_after=3, die_on_resume="2")
+        d2 = ScriptedSSEEngine(self.FULL, die_after=3, die_on_resume="2")
+        _, lb, api = self.setup_disagg(stack, [prefill], [d1, d2])
+        ok_before = M_HANDOFFS.value(labels={"outcome": "ok"})
+        replays_before = retries("replay")
+        got = stream_post(api.port, self.BODY)
+        assert got == self.FULL, "duplicated or dropped events across the chain"
+        assert M_HANDOFFS.value(labels={"outcome": "ok"}) == ok_before + 1
+        assert retries("replay") == replays_before + 1
+        assert prefill.resume_headers == [None]
+        decode_resumes = sorted(
+            h for e in (d1, d2) for h in e.resume_headers
+        )
+        assert decode_resumes == ["2", "3"], (
+            "handoff/replay cursors wrong across decode replicas"
+        )
+
+    def test_prefill_killed_before_handoff_retries_on_prefill_pool(self, stack):
+        """A prefill replica dying BEFORE the handoff point replays on
+        the prefill pool (role preference holds through the replay),
+        reaches the handoff marker there, and only then crosses to
+        decode."""
+        from kubeai_tpu.disagg.handoff import M_HANDOFFS
+
+        # Both prefill replicas die after 1 event — but only on FRESH
+        # requests (no resume cursor), so the replay survives wherever
+        # it lands.
+        p1 = ScriptedSSEEngine(self.PREFILL, die_after=1, die_on_resume="")
+        p2 = ScriptedSSEEngine(self.PREFILL, die_after=1, die_on_resume="")
+        dec = ScriptedSSEEngine(self.FULL)
+        _, lb, api = self.setup_disagg(stack, [p1, p2], [dec])
+        ok_before = M_HANDOFFS.value(labels={"outcome": "ok"})
+        replays_before = retries("replay")
+        got = stream_post(api.port, self.BODY)
+        assert got == self.FULL
+        assert retries("replay") == replays_before + 1
+        assert M_HANDOFFS.value(labels={"outcome": "ok"}) == ok_before + 1
+        # The replay stayed on the prefill pool: one replica saw the
+        # fresh request, the other the resume=1 replay.
+        prefill_resumes = sorted(
+            (h or "") for e in (p1, p2) for h in e.resume_headers
+        )
+        assert prefill_resumes == ["", "1"], (
+            "mid-prefill replay left the prefill pool"
+        )
+        # Decode joined only at the handoff point (cursor 2).
+        assert dec.resume_headers == ["2"]
+
+    def test_handoff_respects_deadline_budget(self, stack):
+        """The end-to-end deadline expires while the prefill replica is
+        stalling before its handoff marker: the proxy must NOT dispatch
+        the decode leg of a request whose caller has given up — the
+        handoff is refused (outcome=deadline) and the decode pool sees
+        zero requests."""
+        from kubeai_tpu.disagg.handoff import M_HANDOFFS
+
+        # The stall is SPREAD across events, each under the per-read
+        # socket timeout (= the remaining budget at connect): the
+        # marker is delivered, but only after the budget has elapsed —
+        # the refusal under test is the cutover's own deadline check,
+        # not the socket timeout.
+        prefill = ScriptedSSEEngine(
+            self.PREFILL, delay_before={1: 0.18, 2: 0.18}
+        )
+        dec = ScriptedSSEEngine(self.FULL)
+        _, lb, api = self.setup_disagg(stack, [prefill], [dec])
+        deadline_before = M_HANDOFFS.value(labels={"outcome": "deadline"})
+        with pytest.raises(Exception):
+            stream_post(api.port, dict(self.BODY, timeout=0.25))
+        assert M_HANDOFFS.value(labels={"outcome": "deadline"}) == (
+            deadline_before + 1
+        )
+        assert dec.resume_headers == [], (
+            "decode pool dispatched for an expired request"
+        )
+        # Containment: the in-flight gauge drains.
+        from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
+
+        g = default_registry.gauge(ACTIVE_REQUESTS)
+        _await(
+            lambda: g.value(
+                labels={"request_model": "dz1", "request_type": "http"}
+            ) == 0,
+            msg="active-requests gauge drain",
+        )
 
 
 class TestHedging:

@@ -1,0 +1,844 @@
+"""Disaggregated prefill/decode serving (docs/disaggregation.md):
+phase-role pod pools, role-aware routing with pool fail-open, the
+replay-based handoff, and per-pool coordinated autoscaling — capped by
+the tier-1 e2e driving proxy → prefill replica → handoff → decode
+replica for a deterministic streamed completion."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kubeai_tpu import faults
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.api.model_types import (
+    Disaggregation,
+    Model,
+    ModelSpec,
+    ValidationError,
+    validate_model,
+)
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.disagg import (
+    ROLE_DECODE,
+    ROLE_PREFILL,
+    disagg_spec,
+    stamp_role_pod,
+)
+from kubeai_tpu.disagg import signals as dsig
+from kubeai_tpu.disagg.handoff import M_HANDOFFS, is_handoff_event
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+from kubeai_tpu.loadbalancer.group import LEAST_LOAD, Endpoint, EndpointGroup
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.proxy.handler import ModelProxy
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.proxy.server import OpenAIServer
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear_all()
+    yield
+    faults.clear_all()
+
+
+def mk_disagg_model(name="dz1", **dz_kw):
+    dz_kw.setdefault("enabled", True)
+    dz_kw.setdefault("handoff_tokens", 3)
+    return Model(
+        meta=ObjectMeta(name=name),
+        spec=ModelSpec(
+            url="hf://org/model",
+            resource_profile="cpu:1",
+            min_replicas=0,
+            disaggregation=Disaggregation(**dz_kw),
+        ),
+    )
+
+
+# ---------------------------------------------------------------------------
+# Spec + validation
+
+
+class TestSpec:
+    def test_validation_accepts_sane_disagg(self):
+        validate_model(mk_disagg_model())
+
+    def test_validation_rejects_bad_knobs(self):
+        with pytest.raises(ValidationError):
+            validate_model(mk_disagg_model(handoff_tokens=0))
+        with pytest.raises(ValidationError):
+            validate_model(mk_disagg_model(prefill_replicas=0))
+        with pytest.raises(ValidationError):
+            validate_model(mk_disagg_model(decode_replicas=0))
+        with pytest.raises(ValidationError):
+            validate_model(
+                mk_disagg_model(prefill_replicas=3, max_prefill_replicas=2)
+            )
+        with pytest.raises(ValidationError):
+            validate_model(mk_disagg_model(decode_target_occupancy_pct=0))
+        m = mk_disagg_model()
+        m.spec.engine = mt.ENGINE_VLLM
+        with pytest.raises(ValidationError):
+            validate_model(m)
+
+    def test_disagg_spec_helper(self):
+        assert disagg_spec(mk_disagg_model()) is not None
+        assert disagg_spec(mk_disagg_model(enabled=False)) is None
+        assert disagg_spec(object()) is None
+
+    def test_stamp_role_pod_labels_args_and_hashes(self):
+        from kubeai_tpu.api.core_types import Container, Pod
+        from kubeai_tpu.controller.pod_plan import pod_spec_hash
+
+        dz = Disaggregation(enabled=True, handoff_tokens=5)
+        base = Pod()
+        base.spec.containers.append(Container(args=["--model", "x"]))
+        pre = stamp_role_pod(base, ROLE_PREFILL, dz)
+        dec = stamp_role_pod(base, ROLE_DECODE, dz)
+        assert pre.meta.labels[mt.LABEL_ROLE] == ROLE_PREFILL
+        assert dec.meta.labels[mt.LABEL_ROLE] == ROLE_DECODE
+        assert pre.spec.containers[0].args == [
+            "--model", "x", "--role", "prefill", "--handoff-budget", "5",
+        ]
+        assert dec.spec.containers[0].args == ["--model", "x", "--role", "decode"]
+        # The unified desired pod stays pristine; role variants hash apart
+        # (mode flips and budget changes roll the pods).
+        assert base.spec.containers[0].args == ["--model", "x"]
+        assert len({pod_spec_hash(p) for p in (base, pre, dec)}) == 3
+
+
+# ---------------------------------------------------------------------------
+# Role-aware endpoint selection (pool preference + fail-open)
+
+
+def mk_role_group(**kw):
+    clk = [0.0]
+    g = EndpointGroup(clock=lambda: clk[0], **kw)
+    g.reconcile_endpoints({
+        "pf": Endpoint(address="10.0.0.1:8000", role=ROLE_PREFILL),
+        "dc": Endpoint(address="10.0.0.2:8000", role=ROLE_DECODE),
+    })
+    return g, clk
+
+
+PF, DC = "10.0.0.1:8000", "10.0.0.2:8000"
+
+
+def pick(g, **kw):
+    addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1, **kw)
+    done()
+    return addr
+
+
+class TestRoleRouting:
+    def test_role_preference_is_strict_while_pool_healthy(self):
+        g, _ = mk_role_group()
+        for _ in range(10):
+            assert pick(g, role=ROLE_PREFILL) == PF
+            assert pick(g, role=ROLE_DECODE) == DC
+
+    def test_whole_pool_ejected_fails_open_to_surviving_pool(self):
+        """Satellite regression: every prefill replica breaker-ejected →
+        prefill-preferring requests must serve on the decode pool (the
+        unified fallback), not block or 503."""
+        g, _ = mk_role_group(breaker_threshold=2, breaker_cooldown=60.0)
+        for _ in range(2):
+            g.report_result(PF, ok=False)
+        snap = {s["address"]: s for s in g.breaker_snapshot()}
+        assert snap[PF]["state"] == "open"
+        assert snap[PF]["role"] == ROLE_PREFILL  # satellite: role in snapshot
+        for _ in range(10):
+            assert pick(g, role=ROLE_PREFILL) == DC
+
+    def test_missing_pool_fails_open(self):
+        g = EndpointGroup()
+        g.reconcile_endpoints({"dc": Endpoint(address=DC, role=ROLE_DECODE)})
+        assert pick(g, role=ROLE_PREFILL) == DC
+
+    def test_exclude_within_pool_prefers_role_over_fresh_other_pool(self):
+        """Two prefill replicas: one failed this request (exclude) → the
+        retry stays in the prefill pool."""
+        g = EndpointGroup()
+        g.reconcile_endpoints({
+            "pf1": Endpoint(address="10.0.0.1:8000", role=ROLE_PREFILL),
+            "pf2": Endpoint(address="10.0.0.3:8000", role=ROLE_PREFILL),
+            "dc": Endpoint(address=DC, role=ROLE_DECODE),
+        })
+        for _ in range(10):
+            assert pick(g, role=ROLE_PREFILL, exclude={"10.0.0.1:8000"}) == (
+                "10.0.0.3:8000"
+            )
+
+    def test_total_outage_still_routes(self):
+        g, _ = mk_role_group(breaker_threshold=2, breaker_cooldown=60.0)
+        for addr in (PF, DC):
+            for _ in range(2):
+                g.report_result(addr, ok=False)
+        assert pick(g, role=ROLE_PREFILL) in (PF, DC)
+
+    def test_endpoint_roles_map(self):
+        g, _ = mk_role_group()
+        assert g.endpoint_roles() == {PF: ROLE_PREFILL, DC: ROLE_DECODE}
+
+
+# ---------------------------------------------------------------------------
+# Per-pool signals + scaling policy
+
+
+class TestSignals:
+    def test_prefill_signal_is_queue_pressure(self):
+        sig = dsig.prefill_signal(
+            {"queue_depth": 6.0, "active_slots": 2.0, "slots_total": 4.0}
+        )
+        assert sig == {"queue_wait": 6.0, "active": 2.0, "combined": 8.0}
+
+    def test_decode_signal_is_binding_occupancy(self):
+        sig = dsig.decode_signal({
+            "active_slots": 2.0, "slots_total": 8.0,  # 25% slots
+            "pages_used": 90.0, "pages_total": 100.0,  # 90% KV — binds
+        })
+        assert sig["slot_occupancy_pct"] == 25.0
+        assert sig["kv_occupancy_pct"] == 90.0
+        assert sig["combined"] == 90.0
+
+    def test_decode_signal_without_capacity_reads_zero(self):
+        assert dsig.decode_signal({})["combined"] == 0.0
+
+    def test_desired_math(self):
+        dz = Disaggregation(
+            enabled=True, prefill_target_queue=4, decode_target_occupancy_pct=80
+        )
+        assert dsig.desired_prefill(0.0, dz) == 1  # floor: never zero
+        assert dsig.desired_prefill(9.0, dz) == 3
+        # Occupancy is proportional control over the CURRENT pool size.
+        assert dsig.desired_decode(40.0, 2, dz) == 1
+        assert dsig.desired_decode(120.0, 2, dz) == 3
+        assert dsig.desired_decode(0.0, 4, dz) == 1
+
+
+class TestScalePool:
+    def mk(self):
+        store = Store()
+        m = mk_disagg_model()
+        m.spec.disaggregation.max_decode_replicas = 4
+        store.create(mt.KIND_MODEL, m)
+        return store, ModelClient(store, required_consecutive_scale_downs=lambda m: 2)
+
+    def test_scale_up_applies_and_clamps(self):
+        store, mc = self.mk()
+        out = mc.scale_pool("dz1", ROLE_DECODE, 9)
+        assert out["applied"] and out["reason"] == "scaled_up"
+        assert out["replicas"] == 4  # max clamp
+        assert store.get(mt.KIND_MODEL, "dz1").spec.disaggregation.decode_replicas == 4
+
+    def test_scale_down_gate_is_per_pool(self):
+        store, mc = self.mk()
+        mc.scale_pool("dz1", ROLE_DECODE, 4)
+        mc.scale_pool("dz1", ROLE_PREFILL, 3)
+        # Decode wants down: deferred twice, then applied.
+        assert mc.scale_pool("dz1", ROLE_DECODE, 1)["reason"] == "scale_down_deferred"
+        # A prefill scale-up between decode decisions must not reset
+        # decode's gate (the counters are keyed per pool).
+        assert mc.scale_pool("dz1", ROLE_PREFILL, 3)["reason"] == "no_change"
+        assert mc.scale_pool("dz1", ROLE_DECODE, 1)["reason"] == "scale_down_deferred"
+        out = mc.scale_pool("dz1", ROLE_DECODE, 1)
+        assert out["applied"] and out["reason"] == "scaled_down"
+        assert store.get(mt.KIND_MODEL, "dz1").spec.disaggregation.decode_replicas == 1
+
+    def test_pools_never_scale_to_zero(self):
+        _, mc = self.mk()
+        for _ in range(5):
+            out = mc.scale_pool("dz1", ROLE_PREFILL, 0)
+        assert out["clamped"] == 1
+
+    def test_non_disagg_model_rejected(self):
+        store = Store()
+        store.create(
+            mt.KIND_MODEL,
+            Model(meta=ObjectMeta(name="u1"), spec=ModelSpec(url="hf://a/b")),
+        )
+        mc = ModelClient(store)
+        assert mc.scale_pool("u1", ROLE_DECODE, 2)["reason"] == "not_disaggregated"
+
+
+# ---------------------------------------------------------------------------
+# Handoff marker detection
+
+
+class TestHandoffMarker:
+    def test_detects_marker_chunk(self):
+        ev = (
+            b'data: {"choices": [{"index": 0, "text": "", '
+            b'"finish_reason": "handoff"}]}\n\n'
+        )
+        assert is_handoff_event(ev)
+
+    def test_token_text_containing_word_is_not_marker(self):
+        ev = (
+            b'data: {"choices": [{"index": 0, "text": "a handoff", '
+            b'"finish_reason": null}]}\n\n'
+        )
+        assert not is_handoff_event(ev)
+
+    def test_done_and_junk_are_not_markers(self):
+        assert not is_handoff_event(b"data: [DONE]\n\n")
+        assert not is_handoff_event(b"data: handoff not json\n\n")
+        assert not is_handoff_event(b": comment handoff\n\n")
+
+
+# ---------------------------------------------------------------------------
+# Controller: role pools
+
+
+def await_role_pods(store, model, want: dict[str, int], timeout=5):
+    deadline = time.time() + timeout
+    while time.time() < deadline:
+        pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: model})
+        got: dict[str, int] = {}
+        for p in pods:
+            got[p.meta.labels.get(mt.LABEL_ROLE, "")] = (
+                got.get(p.meta.labels.get(mt.LABEL_ROLE, ""), 0) + 1
+            )
+        if got == want:
+            return pods
+        time.sleep(0.05)
+    raise AssertionError(f"expected pools {want}, have {got}")
+
+
+class TestControllerPools:
+    @pytest.fixture
+    def rec_store(self):
+        store = Store()
+        system = System().default_and_validate()
+        system.allow_pod_address_override = True
+        rec = ModelReconciler(store, system)
+        rec.start()
+        yield store
+        rec.stop()
+
+    def test_disagg_model_creates_role_pools(self, rec_store):
+        store = rec_store
+        m = mk_disagg_model()
+        m.spec.disaggregation.decode_replicas = 2
+        store.create(mt.KIND_MODEL, m)
+        pods = await_role_pods(store, "dz1", {ROLE_PREFILL: 1, ROLE_DECODE: 2})
+        by_role = {}
+        for p in pods:
+            by_role.setdefault(p.meta.labels[mt.LABEL_ROLE], []).append(p)
+        pre_args = by_role[ROLE_PREFILL][0].spec.containers[0].args
+        assert ["--role", "prefill"] == pre_args[-4:-2]
+        assert ["--handoff-budget", "3"] == pre_args[-2:]
+        dec_args = by_role[ROLE_DECODE][0].spec.containers[0].args
+        assert dec_args[-2:] == ["--role", "decode"]
+
+    def test_pool_resize_only_touches_that_pool(self, rec_store):
+        store = rec_store
+        store.create(mt.KIND_MODEL, mk_disagg_model())
+        pods = await_role_pods(store, "dz1", {ROLE_PREFILL: 1, ROLE_DECODE: 1})
+        decode_name = next(
+            p.meta.name for p in pods
+            if p.meta.labels[mt.LABEL_ROLE] == ROLE_DECODE
+        )
+        store.mutate(
+            mt.KIND_MODEL, "dz1",
+            lambda m: setattr(m.spec.disaggregation, "prefill_replicas", 2),
+        )
+        pods = await_role_pods(store, "dz1", {ROLE_PREFILL: 2, ROLE_DECODE: 1})
+        assert decode_name in {p.meta.name for p in pods}, (
+            "prefill resize recreated a decode pod"
+        )
+
+    def test_mode_flip_rolls_unified_pods_into_role_pools(self, rec_store):
+        store = rec_store
+        m = mk_disagg_model()
+        m.spec.disaggregation.enabled = False
+        m.spec.replicas = 1
+        m.spec.autoscaling_disabled = True
+        store.create(mt.KIND_MODEL, m)
+        deadline = time.time() + 5
+        while time.time() < deadline:
+            pods = store.list(KIND_POD, selector={mt.LABEL_MODEL: "dz1"})
+            if len(pods) == 1 and mt.LABEL_ROLE not in pods[0].meta.labels:
+                break
+            time.sleep(0.05)
+        store.mutate(
+            mt.KIND_MODEL, "dz1",
+            lambda m: setattr(m.spec.disaggregation, "enabled", True),
+        )
+        # The unlabeled pod folds into the decode pool's rollout and the
+        # prefill pool comes up alongside — converges to 1+1 labeled.
+        await_role_pods(store, "dz1", {ROLE_PREFILL: 1, ROLE_DECODE: 1}, timeout=10)
+
+
+# ---------------------------------------------------------------------------
+# Fleet collector: role dimensions
+
+
+class TestFleetRoles:
+    ENGINE_TEXT = """\
+kubeai_engine_queue_depth {q}
+kubeai_engine_active_slots {a}
+kubeai_engine_slots_total {st}
+kubeai_engine_kv_pages_used {pu}
+kubeai_engine_kv_pages_total {pt}
+kubeai_engine_generated_tokens_total 0
+"""
+
+    class RoleStubLB:
+        def __init__(self, addrs, roles):
+            self.addrs = addrs
+            self.roles = roles
+
+        def get_all_addresses(self, model):
+            return list(self.addrs)
+
+        def get_endpoint_roles(self, model):
+            return dict(self.roles)
+
+        def get_self_ips(self):
+            return []
+
+    def test_debug_fleet_rows_and_pools_carry_roles(self):
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        texts = {
+            "p:1": self.ENGINE_TEXT.format(q=5, a=1, st=2, pu=4, pt=100),
+            "d:1": self.ENGINE_TEXT.format(q=0, a=6, st=8, pu=90, pt=100),
+        }
+        lb = self.RoleStubLB(
+            list(texts), {"p:1": ROLE_PREFILL, "d:1": ROLE_DECODE}
+        )
+        clk = [0.0]
+        col = FleetCollector(
+            lb, clock=lambda: clk[0], fetch=lambda addr: texts[addr]
+        )
+        view = col.collect(["m1"])["m1"]
+        roles = {e["address"]: e["role"] for e in view["endpoints"]}
+        assert roles == {"p:1": ROLE_PREFILL, "d:1": ROLE_DECODE}
+        pools = view["pools"]
+        assert pools[ROLE_PREFILL]["queue_depth"] == 5
+        assert pools[ROLE_PREFILL]["active_slots"] == 1
+        assert pools[ROLE_DECODE]["active_slots"] == 6
+        assert pools[ROLE_DECODE]["pages_used"] == 90
+        # The unified aggregate still sums everything (back-compat).
+        assert view["aggregate"]["queue_depth"] == 5
+        assert view["aggregate"]["active_slots"] == 7
+
+    def test_unified_model_has_no_pools_key(self):
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        texts = {"a:1": self.ENGINE_TEXT.format(q=0, a=0, st=8, pu=0, pt=100)}
+        lb = self.RoleStubLB(list(texts), {"a:1": ""})
+        col = FleetCollector(lb, clock=lambda: 0.0, fetch=lambda a: texts[a])
+        assert "pools" not in col.collect(["m1"])["m1"]
+
+
+# ---------------------------------------------------------------------------
+# Autoscaler: one decision per pool per tick, distinct signals
+
+
+class _Lead:
+    def __init__(self):
+        self.is_leader = threading.Event()
+        self.is_leader.set()
+
+
+class TestPerPoolAutoscaling:
+    def mk_autoscaler(self, store, texts, roles):
+        from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        mc = ModelClient(store, required_consecutive_scale_downs=lambda m: 1)
+        lb = TestFleetRoles.RoleStubLB(list(texts), roles)
+        fleet = FleetCollector(
+            lb, clock=time.monotonic, fetch=lambda addr: texts[addr]
+        )
+        return Autoscaler(
+            store, mc, lb, _Lead(),
+            average_window_count=1,  # window of 1: decisions track the tick's signal
+            fixed_self_metric_addrs=[],
+            fleet=fleet,
+        )
+
+    def test_pools_scale_on_distinct_signals(self):
+        store = Store()
+        m = mk_disagg_model()
+        m.spec.disaggregation.prefill_target_queue = 4
+        m.spec.disaggregation.decode_target_occupancy_pct = 80
+        store.create(mt.KIND_MODEL, m)
+        texts = {
+            # Prefill pool: 9 queued + 1 active = 10 → ceil(10/4) = 3.
+            "p:1": TestFleetRoles.ENGINE_TEXT.format(q=9, a=1, st=2, pu=4, pt=100),
+            # Decode pool: 100% slots busy at 1 replica → ceil(1*100/80) = 2.
+            "d:1": TestFleetRoles.ENGINE_TEXT.format(q=0, a=8, st=8, pu=50, pt=100),
+        }
+        asc = self.mk_autoscaler(
+            store, texts, {"p:1": ROLE_PREFILL, "d:1": ROLE_DECODE}
+        )
+        asc.tick()
+        recs = asc.decisions.snapshot(model="dz1")
+        by_pool = {r["pool"]: r for r in recs}
+        assert set(by_pool) == {ROLE_PREFILL, ROLE_DECODE}
+        pre, dec = by_pool[ROLE_PREFILL], by_pool[ROLE_DECODE]
+        # Distinct phase signals, each with its breakdown.
+        assert pre["signal"]["source"] == "prefill_queue_wait"
+        assert pre["signal"]["queue_wait"] == 9.0
+        assert pre["desired"] == 3 and pre["applied"]
+        assert dec["signal"]["source"] == "decode_occupancy"
+        assert dec["signal"]["slot_occupancy_pct"] == 100.0
+        assert dec["desired"] == 2 and dec["applied"]
+        dz = store.get(mt.KIND_MODEL, "dz1").spec.disaggregation
+        assert dz.prefill_replicas == 3
+        assert dz.decode_replicas == 2
+
+    def test_unreachable_pool_holds_with_audit_record(self):
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_disagg_model())
+        texts = {
+            "p:1": TestFleetRoles.ENGINE_TEXT.format(q=2, a=1, st=2, pu=0, pt=100),
+        }
+
+        def fetch(addr):
+            if addr == "d:1":
+                raise ConnectionError("dead decode pool")
+            return texts[addr]
+
+        from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        mc = ModelClient(store)
+        lb = TestFleetRoles.RoleStubLB(
+            ["p:1", "d:1"], {"p:1": ROLE_PREFILL, "d:1": ROLE_DECODE}
+        )
+        fleet = FleetCollector(lb, clock=time.monotonic, fetch=fetch)
+        asc = Autoscaler(
+            store, mc, lb, _Lead(), average_window_count=1,
+            fixed_self_metric_addrs=[], fleet=fleet,
+        )
+        asc.tick()
+        by_pool = {r["pool"]: r for r in asc.decisions.snapshot(model="dz1")}
+        dec = by_pool[ROLE_DECODE]
+        assert dec["reason"] == "no_pool_telemetry"
+        assert dec["applied"] is False
+        assert dec["scrape_failures"]["engines"] == ["d:1"]
+        # The reachable pool still got a real decision.
+        assert by_pool[ROLE_PREFILL]["signal"]["source"] == "prefill_queue_wait"
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 e2e: proxy → prefill replica → handoff → decode replica
+
+
+def mk_params(**kw):
+    from kubeai_tpu.engine.sampling import SamplingParams
+
+    kw.setdefault("temperature", 0.0)
+    kw.setdefault("max_tokens", 4)
+    return SamplingParams(**kw)
+
+
+@pytest.fixture(scope="module")
+def role_engines():
+    """One REAL prefill-role engine server (handoff budget 3) and one
+    REAL decode-role engine server, built from the same seed so their
+    greedy token streams are identical — the determinism the replay-
+    based handoff rides on."""
+    from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+    from kubeai_tpu.engine.server import EngineServer
+
+    ec = EngineConfig(
+        max_slots=2, max_seq_len=256, prefill_buckets=(16, 32), decode_chunk=2,
+    )
+    pre_eng = build_test_engine(engine_config=ec)
+    dec_eng = build_test_engine(engine_config=ec)
+    prefill = EngineServer(
+        pre_eng, "dz1", host="127.0.0.1", port=0,
+        role=ROLE_PREFILL, handoff_budget=3,
+    )
+    decode = EngineServer(dec_eng, "dz1", host="127.0.0.1", port=0, role=ROLE_DECODE)
+    prefill.start()
+    decode.start()
+    # Warm both engines so per-test behavior measures scheduling, not XLA.
+    for eng in (pre_eng, dec_eng):
+        eng.generate(eng.tokenizer.encode("warm"), mk_params(), timeout=120)
+    yield prefill, decode
+    faults.clear_all()
+    prefill.stop()
+    decode.stop()
+
+
+@pytest.fixture
+def disagg_stack(role_engines):
+    prefill, decode = role_engines
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=10)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+
+    store.create(mt.KIND_MODEL, mk_disagg_model())
+    pods = await_role_pods(store, "dz1", {ROLE_PREFILL: 1, ROLE_DECODE: 1})
+    for p in pods:
+        srv = (
+            prefill
+            if p.meta.labels[mt.LABEL_ROLE] == ROLE_PREFILL
+            else decode
+        )
+
+        def mutate(pp, port=srv.port):
+            pp.status.ready = True
+            pp.status.pod_ip = "127.0.0.1"
+            pp.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+            pp.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(port)
+
+        store.mutate(KIND_POD, p.meta.name, mutate)
+    # Both role endpoints visible to the balancer before any request.
+    deadline = time.time() + 5
+    while time.time() < deadline:
+        if len(lb.get_all_addresses("dz1")) == 2:
+            break
+        time.sleep(0.02)
+    yield store, lb, mc, api
+    api.stop()
+    lb.stop()
+    rec.stop()
+
+
+def sse_post(port, body, path, rid=None, timeout=30):
+    """POST a streaming request; returns (payload strings, response
+    headers). The stream must COMPLETE — truncation raises."""
+    headers = {"Content-Type": "application/json"}
+    if rid:
+        headers["X-Request-ID"] = rid
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(body).encode(),
+        headers=headers,
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+        hdrs = dict(resp.headers)
+    out = []
+    for block in raw.replace(b"\r\n", b"\n").split(b"\n\n"):
+        if block.startswith(b"data: "):
+            out.append(block[6:].decode())
+    return out, hdrs
+
+
+def shape(events):
+    """(text, finish_reason) per event — the client-visible stream,
+    minus per-request id/created fields (which legitimately change at
+    the handoff boundary, same as a crash replay)."""
+    out = []
+    for p in events:
+        if p == "[DONE]":
+            out.append("[DONE]")
+            continue
+        c = json.loads(p)["choices"][0]
+        out.append((c.get("text"), c.get("finish_reason")))
+    return out
+
+
+class TestDisaggE2E:
+    BODY = {
+        "model": "dz1", "prompt": "count with me", "stream": True,
+        "temperature": 0, "max_tokens": 8,
+    }
+
+    def test_handoff_stream_is_uninterrupted_and_byte_correct(self, disagg_stack, role_engines):
+        """Acceptance: a deterministic streamed completion through the
+        proxy crosses prefill → decode with zero duplicated and zero
+        dropped events; the client sees ONE stream identical in shape
+        to a run served whole by a decode replica; the handoff is
+        recorded in the trace; and the autoscaler's tick emits one
+        DecisionLog record per pool with distinct phase signals."""
+        prefill, decode = role_engines
+        store, lb, mc, api = disagg_stack
+
+        # Reference: the same request served WHOLE by the (uncapped)
+        # decode replica, straight at the engine.
+        reference, _ = sse_post(decode.port, self.BODY, "/v1/completions")
+        assert reference[-1] == "[DONE]"
+        assert len(reference) > 5, "reference stream suspiciously short"
+        # The reference must contain real content and a real finish.
+        assert any(t for t, _ in shape(reference)[:-1] if t)
+
+        capped_before = default_registry.counter(
+            "kubeai_engine_handoff_capped_total"
+        ).value()
+        ok_before = M_HANDOFFS.value(labels={"outcome": "ok"})
+        rid = "disagg-e2e-1"
+        got, hdrs = sse_post(
+            api.port, self.BODY, "/openai/v1/completions", rid=rid
+        )
+        assert hdrs.get("X-Request-ID") == rid
+        assert shape(got) == shape(reference), (
+            "handoff duplicated or dropped stream events"
+        )
+        # The handoff actually happened (this was not a unified serve).
+        assert M_HANDOFFS.value(labels={"outcome": "ok"}) == ok_before + 1
+        assert default_registry.counter(
+            "kubeai_engine_handoff_capped_total"
+        ).value() == capped_before + 1
+        # The client never saw the prefill engine's marker chunk.
+        assert all("handoff" not in (fr or "") for _, fr in
+                   [s for s in shape(got) if isinstance(s, tuple)])
+
+        # Handoff record in the trace: the proxy timeline carries a
+        # `handoff` phase with the cutover cursor.
+        deadline = time.time() + 5
+        timeline = None
+        while time.time() < deadline and timeline is None:
+            with urllib.request.urlopen(
+                f"http://127.0.0.1:{api.port}/debug/requests?id={rid}", timeout=5
+            ) as resp:
+                doc = json.loads(resp.read())
+            for t in doc.get("requests", []):
+                if t.get("component") == "proxy" and t.get("request_id") == rid:
+                    timeline = t
+            time.sleep(0.05)
+        assert timeline is not None, "proxy timeline not recorded"
+        phases = {p["name"]: p for p in timeline["phases"]}
+        assert "handoff" in phases, f"no handoff span in {sorted(phases)}"
+        assert phases["handoff"]["attrs"]["events"] >= 1
+        assert timeline["outcome"] == "ok"
+
+        # Two per-pool DecisionLog records with DISTINCT signals in
+        # /debug/autoscaler, produced by a real tick over the real
+        # engines' /metrics.
+        from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        fleet = FleetCollector(lb)
+        asc = Autoscaler(
+            store, mc, lb, _Lead(), average_window_count=1,
+            fixed_self_metric_addrs=[], fleet=fleet,
+        )
+        api.decision_log = asc.decisions
+        asc.tick()
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/debug/autoscaler?model=dz1", timeout=5
+        ) as resp:
+            doc = json.loads(resp.read())
+        by_pool = {r.get("pool"): r for r in doc["decisions"]}
+        assert set(by_pool) >= {ROLE_PREFILL, ROLE_DECODE}
+        assert by_pool[ROLE_PREFILL]["signal"]["source"] == "prefill_queue_wait"
+        assert by_pool[ROLE_DECODE]["signal"]["source"] == "decode_occupancy"
+
+    def test_short_completion_finishes_on_prefill_without_handoff(self, disagg_stack):
+        """A generation that fits inside the handoff budget completes on
+        the prefill replica — its finish reason passes through untouched
+        and no handoff is recorded."""
+        store, lb, mc, api = disagg_stack
+        ok_before = M_HANDOFFS.value(labels={"outcome": "ok"})
+        body = dict(self.BODY, max_tokens=2)
+        got, _ = sse_post(api.port, body, "/openai/v1/completions")
+        assert got[-1] == "[DONE]"
+        fin = [fr for s in shape(got) if isinstance(s, tuple) for fr in [s[1]] if fr]
+        assert fin == ["length"]
+        assert M_HANDOFFS.value(labels={"outcome": "ok"}) == ok_before
+
+    def test_ineligible_request_serves_unified_on_decode_pool(self, disagg_stack, role_engines):
+        """temperature > 0 without a seed is handoff-ineligible: the
+        request must serve whole on the decode pool (no cap, no
+        handoff)."""
+        prefill, decode = role_engines
+        store, lb, mc, api = disagg_stack
+        from kubeai_tpu.disagg.handoff import M_DISAGG_REQUESTS
+
+        uni_before = M_DISAGG_REQUESTS.value(labels={"mode": "unified"})
+        ok_before = M_HANDOFFS.value(labels={"outcome": "ok"})
+        body = dict(self.BODY, temperature=0.9)
+        got, _ = sse_post(api.port, body, "/openai/v1/completions")
+        assert got[-1] == "[DONE]"
+        assert M_DISAGG_REQUESTS.value(labels={"mode": "unified"}) == uni_before + 1
+        assert M_HANDOFFS.value(labels={"outcome": "ok"}) == ok_before
+
+    def test_unplanned_stream_on_prefill_replica_serves_whole(self, role_engines):
+        """The budget cap is gated on the proxy's X-Handoff-Planned
+        intent: a stream reaching a prefill replica WITHOUT a planned
+        cutover (direct client, or an ineligible request that failed
+        open because the decode pool is gone) must serve whole — never
+        a K-token truncation with a marker nobody consumes."""
+        prefill, decode = role_engines
+        got, _ = sse_post(prefill.port, self.BODY, "/v1/completions")
+        ref, _ = sse_post(decode.port, self.BODY, "/v1/completions")
+        assert shape(got) == shape(ref), "unplanned stream was budget-capped"
+
+
+def test_decode_pool_down_handoff_fails_open_to_prefill(role_engines):
+    """Full degradation path: the decode pool exists but refuses every
+    connection. An eligible stream runs its prefill leg normally, the
+    cutover's decode acquisition fails over — and fails OPEN back onto
+    the prefill replica, now WITHOUT the planned-handoff intent, which
+    therefore serves the resumed stream whole and uncapped. The client
+    still receives one complete, uninterrupted stream."""
+    prefill, decode = role_engines
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=10)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    try:
+        store.create(mt.KIND_MODEL, mk_disagg_model())
+        pods = await_role_pods(store, "dz1", {ROLE_PREFILL: 1, ROLE_DECODE: 1})
+        import socket
+
+        # A bound-but-unlistened port: decode connects are refused.
+        dead = socket.socket()
+        dead.bind(("127.0.0.1", 0))
+        dead_port = dead.getsockname()[1]
+        dead.close()
+        for p in pods:
+            port = (
+                prefill.port
+                if p.meta.labels[mt.LABEL_ROLE] == ROLE_PREFILL
+                else dead_port
+            )
+
+            def mutate(pp, port=port):
+                pp.status.ready = True
+                pp.status.pod_ip = "127.0.0.1"
+                pp.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+                pp.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(port)
+
+            store.mutate(KIND_POD, p.meta.name, mutate)
+        deadline = time.time() + 5
+        while time.time() < deadline and len(lb.get_all_addresses("dz1")) != 2:
+            time.sleep(0.02)
+
+        body = {
+            "model": "dz1", "prompt": "count with me", "stream": True,
+            "temperature": 0, "max_tokens": 8,
+        }
+        reference, _ = sse_post(prefill.port, body, "/v1/completions")
+        ok_before = M_HANDOFFS.value(labels={"outcome": "ok"})
+        got, _ = sse_post(api.port, body, "/openai/v1/completions")
+        assert shape(got) == shape(reference), (
+            "fail-open degraded stream duplicated or dropped events"
+        )
+        # The cutover still counts as ok — it acquired an upstream
+        # (the prefill replica, serving unified) and grafted it.
+        assert M_HANDOFFS.value(labels={"outcome": "ok"}) == ok_before + 1
+    finally:
+        api.stop()
+        lb.stop()
+        rec.stop()
