@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -38,6 +38,21 @@ disagg-bench: ## unified vs disaggregated A/B at mixed prompt lengths -> BENCH_d
 	@# comparison block schema: benchmarks/BENCH_SCHEMA.md (perf_gate.py
 	@# validates it). See docs/disaggregation.md.
 	JAX_PLATFORMS=cpu $(PY) benchmarks/disagg_bench.py --json BENCH_disagg.json
+
+incident-drill: ## e2e incident-black-box smoke: real proxy+engine, injected mid-stream kill, canary detection, persisted incident + rendered report
+	@# Exits nonzero unless an incident lands with >=3 correlated
+	@# sections AND the canary flags the failure within one probe
+	@# period. Artifacts under build/incident-drill/.
+	JAX_PLATFORMS=cpu KUBEAI_DEBUG_FAULTS=1 $(PY) benchmarks/incident_drill.py
+
+INCIDENT_DIR ?=
+INCIDENT_ID ?=
+incident-report: ## render the latest captured incident as a correlated timeline
+	@# Usage: make incident-report [INCIDENT_DIR=/path] [INCIDENT_ID=...]
+	@# Default dir: $$KUBEAI_INCIDENT_DIR or /tmp/kubeai-incidents.
+	$(PY) -m kubeai_tpu.obs.incident_report \
+	    $(if $(INCIDENT_DIR),--dir $(INCIDENT_DIR)) \
+	    $(if $(INCIDENT_ID),--id $(INCIDENT_ID))
 
 OPERATOR_URL ?= http://localhost:8000
 fleet-snapshot: ## dump /debug/fleet + /debug/autoscaler + /debug/slo (runbook capture)

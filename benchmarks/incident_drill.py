@@ -1,0 +1,315 @@
+"""Incident drill: end-to-end smoke of the incident black box against a
+REAL serving stack — store → reconciler → balancer → proxy/OpenAI server
+→ a real (CPU) engine behind an EngineServer — with the incident
+recorder + synthetic canary wired exactly as the manager wires them
+(obs.incidents.standard_sources: one wiring, zero drift).
+
+The drill:
+
+1. serves healthy traffic (client streams + one canary sweep pinning
+   the output fingerprint baseline), ticking the autoscaler and SLO
+   monitor so their surfaces carry real records;
+2. injects a mid-stream kill (``engine.stream`` failpoint — every SSE
+   write severs the socket like a crashed replica);
+3. waits for detection: the canary's next probe errors (within ONE
+   probe period), the breaker ejects the endpoint, and the trigger bus
+   captures correlated incidents into the on-disk ring;
+4. renders the report (``kubeai_tpu.obs.incident_report``) and verifies
+   the acceptance bar: a PERSISTED incident whose report correlates
+   >= 3 surfaces (SLO / fleet / autoscaler / traces / breaker) around
+   the injected failure, and ``kubeai_canary_probes_total{outcome=
+   "error"}`` incremented.
+
+Run: ``make incident-drill`` (artifacts under build/incident-drill/).
+``--fast`` is the tier-1 variant (tests/test_incidents.py runs it).
+Exit 0 = every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu import faults
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.core_types import KIND_POD
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+from kubeai_tpu.autoscaler.fleet import FleetCollector
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.engine.server import EngineServer
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+from kubeai_tpu.obs.canary import CanaryProber, M_PROBES, install_canary, uninstall_canary
+from kubeai_tpu.obs.incident_report import render_incident
+from kubeai_tpu.obs.incidents import (
+    IncidentRecorder,
+    install_recorder,
+    standard_sources,
+    uninstall_recorder,
+)
+from kubeai_tpu.obs.slo import SLOMonitor
+from kubeai_tpu.proxy.handler import ModelProxy
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.proxy.server import OpenAIServer
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+
+MODEL = "drill-model"
+# The surfaces the acceptance bar counts as "correlated": each must
+# contribute at least one line to the rendered timeline.
+CORRELATED = ("slo", "fleet", "autoscaler", "request", "breaker", "canary")
+# Timeline source tag -> the snapshot section whose capture backs it.
+SECTION_OF = {
+    "slo": "slo", "fleet": "fleet", "autoscaler": "autoscaler",
+    "request": "requests", "breaker": "endpoints", "canary": "canary",
+}
+
+
+class _AlwaysLeader:
+    def __init__(self):
+        self.is_leader = threading.Event()
+        self.is_leader.set()
+
+
+def _await(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out awaiting {msg}")
+
+
+def _stream(port: int, body: dict, timeout=30) -> list[str]:
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    return [
+        block[6:].decode()
+        for block in raw.split(b"\n\n")
+        if block.startswith(b"data: ")
+    ]
+
+
+def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = True) -> dict:
+    """Execute the drill; returns the summary dict (checks + evidence).
+    Raises AssertionError on a failed acceptance check."""
+    t_start = time.monotonic()
+    incident_dir = incident_dir or os.path.join("build", "incident-drill", "incidents")
+    os.makedirs(incident_dir, exist_ok=True)
+    for stale in os.listdir(incident_dir):
+        if stale.startswith("incident-"):
+            os.remove(os.path.join(incident_dir, stale))
+
+    # -- the real stack ----------------------------------------------------
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=30)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+
+    election = _AlwaysLeader()
+    fleet = FleetCollector(lb, default_max_age=0.2)
+    autoscaler = Autoscaler(
+        store, mc, lb, election, interval_seconds=3600, fleet=fleet
+    )  # ticked manually — the drill owns its own clock
+    slo = SLOMonitor(
+        interval_seconds=3600, window_seconds=120,
+        remote_pages=fleet.parsed_pages,
+    )
+    canary = CanaryProber(
+        proxy, mc, lb, interval_seconds=0.5, timeout_seconds=10,
+        max_tokens=4, election=election, enabled=True,
+    )
+    recorder = IncidentRecorder(
+        sources=standard_sources(
+            lb, mc, fleet=fleet, decision_log=autoscaler.decisions,
+            slo=slo, canary=canary,
+        ),
+        incident_dir=incident_dir,
+        debounce_seconds=2.0,
+        election=election,
+    )
+    install_recorder(recorder)
+    install_canary(canary)
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(
+            max_slots=2, max_seq_len=256, prefill_buckets=(16, 32),
+            max_queue=8, decode_chunk=2,
+        )
+    )
+    srv = EngineServer(eng, MODEL, host="127.0.0.1", port=0)
+    srv.start()
+    summary: dict = {"fast": fast, "incident_dir": incident_dir}
+    try:
+        # Warm the compile caches so probe latencies measure serving.
+        eng.generate(
+            eng.tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=120,
+        )
+
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name=MODEL),
+                spec=ModelSpec(
+                    url="hf://drill/model", resource_profile="cpu:1",
+                    replicas=1, min_replicas=1,
+                ),
+            ),
+        )
+        _await(
+            lambda: len(store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})) == 1,
+            msg="model pod",
+        )
+        [pod] = store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})
+
+        def forge(p):
+            p.status.ready = True
+            p.status.pod_ip = "127.0.0.1"
+            p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+            p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(srv.port)
+
+        store.mutate(KIND_POD, pod.meta.name, forge)
+        _await(lambda: lb.get_all_addresses(MODEL), msg="endpoint")
+
+        # -- phase 1: healthy baseline ------------------------------------
+        body = {
+            "model": MODEL, "prompt": "count with me", "stream": True,
+            "temperature": 0, "max_tokens": 4,
+        }
+        for _ in range(1 if fast else 3):
+            events = _stream(api.port, body)
+            assert events and events[-1] == "[DONE]", "healthy stream truncated"
+        canary.tick()
+        baseline = canary.report()["models"][MODEL]
+        assert baseline["outcome"] == "ok", f"canary baseline not ok: {baseline}"
+        autoscaler.tick()
+        slo.tick()
+        summary["baseline"] = {
+            "canary_fingerprint": baseline["fingerprint"],
+            "canary_e2e_s": baseline["e2e_s"],
+        }
+
+        # -- phase 2: inject + detect -------------------------------------
+        errors_before = M_PROBES.value(labels={"outcome": "error"})
+        t_inject = time.monotonic()
+        faults.arm_spec("engine.stream", "error")  # every stream dies
+        # ONE probe period later the canary must have flagged the
+        # failure class (the probe's replays all die too).
+        canary.tick()
+        t_detect = time.monotonic()
+        errors_after = M_PROBES.value(labels={"outcome": "error"})
+        assert errors_after > errors_before, (
+            "canary did not flag the injected failure "
+            f"(probes_total{{outcome=error}} {errors_before} -> {errors_after})"
+        )
+        # The probe's failed attempts feed the breaker; a second sweep
+        # guarantees the ejection threshold (3) regardless of how many
+        # replays the retry budget granted the first probe.
+        canary.tick()
+        autoscaler.tick()
+        slo.tick()
+        recorder.wait_idle(timeout=15)
+        incidents = recorder.snapshot()
+        assert incidents, "no incident captured after the injected failure"
+        summary["detection"] = {
+            "within_probe_periods": 1,
+            "seconds_to_flag": round(t_detect - t_inject, 3),
+            "canary_error_probes": errors_after - errors_before,
+            "triggers": sorted({i["trigger"] for i in incidents}),
+        }
+
+        # -- phase 3: persistence + report --------------------------------
+        on_disk = sorted(
+            n for n in os.listdir(incident_dir) if n.startswith("incident-")
+        )
+        assert on_disk, "incident was not persisted to the on-disk ring"
+        best = max(incidents, key=lambda i: len(i["sections_ok"]))
+        doc = recorder.get(best["id"])
+        assert doc is not None
+        assert len(doc["sections_ok"]) >= 3, (
+            f"incident captured only {doc['sections_ok']}"
+        )
+        report = render_incident(doc)
+        # A surface counts as correlated only when its timeline entries
+        # came from a SUCCESSFULLY captured section — the renderer also
+        # emits "<section capture failed>" lines under the same source
+        # tag, and those are absence of evidence, not evidence.
+        correlated = [
+            s for s in CORRELATED
+            if f"  {s:<10s}" in report
+            and SECTION_OF[s] in doc["sections_ok"]
+        ]
+        assert len(correlated) >= 3, (
+            f"report correlates only {correlated} (need >=3 of {CORRELATED})"
+        )
+        summary["incident"] = {
+            "id": doc["id"],
+            "trigger": doc["trigger"],
+            "sections_ok": doc["sections_ok"],
+            "persisted_files": len(on_disk),
+            "correlated_surfaces": correlated,
+        }
+        summary["ok"] = True
+        summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
+        if verbose:
+            print(report)
+        return summary
+    finally:
+        faults.clear_all()
+        uninstall_canary(canary)
+        uninstall_recorder(recorder)
+        # Join the capture worker too: a stranded daemon thread's source
+        # closures would pin this whole stack for the rest of the
+        # process (the fast drill runs in-process under pytest).
+        recorder.stop()
+        srv.stop()
+        api.stop()
+        lb.stop()
+        rec.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("incident-drill")
+    parser.add_argument("--fast", action="store_true", help="tier-1 variant: minimal healthy phase")
+    parser.add_argument("--dir", default=None, help="incident ring directory (default build/incident-drill/incidents)")
+    parser.add_argument("--json", default=os.path.join("build", "incident-drill", "summary.json"))
+    args = parser.parse_args(argv)
+    os.environ.setdefault("KUBEAI_DEBUG_FAULTS", "1")
+    try:
+        summary = run(fast=args.fast, incident_dir=args.dir)
+    except AssertionError as e:
+        print(f"INCIDENT DRILL FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -23,6 +23,7 @@ from dataclasses import dataclass, field
 
 from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS, default_registry, parse_prometheus_text
+from kubeai_tpu.obs.incidents import publish_trigger
 from kubeai_tpu.runtime.store import AlreadyExists, NotFound, ObjectMeta, Store
 
 log = logging.getLogger("kubeai_tpu.autoscaler")
@@ -184,6 +185,11 @@ class Autoscaler:
         self.decisions = DecisionLog(decision_capacity)
         self._clock = clock
         self._averages: dict[str, SimpleMovingAverage] = {}
+        # Consecutive no_pool_telemetry ticks per model#role: the
+        # incident trigger fires only on a CONFIRMED hold (2nd tick in
+        # a row) so a one-tick scrape blip or a pool that is still
+        # starting up doesn't churn the incident ring.
+        self._hold_streak: dict[str, int] = {}
         self._running = False
         self._thread: threading.Thread | None = None
         self._load_state()
@@ -244,6 +250,14 @@ class Autoscaler:
         models = self.model_client.list_all_models()
         actives, peer_failures = self._aggregate_metrics_detailed()
         enabled = [m for m in models if not m.spec.autoscaling_disabled]
+        # Streaks for models no longer in the store must not survive:
+        # a model deleted mid-hold and later RECREATED would otherwise
+        # inherit the dead deployment's streak and fire autoscaler_hold
+        # on its first (normal, still-starting) blind tick, defeating
+        # the two-consecutive-tick confirmation.
+        live = {m.meta.name for m in models}
+        for k in [k for k in self._hold_streak if k.split("#", 1)[0] not in live]:
+            del self._hold_streak[k]
         fleet_view = None
         if self.fleet is not None:
             # ONE scrape per endpoint for the whole tick; the same
@@ -329,6 +343,18 @@ class Autoscaler:
                 },
             }
             self.decisions.append(record)
+            # Incident trigger: desired exceeded the clamp — the model
+            # WANTS more capacity than maxReplicas allows. A min-clamp
+            # (desired < clamped) is idle normality, not an incident.
+            clamped = outcome.get("clamped")
+            if clamped is not None and clamped < desired:
+                publish_trigger(
+                    "autoscaler_clamp", model=name,
+                    detail={
+                        "desired": desired, "clamped": clamped,
+                        "window_avg": round(mean, 3),
+                    },
+                )
             labels = {"model": name}
             M_DESIRED.set(desired, labels=labels)
             M_SIGNAL.set(proxy_signal, labels={**labels, "source": "proxy"})
@@ -359,10 +385,13 @@ class Autoscaler:
     def _clear_pool_series(self, name: str) -> None:
         """Drop per-pool gauge series for a model served unified this
         tick — a model flipped back from disaggregated must not export
-        its final pre-flip pool saturation forever."""
+        its final pre-flip pool saturation forever. The hold streaks go
+        with them: a pool that no longer exists isn't "still blind", and
+        a later flip back to disagg must re-confirm from zero."""
         for role in ("prefill", "decode"):
             labels = {"model": name, "pool": role}
             M_DESIRED.remove(labels=labels)
+            self._hold_streak.pop(f"{name}#{role}", None)
             for source in ("prefill_queue_wait", "decode_occupancy"):
                 M_SIGNAL.remove(labels={**labels, "source": source})
 
@@ -424,7 +453,27 @@ class Autoscaler:
                     }
                 )
                 self.decisions.append(record)
+                # A silent pool is an incident in waiting: the
+                # autoscaler is flying blind on this phase role. Fire
+                # only once CONFIRMED (two consecutive blind ticks — a
+                # single scrape blip is not evidence). The current>0
+                # guard is defensive only: validation floors pools at 1
+                # today, but a legitimately zero-replica pool (future
+                # scale-to-zero) must not page anyone.
+                # >= (not ==): while the pool stays blind, every tick
+                # keeps publishing and the recorder's debounce folds the
+                # repeats into suppressed_repeats — an hour-long hold
+                # must not leave the same footprint as a 2-tick one.
+                streak = self._hold_streak.get(key, 0) + 1
+                self._hold_streak[key] = streak
+                if streak >= 2 and record["current"] > 0:
+                    publish_trigger(
+                        "autoscaler_hold", model=name,
+                        detail={"pool": role, "reason": "no_pool_telemetry"},
+                        key=key,
+                    )
                 continue
+            self._hold_streak.pop(key, None)  # telemetry is back
             if role == ROLE_PREFILL:
                 sig = dsig.prefill_signal(agg)
                 target = max(dz.prefill_target_queue, 1)
@@ -466,6 +515,16 @@ class Autoscaler:
                 }
             )
             self.decisions.append(record)
+            clamped = outcome.get("clamped")
+            if clamped is not None and clamped < desired:
+                publish_trigger(
+                    "autoscaler_clamp", model=name,
+                    detail={
+                        "pool": role, "desired": desired, "clamped": clamped,
+                        "window_avg": round(mean, 3),
+                    },
+                    key=f"{name}#{role}",
+                )
             labels = {"model": name, "pool": role}
             M_DESIRED.set(desired, labels=labels)
             M_SIGNAL.set(sig["combined"], labels={**labels, "source": source})

@@ -33,6 +33,9 @@ _PAGES_TOTAL = "kubeai_engine_kv_pages_total"
 _GEN_TOKENS = "kubeai_engine_generated_tokens_total"
 _HBM_USED = "kubeai_engine_hbm_used_bytes"
 _HBM_LIMIT = "kubeai_engine_hbm_limit_bytes"
+_PREFIX_CACHED = "kubeai_engine_prefix_cached_tokens_total"
+_PREFIX_LOOKUP = "kubeai_engine_prefix_lookup_tokens_total"
+_CACHED_EVICTIONS = "kubeai_engine_kv_cached_evictions_total"
 
 M_FLEET_ACTIVE = default_registry.gauge(
     "kubeai_fleet_active_slots",
@@ -54,6 +57,12 @@ M_FLEET_HEADROOM = default_registry.gauge(
     "kubeai_fleet_headroom_requests",
     "estimated additional concurrent requests the model's fleet can absorb "
     "(free slots bounded by free KV pages at the observed pages-per-request)",
+)
+M_FLEET_PREFIX_RATIO = default_registry.gauge(
+    "kubeai_fleet_prefix_hit_ratio",
+    "fleet-wide prefix-cache hit ratio per model: cumulative cached tokens "
+    "over cumulative looked-up prompt tokens across the model's endpoints "
+    "(0 with no lookups yet)",
 )
 # Same metric the autoscaler's peer scrape increments (scope label keeps
 # the sources apart); registering here is idempotent get-or-create.
@@ -209,6 +218,8 @@ class FleetCollector:
         def val(name: str) -> float:
             return sum(v for _, v in parsed.get(name, []))
 
+        prefix_lookup = val(_PREFIX_LOOKUP)
+        prefix_cached = val(_PREFIX_CACHED)
         tokens_total = val(_GEN_TOKENS)
         win = self._prev_tokens.get(addr)
         if win is None:
@@ -232,6 +243,18 @@ class FleetCollector:
             "tokens_per_second": round(tps, 3),
             "hbm_used_bytes": val(_HBM_USED),
             "hbm_limit_bytes": val(_HBM_LIMIT),
+            # Per-replica prefix-cache evidence (ROADMAP item 5b): the
+            # hit RATIO, not just the raw hit counter — cumulative, so
+            # it reads as "lifetime share of prompt tokens served from
+            # shared KV on this replica".
+            "prefix_lookup_tokens": prefix_lookup,
+            "prefix_cached_tokens": prefix_cached,
+            "prefix_hit_ratio": (
+                round(prefix_cached / prefix_lookup, 4)
+                if prefix_lookup > 0
+                else None
+            ),
+            "kv_cached_evictions": val(_CACHED_EVICTIONS),
         }
 
     @staticmethod
@@ -242,10 +265,17 @@ class FleetCollector:
             for k in (
                 "queue_depth", "active_slots", "slots_total", "pages_used",
                 "pages_cached", "pages_total", "tokens_per_second",
+                "prefix_lookup_tokens", "prefix_cached_tokens",
+                "kv_cached_evictions",
             )
         }
         agg["endpoints"] = len(ok)
         agg["failed_endpoints"] = len(endpoints) - len(ok)
+        agg["prefix_hit_ratio"] = (
+            round(agg["prefix_cached_tokens"] / agg["prefix_lookup_tokens"], 4)
+            if agg["prefix_lookup_tokens"] > 0
+            else None
+        )
         agg["free_pages"] = max(agg["pages_total"] - agg["pages_used"], 0.0)
         # Headroom estimate: free slots, bounded by how many more
         # sequences the free KV pages can back at the fleet's observed
@@ -309,6 +339,9 @@ class FleetCollector:
             M_FLEET_FREE_PAGES.set(agg["free_pages"], labels=labels)
             M_FLEET_TPS.set(agg["tokens_per_second"], labels=labels)
             M_FLEET_HEADROOM.set(agg["headroom_requests"], labels=labels)
+            M_FLEET_PREFIX_RATIO.set(
+                agg["prefix_hit_ratio"] or 0.0, labels=labels
+            )
             # Per-pool series (extra `pool` label) so a saturated decode
             # pool is visible even when the prefill pool has headroom.
             for role, pagg in views[model].get("pools", {}).items():
@@ -350,6 +383,15 @@ class FleetCollector:
         reads as a brief dip in window volume, not as garbage."""
         with self._lock:
             return list(self._last_pages.values())
+
+    def parsed_pages_by_addr(self) -> dict[str, dict]:
+        """Same pages keyed by endpoint address — for consumers that
+        difference counters PER SOURCE (the incident recorder's watch):
+        an endpoint whose scrape failed for one tick and then recovered
+        must be recognized as the same source, or its whole cumulative
+        history reads as a one-interval spike."""
+        with self._lock:
+            return dict(self._last_pages)
 
     def debug_view(self, models: list[str], max_age: float | None = None) -> dict:
         """The /debug/fleet payload. Reuses the last collect when it is

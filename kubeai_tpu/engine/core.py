@@ -371,6 +371,22 @@ class Engine:
             "kubeai_engine_prefix_cached_tokens_total",
             "prompt tokens skipped via shared-prefix page reuse",
         )
+        # The denominator the cached-tokens counter never had: prompt
+        # tokens that went THROUGH a prefix-cache lookup at admission.
+        # hit ratio = cached_tokens / lookup_tokens — computable per
+        # replica and fleet-wide (the fleet collector derives it).
+        self.m_prefix_lookup = default_registry.counter(
+            "kubeai_engine_prefix_lookup_tokens_total",
+            "prompt tokens offered to the shared-prefix cache lookup at "
+            "admission (denominator for the prefix hit ratio; 0 growth = "
+            "prefix caching disabled)",
+        )
+        self.m_cached_evictions = default_registry.counter(
+            "kubeai_engine_kv_cached_evictions_total",
+            "reusable cached KV pages evicted by allocation pressure "
+            "(LRU; sustained growth means the prefix cache is thrashing)",
+        )
+        self._evictions_seen = 0
         self.m_pages_used = default_registry.callback_gauge(
             "kubeai_engine_kv_pages_used",
             "KV pool pages referenced by live slots",
@@ -1281,13 +1297,21 @@ class Engine:
     def _update_recompile_counter(self) -> None:
         """Scheduler-loop poll: surface compilations (warmup AND shape-
         churn recompiles) as a counter — steady growth after warmup is
-        the classic silent TPU latency killer."""
+        the classic silent TPU latency killer. The KV cached-page
+        eviction counter rides the same poll (paging.py stays
+        dependency-free; both sources are scheduler-thread-owned)."""
         n = self._jit_cache_entries()
         if n > self._jit_entries_seen:
             self.m_recompiles.inc(n - self._jit_entries_seen)
             self._jit_entries_seen = n
         elif n < self._jit_entries_seen:
             self._jit_entries_seen = n  # caches dropped (recovery rebuild)
+        ev = self._pool.evictions
+        if ev > self._evictions_seen:
+            self.m_cached_evictions.inc(ev - self._evictions_seen)
+            self._evictions_seen = ev
+        elif ev < self._evictions_seen:
+            self._evictions_seen = ev  # pool rebuilt (engine reset)
 
     def is_ready(self) -> bool:
         """Readiness (k8s probe seam): the scheduler loop is alive and
@@ -1879,6 +1903,12 @@ class Engine:
         self._page_table[slot_idx, :] = 0
         self._page_table[slot_idx, : len(row)] = row
         reuse = len(claimed) * ps
+        if self.cfg.prefix_cache_min:
+            # Counted at ADMISSION (not per lookup attempt): a KV-
+            # deferred request re-runs the lookup every round, and an
+            # attempt-counted denominator would understate the hit
+            # ratio exactly when the pool is under pressure.
+            self.m_prefix_lookup.inc(len(ids))
         if reuse:
             self.m_prefix_cached.inc(reuse)
         return slot_idx, reuse

@@ -56,6 +56,12 @@ class PagePool:
         # refcount-0 pages with registered content, LRU order (oldest
         # first); values unused.
         self._cached: "OrderedDict[int, None]" = OrderedDict()
+        # Cumulative cached-page evictions (allocation pressure pushing
+        # reusable prefixes out). Plain int — this module stays
+        # dependency-free; the engine mirrors it into
+        # kubeai_engine_kv_cached_evictions_total from the scheduler
+        # loop (same poll discipline as the jit-recompile counter).
+        self.evictions = 0
 
     # -- capacity ----------------------------------------------------------
 
@@ -130,6 +136,7 @@ class PagePool:
                 # Evict the least-recently-used cached page.
                 page, _ = self._cached.popitem(last=False)
                 self._unregister(page)
+                self.evictions += 1
             self._ref[page] = 1
             out.append(page)
         return out

@@ -36,7 +36,12 @@ from kubeai_tpu.engine.core import Engine
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.faults import FaultError, fault, handle_faults_request
 from kubeai_tpu.metrics import default_registry
-from kubeai_tpu.obs import extract_context, handle_debug_request
+from kubeai_tpu.obs import (
+    extract_context,
+    handle_canary_request,
+    handle_debug_request,
+    handle_incident_request,
+)
 from kubeai_tpu.obs.perf import handle_perf_request
 
 log = logging.getLogger("kubeai_tpu.engine.server")
@@ -343,6 +348,12 @@ def _make_handler(srv: EngineServer):
                 resp = (
                     handle_faults_request(path, query)
                     or handle_perf_request(path, query, engine=srv.engine)
+                    # Incident/canary surfaces answer "not installed"
+                    # here — the black box lives operator-side, but an
+                    # in-process stack (tests, the drill) may install
+                    # one globally, and the route must exist either way.
+                    or handle_incident_request(path, query)
+                    or handle_canary_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:

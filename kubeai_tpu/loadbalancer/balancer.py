@@ -142,6 +142,7 @@ class LoadBalancer:
                 g = EndpointGroup(
                     breaker_threshold=self.breaker_threshold,
                     breaker_cooldown=self.breaker_cooldown,
+                    name=model_name,
                 )
                 self._groups[model_name] = g
             return g
@@ -159,6 +160,12 @@ class LoadBalancer:
         with self._groups_lock:
             groups = dict(self._groups)
         return {name: g.breaker_snapshot() for name, g in sorted(groups.items())}
+
+    def routing_snapshot(self) -> dict[str, dict]:
+        """model -> CHWBL ring + recent-pick view (/debug/routing)."""
+        with self._groups_lock:
+            groups = dict(self._groups)
+        return {name: g.routing_snapshot() for name, g in sorted(groups.items())}
 
     # -- proxy interface (ref: load_balancer.go:176-202) -------------------
 

@@ -58,6 +58,16 @@ class HashRing:
                 if i < len(self._sorted) and self._sorted[i] == h:
                     self._sorted.pop(i)
 
+    def vnode_counts(self) -> dict[str, int]:
+        """Live virtual nodes per endpoint — normally ``replication``
+        each, fewer only when two endpoints' replica hashes collided
+        (last add wins a contested slot). The /debug/routing surface
+        exposes this so ring skew is observable instead of assumed."""
+        out: dict[str, int] = {}
+        for name in self._hash_to_name.values():
+            out[name] = out.get(name, 0) + 1
+        return out
+
     def walk(self, key: str) -> Iterator[str]:
         """Yield endpoint names in clockwise ring order starting at the
         position of ``xxh64(key)``; one yield per ring slot (an endpoint

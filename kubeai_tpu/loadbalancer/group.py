@@ -13,11 +13,13 @@ from __future__ import annotations
 import random
 import threading
 import time
+from collections import deque
 from dataclasses import dataclass, field
 
 from kubeai_tpu.loadbalancer.chwbl import HashRing, chwbl_choose
 
 from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.obs.incidents import publish_trigger
 
 LEAST_LOAD = "LeastLoad"
 PREFIX_HASH = "PrefixHash"
@@ -104,13 +106,15 @@ class EndpointGroup:
         breaker_threshold: int = 3,
         breaker_cooldown: float = 10.0,
         clock=time.monotonic,
+        name: str = "",
     ):
         """*breaker_threshold* consecutive failed attempts eject an
         endpoint for *breaker_cooldown* seconds; after the cooldown it
         goes half-open and admits ONE probe request — success closes the
         breaker, failure re-ejects. ``breaker_threshold <= 0`` disables
         breaking. *clock* is injectable so tests drive cooldowns with a
-        fake clock instead of sleeps."""
+        fake clock instead of sleeps. *name* is the model this group
+        serves — incident triggers and the routing snapshot carry it."""
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._endpoints: dict[str, Endpoint] = {}
@@ -121,6 +125,11 @@ class EndpointGroup:
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
         self._clock = clock
+        self.name = name
+        # Recent endpoint picks (routing observability): (clock t, pod
+        # name, strategy) ring — deque appends are atomic under the GIL
+        # and the pick path already holds the group lock.
+        self._picks: deque[tuple[float, str, str]] = deque(maxlen=512)
 
     # -- balancing ---------------------------------------------------------
 
@@ -214,6 +223,7 @@ class EndpointGroup:
                     ep.probe_started = self._clock()
                 ep.in_flight += 1
                 self._total_in_flight += 1
+                self._picks.append((self._clock(), name, strategy))
 
                 def done(_name=name):
                     with self._lock:
@@ -364,6 +374,17 @@ class EndpointGroup:
                 ep.opened_at = now
                 ep.probe_started = None
                 _M_EJECTIONS.inc(labels={"endpoint": ep.address})
+                # Incident trigger (enqueue-only — safe under _cond): a
+                # failed half-open probe means the endpoint is STILL
+                # dead after a full cooldown.
+                publish_trigger(
+                    "breaker_ejection", model=self.name,
+                    detail={
+                        "endpoint": ep.address, "role": ep.role,
+                        "transition": "half_open->open",
+                        "consecutive_failures": ep.consecutive_failures,
+                    },
+                )
             elif (
                 ep.breaker_state == BREAKER_CLOSED
                 and self.breaker_threshold > 0
@@ -372,6 +393,14 @@ class EndpointGroup:
                 self._set_state(ep, BREAKER_OPEN)
                 ep.opened_at = now
                 _M_EJECTIONS.inc(labels={"endpoint": ep.address})
+                publish_trigger(
+                    "breaker_ejection", model=self.name,
+                    detail={
+                        "endpoint": ep.address, "role": ep.role,
+                        "transition": "closed->open",
+                        "consecutive_failures": ep.consecutive_failures,
+                    },
+                )
 
     def breaker_snapshot(self) -> list[dict]:
         """Per-endpoint breaker view for the /debug/endpoints surface."""
@@ -395,6 +424,57 @@ class EndpointGroup:
                 }
                 for name, ep in sorted(self._endpoints.items())
             ]
+
+    # -- routing observability ---------------------------------------------
+
+    def routing_snapshot(self) -> dict:
+        """The /debug/routing view of this group: the CHWBL ring's
+        per-endpoint virtual-node counts, live in-flight load vs the
+        group mean (the bounded-load check's inputs), and the recent
+        pick distribution — PrefixHash-vs-LeastLoad behavior inspectable
+        at runtime instead of only in benchmarks."""
+        with self._lock:
+            now = self._clock()
+            vnodes = self._ring.vnode_counts()
+            n = len(self._endpoints)
+            mean = self._total_in_flight / n if n else 0.0
+            picks = list(self._picks)
+            pick_counts: dict[str, int] = {}
+            strategies: dict[str, int] = {}
+            for _, name, strategy in picks:
+                pick_counts[name] = pick_counts.get(name, 0) + 1
+                strategies[strategy] = strategies.get(strategy, 0) + 1
+            return {
+                "ring_slots": len(self._ring),
+                "replication": self._ring.replication,
+                "total_in_flight": self._total_in_flight,
+                "mean_in_flight": round(mean, 3),
+                "endpoints": [
+                    {
+                        "name": name,
+                        "address": ep.address,
+                        "role": ep.role,
+                        "in_flight": ep.in_flight,
+                        "vnodes": vnodes.get(name, 0),
+                        # >1.0 = this endpoint is above the group mean —
+                        # the CHWBL bound (mean_load_factor, default
+                        # 1.25) walks past it.
+                        "load_factor": (
+                            round(ep.in_flight / mean, 3) if mean > 0 else 0.0
+                        ),
+                        "breaker_state": ep.breaker_state,
+                        "recent_picks": pick_counts.get(name, 0),
+                    }
+                    for name, ep in sorted(self._endpoints.items())
+                ],
+                "recent_picks": {
+                    "window_seconds": (
+                        round(now - picks[0][0], 3) if picks else 0.0
+                    ),
+                    "total": len(picks),
+                    "by_strategy": strategies,
+                },
+            }
 
     # -- membership --------------------------------------------------------
 

@@ -20,6 +20,13 @@ import uuid
 from kubeai_tpu.autoscaler.autoscaler import Autoscaler
 from kubeai_tpu.autoscaler.fleet import FleetCollector
 from kubeai_tpu.autoscaler.leader import Election
+from kubeai_tpu.obs.canary import CanaryProber, install_canary, uninstall_canary
+from kubeai_tpu.obs.incidents import (
+    IncidentRecorder,
+    install_recorder,
+    standard_sources,
+    uninstall_recorder,
+)
 from kubeai_tpu.obs.slo import SLOMonitor
 from kubeai_tpu.config.system import System, load_system_config
 from kubeai_tpu.controller.adapters import AdapterReconciler
@@ -122,6 +129,33 @@ class Manager:
         self.api.fleet = self.fleet
         self.api.slo = self.slo
         self.api.election = self.election
+        # Incident black box: trigger sources across the stack (SLO burn,
+        # breaker ejections, autoscaler clamps/holds, canary failures,
+        # crash loops / gang reforms / error spikes via the counter
+        # watch) capture ONE correlated snapshot of every debug surface
+        # into a bounded on-disk ring — leader-gated like the SLO loop.
+        self.canary = CanaryProber(
+            self.proxy, self.model_client, self.lb, election=self.election
+        )
+        self.incidents = IncidentRecorder(
+            sources=standard_sources(
+                self.lb,
+                self.model_client,
+                fleet=self.fleet,
+                decision_log=self.autoscaler.decisions,
+                slo=self.slo,
+                canary=self.canary,
+            ),
+            election=self.election,
+            # By-ADDR pages (not the flat list): the counter watch
+            # differences per source, so a scrape-recovered endpoint
+            # diffs against its own baseline instead of reading its
+            # whole cumulative history as a one-interval spike.
+            remote_pages=self.fleet.parsed_pages_by_addr,
+            watch_interval=self.system.autoscaling.interval_seconds,
+        )
+        install_recorder(self.incidents)
+        install_canary(self.canary)
         self.messengers = [
             Messenger(
                 stream.requests_url,
@@ -143,6 +177,8 @@ class Manager:
         self.election.start()
         self.autoscaler.start()
         self.slo.start()
+        self.incidents.start()
+        self.canary.start()
         if self.local_runtime:
             self.local_runtime.start()
         for m in self.messengers:
@@ -163,6 +199,12 @@ class Manager:
         self.api.stop()
         if self.local_runtime:
             self.local_runtime.stop()
+        self.canary.stop()
+        self.incidents.stop()
+        # Identity-checked uninstall: a newer Manager's installation
+        # (tests build several per process) must survive this stop.
+        uninstall_canary(self.canary)
+        uninstall_recorder(self.incidents)
         self.slo.stop()
         self.autoscaler.stop()
         self.election.stop()

@@ -7,6 +7,19 @@ buffer, scheduler step records, and a Chrome-trace/Perfetto export —
 all served from /debug endpoints on both HTTP servers.
 """
 
+from kubeai_tpu.obs.canary import (
+    CanaryProber,
+    handle_canary_request,
+    install_canary,
+    uninstall_canary,
+)
+from kubeai_tpu.obs.incidents import (
+    IncidentRecorder,
+    handle_incident_request,
+    install_recorder,
+    publish_trigger,
+    uninstall_recorder,
+)
 from kubeai_tpu.obs.recorder import (
     DEBUG_PATHS,
     FlightRecorder,
@@ -30,6 +43,15 @@ from kubeai_tpu.obs.trace import (
 )
 
 __all__ = [
+    "CanaryProber",
+    "handle_canary_request",
+    "install_canary",
+    "uninstall_canary",
+    "IncidentRecorder",
+    "handle_incident_request",
+    "install_recorder",
+    "publish_trigger",
+    "uninstall_recorder",
     "DEBUG_PATHS",
     "FlightRecorder",
     "default_recorder",

@@ -1,0 +1,873 @@
+"""Incident black box: triggered cross-layer snapshots with a bounded
+on-disk ring and a correlated /debug/incidents surface.
+
+Every existing debug plane (/debug/slo, /debug/fleet, /debug/autoscaler,
+/debug/requests, breaker states, per-endpoint engine excerpts) is a
+poll-at-the-right-moment surface backed by bounded ring buffers: the
+transient failures the chaos layer itself injects — breaker ejections,
+gang re-forms, mid-stream replays, crash loops, autoscaler holds —
+evaporate before an operator looks. This module closes that gap: named
+**trigger sources** across the stack publish events onto a tiny bus
+(`publish_trigger`, a no-op until a recorder is installed — same
+fast-path discipline as faults.py), and the leader's IncidentRecorder
+captures ONE correlated snapshot of every registered surface per
+accepted trigger.
+
+Discipline mirrors the rest of the repo:
+
+- **Publish is hot-path safe.** Trigger sites call `publish_trigger`
+  while holding their own locks (the breaker publishes under the
+  endpoint-group condition); publish only stamps the debounce table and
+  enqueues — the capture (which takes those same locks via the snapshot
+  sources) runs on a daemon worker thread.
+- **Leader-gated.** Non-leader operator replicas have cold fleet
+  scrapes and empty decision logs; a snapshot from one would be the
+  vacuously-green evidence the SLO monitor's gate exists to prevent.
+  Followers capture nothing at all.
+- **Debounced + deduped.** One incident per (trigger, model) per
+  `KUBEAI_INCIDENT_DEBOUNCE` seconds (injectable clock); suppressed
+  repeats are counted on the retained incident instead of re-capturing.
+- **Bounded both ways.** In-memory deque ring AND an on-disk ring under
+  `KUBEAI_INCIDENT_DIR` (atomic tmp+rename like the sweep resume;
+  oldest files pruned past `KUBEAI_INCIDENT_MAX`), so the evidence
+  survives an operator restart — the whole point of a black box.
+
+Trigger sources wired in-tree (grep ``publish_trigger(`` for ground
+truth): ``slo_burn`` (obs/slo.py burn-rate crossing), ``breaker_ejection``
+(loadbalancer/group.py), ``autoscaler_clamp`` / ``autoscaler_hold``
+(autoscaler decision outcomes), ``canary_error`` / ``canary_corrupt``
+(obs/canary.py), and this module's own counter watch: ``crash_loop``
+(kubeai_pod_restarts_total), ``gang_reform`` (kubeai_gang_reforms_total,
+local + fleet-scraped), ``error_spike`` / ``deadline_spike``
+(kubeai_engine_requests_total outcome deltas).
+
+Served at ``GET /debug/incidents[?id=]`` on BOTH HTTP servers (the
+engine server answers "not installed" — the recorder lives operator-
+side); rendered human-readable by ``python -m
+kubeai_tpu.obs.incident_report`` (docs/observability.md#incident-response).
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import queue
+import threading
+import time
+from collections import deque
+
+from kubeai_tpu.metrics.registry import Counter, default_registry
+from kubeai_tpu.utils import env_float
+
+log = logging.getLogger("kubeai_tpu.incidents")
+
+M_INCIDENTS = default_registry.counter(
+    "kubeai_incidents_total",
+    "incident snapshots captured, by trigger source",
+)
+M_CAPTURE = default_registry.histogram(
+    "kubeai_incident_capture_seconds",
+    "wall time to capture one correlated incident snapshot (all sections)",
+    buckets=(0.01, 0.05, 0.1, 0.25, 0.5, 1, 2.5, 5, 10, 30),
+)
+M_SUPPRESSED = default_registry.counter(
+    "kubeai_incident_suppressed_total",
+    "triggers deduped into an existing incident by the debounce window",
+)
+
+DEFAULT_DIR = "/tmp/kubeai-incidents"
+
+
+def incident_dir_default() -> str:
+    return os.environ.get("KUBEAI_INCIDENT_DIR", "") or DEFAULT_DIR
+
+
+# ---------------------------------------------------------------------------
+# The trigger bus: module-global install point, mirroring faults.py's
+# registry — a trigger site costs one attribute read when no recorder is
+# installed (engine processes, unit tests).
+
+_recorder: "IncidentRecorder | None" = None
+
+
+def install_recorder(rec: "IncidentRecorder") -> None:
+    global _recorder
+    _recorder = rec
+
+
+def uninstall_recorder(rec: "IncidentRecorder") -> None:
+    """Identity-checked (mirrors unregister_engine_debug_section): a
+    dying owner must not clobber a newer recorder's installation."""
+    global _recorder
+    if _recorder is rec:
+        _recorder = None
+
+
+def installed_recorder() -> "IncidentRecorder | None":
+    return _recorder
+
+
+def publish_trigger(
+    trigger: str, model: str = "", detail: dict | None = None, key: str = ""
+) -> str | None:
+    """Fire a trigger at the installed recorder (no-op when none is
+    installed or this replica is not the leader). Safe to call from any
+    thread, including under component locks — never blocks. *key*
+    overrides the debounce/dedupe key (default: the model — e.g. the
+    SLO source keys per objective). Returns the incident id when a
+    capture was scheduled, else None."""
+    rec = _recorder
+    if rec is None:
+        return None
+    try:
+        return rec.publish(trigger, model=model, detail=detail, key=key)
+    except Exception:  # a trigger must never break its source's hot path
+        log.exception("incident trigger %s failed to publish", trigger)
+        return None
+
+
+# ---------------------------------------------------------------------------
+
+
+def _counter_sources(
+    name: str, by_addr=None, include_local: bool = True
+) -> dict[str, dict[tuple, float]]:
+    """Cumulative per-label-set values of counter *name*, PER SOURCE:
+    ``"local"`` is the in-process registry; every other source is one
+    scraped endpoint page keyed by its address (the fleet collector's
+    ``parsed_pages_by_addr``, resolved ONCE per tick by the caller).
+    Keeping sources separate is what makes the watch deltas honest: a
+    scrape that failed for one tick and then recovered is recognized as
+    the SAME endpoint — differencing against its own baseline — instead
+    of its whole cumulative history reading as a one-interval spike.
+    *include_local=False* for ENGINE-owned counters when fleet scraping
+    is wired: an in-process engine (dev mode, the drill) registers its
+    series in the operator's own registry AND is scraped at its addr —
+    summing both would double every delta."""
+    out: dict[str, dict[tuple, float]] = {}
+    if include_local:
+        m = default_registry.get(name)
+        if isinstance(m, Counter):
+            out["local"] = dict(m.snapshot())
+    if by_addr:
+        for addr, page in by_addr.items():
+            series: dict[tuple, float] = {}
+            for labels, v in page.get(name, []):
+                key = tuple(sorted(labels.items()))
+                series[key] = series.get(key, 0.0) + v
+            out[addr] = series
+    return out
+
+
+class IncidentRecorder:
+    """Leader-gated, dependency-free incident recorder.
+
+    *sources* is name -> zero-arg callable returning a JSON-able value;
+    each accepted trigger captures EVERY source into one snapshot (a
+    failing source contributes ``{"error": ...}`` for its section only —
+    an incident with a broken surface is still an incident). *election*
+    is duck-typed: any object with an ``is_leader`` Event (None = always
+    leader, the single-replica/dev mode). *clock* drives debounce,
+    *wall* stamps records — both injectable like the SLO monitor's.
+    """
+
+    def __init__(
+        self,
+        sources: dict | None = None,
+        incident_dir: str | None = None,
+        capacity: int = 32,
+        max_disk: int | None = None,
+        debounce_seconds: float | None = None,
+        clock=time.monotonic,
+        wall=time.time,
+        election=None,
+        remote_pages=None,
+        watch_interval: float = 10.0,
+    ):
+        self._sources: dict[str, object] = dict(sources or {})
+        self.incident_dir = (
+            incident_dir if incident_dir is not None else incident_dir_default()
+        )
+        self.capacity = capacity
+        self.max_disk = (
+            max_disk
+            if max_disk is not None
+            else int(env_float("KUBEAI_INCIDENT_MAX", 64))
+        )
+        self.debounce = (
+            debounce_seconds
+            if debounce_seconds is not None
+            else env_float("KUBEAI_INCIDENT_DEBOUNCE", 30.0)
+        )
+        # Slow-cadence triggers get a wider window: a steady
+        # CrashLoopBackOff restarts at the 60s backoff cap, gang
+        # re-forms wait up to KUBEAI_GANG_REFORM_TIMEOUT (300s), and
+        # canary probes repeat every KUBEAI_CANARY_INTERVAL (30s, i.e.
+        # never inside the default 30s window) — gaps AT OR PAST the
+        # default debounce, so the sliding window would treat every
+        # repeat as a fresh incident and churn both rings past the
+        # root-cause evidence. Floored at the general debounce so an
+        # operator raising KUBEAI_INCIDENT_DEBOUNCE raises these too.
+        slow = max(
+            self.debounce, env_float("KUBEAI_INCIDENT_SLOW_DEBOUNCE", 300.0)
+        )
+        self.trigger_debounce = {
+            "crash_loop": slow,
+            "gang_reform": slow,
+            "canary_error": slow,
+            "canary_corrupt": slow,
+        }
+        self._clock = clock
+        self._wall = wall
+        self._election = election
+        self._remote_pages = remote_pages
+        self.watch_interval = watch_interval
+        self._lock = threading.Lock()
+        self._ring: deque[dict] = deque(maxlen=capacity)
+        self._last_fire: dict[tuple[str, str], float] = {}
+        # id -> suppressed-repeat count folded into a retained incident.
+        self._suppressed: dict[str, int] = {}
+        self._last_id: dict[tuple[str, str], str] = {}
+        self._seq = 0
+        self._q: "queue.Queue[dict]" = queue.Queue()
+        self._worker: threading.Thread | None = None
+        self._worker_lock = threading.Lock()
+        # Counter-watch state (error spikes, crash loops, gang reforms):
+        # kind -> source -> {labelset: cumulative}. None until the first
+        # watch tick seeds the baseline — history predating this
+        # recorder (or a newly-sighted endpoint) must not read as a
+        # fresh spike. Baselines PERSIST across a source's absence (a
+        # failed scrape evicts the addr from the fleet's pages for that
+        # tick): errors counted during the gap must still read as a
+        # delta on recovery, not vanish into a re-seed. Sources absent
+        # watch_absent_ticks in a row age out (pod-churn bound).
+        self._watch_base: dict[str, dict[str, dict[tuple, float]]] | None = None
+        self._watch_absent: dict[tuple[str, str], int] = {}
+        self.watch_absent_ticks = 60
+        # Per-incident time of the last fold re-persist: a sustained
+        # condition folds once per tick, but rewriting the (large) doc
+        # on disk is throttled to once per debounce window. Ids with
+        # throttled (unflushed) repeats wait in _fold_dirty; the watch
+        # loop and stop() flush them once their window passes, so the
+        # persisted count converges after the condition quiets.
+        self._fold_flushed: dict[str, float] = {}
+        self._fold_dirty: set[str] = set()
+        self._watch_thread: threading.Thread | None = None
+        self._stop_evt = threading.Event()
+        self._running = False
+        # Set by stop(), cleared by start(). Distinct from _running
+        # (which only gates the watch loop): a recorder that was never
+        # start()ed must still accept triggers — tests and the drill
+        # publish directly — but one that was STOPPED must not respawn
+        # a capture worker with no sentinel coming to release it.
+        self._stopped = False
+        # Spike thresholds (per watch interval): minimum terminal events
+        # to judge a rate at all, and the bad fraction that trips.
+        self.error_min_requests = env_float("KUBEAI_INCIDENT_ERROR_MIN", 5.0)
+        self.error_rate_threshold = env_float("KUBEAI_INCIDENT_ERROR_RATE", 0.3)
+
+    # -- wiring ------------------------------------------------------------
+
+    def register_source(self, name: str, fn) -> None:
+        """Add/replace one snapshot section provider (latest wins)."""
+        self._sources[name] = fn
+
+    def _leading(self) -> bool:
+        return self._election is None or self._election.is_leader.is_set()
+
+    # -- triggering --------------------------------------------------------
+
+    def publish(
+        self, trigger: str, model: str = "", detail: dict | None = None, key: str = ""
+    ) -> str | None:
+        """Accept or debounce one trigger. Cheap and non-blocking by
+        contract (called under component locks): stamps the debounce
+        table and enqueues the capture for the worker thread. Followers
+        (non-leaders) record NOTHING — their surfaces are cold and a
+        snapshot of them would be evidence of the wrong thing."""
+        if self._stopped or not self._leading():
+            return None
+        now = self._clock()
+        window = self.trigger_debounce.get(trigger, self.debounce)
+        with self._lock:
+            key = (trigger, key or model)
+            last = self._last_fire.get(key)
+            if last is not None and now - last < window:
+                # SLIDING window: each suppressed repeat re-anchors the
+                # debounce, so a condition that keeps firing (an hour of
+                # no_pool_telemetry at a 10s tick) folds into ONE
+                # incident for its whole duration — a fixed anchor would
+                # re-capture every debounce period and churn the rings
+                # past the root-cause evidence they exist to preserve. A
+                # new incident for the same key requires the condition
+                # to go QUIET for a full debounce first.
+                self._last_fire[key] = now
+                M_SUPPRESSED.inc(labels={"trigger": trigger})
+                held = self._last_id.get(key)
+                if held is not None:
+                    self._suppressed[held] = self._suppressed.get(held, 0) + 1
+                    # Fold the repeat into the PERSISTED document too —
+                    # the footprint of an hour-long hold vs a 2-tick
+                    # blip must survive the operator restart the disk
+                    # ring exists for — but on the WORKER thread: this
+                    # path runs under component locks (the breaker's
+                    # _cond), so the enqueue-only contract forbids disk
+                    # IO here.
+                    self._ensure_worker()
+                    self._q.put({"fold": held})
+                return None
+            self._last_fire[key] = now
+            self._seq += 1
+            incident_id = (
+                f"{int(self._wall() * 1000):013d}-{self._seq:04d}-{trigger}"
+            )
+            self._last_id[key] = incident_id
+        self._ensure_worker()
+        self._q.put(
+            {
+                "id": incident_id,
+                "t": self._wall(),
+                "trigger": trigger,
+                "model": model,
+                "detail": dict(detail or {}),
+            }
+        )
+        return incident_id
+
+    def _ensure_worker(self) -> None:
+        if self._worker is not None and self._worker.is_alive():
+            return
+        with self._worker_lock:
+            if self._stopped:
+                return  # enqueue is harmless; respawning is not
+            if self._worker is not None and self._worker.is_alive():
+                return
+            self._worker = threading.Thread(
+                target=self._drain, name="incident-recorder", daemon=True
+            )
+            self._worker.start()
+
+    def _drain(self) -> None:
+        while True:
+            event = self._q.get()
+            try:
+                if event is None:  # stop() sentinel: exit cleanly
+                    return
+                if "fold" in event:
+                    self._persist_fold(event["fold"], force=event.get("force", False))
+                else:
+                    self._capture(event)
+            except Exception:
+                log.exception("incident capture failed")
+            finally:
+                self._q.task_done()
+
+    def _persist_fold(self, incident_id: str, force: bool = False) -> None:
+        """Re-persist a retained incident whose suppressed-repeat count
+        grew (runs on the worker thread — folds are enqueued by publish,
+        which must not do disk IO under its callers' locks). A doc not
+        in the memory ring is skipped: either its capture is still
+        queued behind this event (capture stamps the live count itself)
+        or it was evicted (its bookkeeping went with it)."""
+        now = self._clock()
+        last = self._fold_flushed.get(incident_id)
+        if not force and last is not None and now - last < self.debounce:
+            # Disk throttle: the memory count (snapshot()/get()) stays
+            # exact every fold; the persisted copy lags by at most one
+            # debounce window instead of being rewritten — engine
+            # excerpts and all — once per trigger tick for the whole
+            # life of a sustained condition. Marked dirty so the watch
+            # loop (or stop()) flushes the FINAL count once the window
+            # passes — a condition that ends mid-window must still
+            # leave its true footprint on disk.
+            self._fold_dirty.add(incident_id)
+            return
+        doc = None
+        with self._lock:
+            for d in self._ring:
+                if d["id"] == incident_id:
+                    d["suppressed_repeats"] = self._suppressed.get(incident_id, 0)
+                    doc = dict(d)
+                    break
+        self._fold_dirty.discard(incident_id)
+        if doc is not None:
+            self._fold_flushed[incident_id] = now
+            self._persist(doc)
+
+    # -- capture -----------------------------------------------------------
+
+    def _capture(self, event: dict) -> None:
+        t0 = time.monotonic()
+        sections: dict[str, object] = {}
+        ok: list[str] = []
+        for name, fn in list(self._sources.items()):
+            try:
+                sections[name] = fn()
+                ok.append(name)
+            except Exception as e:
+                sections[name] = {"error": str(e)[:300]}
+        dur = time.monotonic() - t0
+        doc = dict(event)
+        doc["sections"] = sections
+        doc["sections_ok"] = ok
+        doc["capture_seconds"] = round(dur, 4)
+        with self._lock:
+            # Repeats that folded between publish and this capture
+            # landing must reach the persisted doc too.
+            doc["suppressed_repeats"] = self._suppressed.get(doc["id"], 0)
+            # Memory-ring eviction prunes the per-incident bookkeeping
+            # with it: suppressed counts (and the debounce table's held
+            # id) must not outlive the incident they describe, or a
+            # long-lived leader grows them without bound.
+            evicted = (
+                self._ring[0]["id"]
+                if len(self._ring) == self._ring.maxlen
+                else None
+            )
+            self._ring.append(doc)
+            if evicted is not None:
+                self._suppressed.pop(evicted, None)
+                self._fold_flushed.pop(evicted, None)
+                self._fold_dirty.discard(evicted)
+                for k in [
+                    k for k, v in self._last_id.items() if v == evicted
+                ]:
+                    del self._last_id[k]
+        M_INCIDENTS.inc(labels={"trigger": event["trigger"]})
+        M_CAPTURE.observe(dur)
+        self._persist(doc)
+        log.warning(
+            "incident %s captured: trigger=%s model=%s sections=%d/%d in %.2fs",
+            doc["id"], event["trigger"], event["model"] or "-",
+            len(ok), len(sections), dur,
+        )
+
+    def _persist(self, doc: dict) -> None:
+        """Atomic write (tmp + rename, the sweep-resume discipline) into
+        the bounded disk ring; IO failure degrades to memory-only."""
+        if not self.incident_dir:
+            return
+        final = os.path.join(self.incident_dir, f"incident-{doc['id']}.json")
+        tmp = final + ".tmp"
+        try:
+            os.makedirs(self.incident_dir, exist_ok=True)
+            with open(tmp, "w") as f:
+                json.dump(doc, f)
+            os.replace(tmp, final)
+            self._prune_disk()
+        except OSError as e:
+            # Reclaim the partial write: a full disk during an incident
+            # storm must not also accumulate unbounded .tmp debris (the
+            # prune pass only manages completed .json files).
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
+            log.warning("incident persist failed (%s); kept in memory only", e)
+
+    def _prune_disk(self) -> None:
+        # Ids lead with zero-padded epoch-ms, so lexicographic order IS
+        # chronological order. Orphaned .tmp files (a crash between
+        # write and rename) are reclaimed too — safe because this runs
+        # on the single capture-worker thread, the only writer.
+        names = []
+        for n in os.listdir(self.incident_dir):
+            if not n.startswith("incident-"):
+                continue
+            if n.endswith(".json.tmp"):
+                try:
+                    os.remove(os.path.join(self.incident_dir, n))
+                except OSError:
+                    pass
+            elif n.endswith(".json"):
+                names.append(n)
+        names.sort()
+        for n in names[: max(len(names) - self.max_disk, 0)]:
+            try:
+                os.remove(os.path.join(self.incident_dir, n))
+            except OSError:
+                pass
+
+    # -- counter watch -----------------------------------------------------
+
+    def watch_tick(self) -> None:
+        """Diff cumulative counters (local registry + fleet-scraped
+        remote pages) against RETAINED per-source baselines and publish
+        derived triggers. The first tick only seeds — counter history
+        predating the recorder is not an incident — and each SOURCE
+        (endpoint) seeds independently on first sighting. A source's
+        baseline survives its absence (a failed scrape evicts the addr
+        from the fleet's pages for a tick): on recovery the delta spans
+        the whole gap, so errors counted while the scrape was down
+        still fire — the correlated engine-erroring-AND-unscrapeable
+        failure is exactly when the watch must not go blind. Negative
+        deltas (engine restart reset the counter) clamp to zero, same
+        rule as the SLO monitor."""
+        # Debounce-table hygiene (rides the watch cadence): entries
+        # quiet for 2x their window can never suppress anything again —
+        # without pruning, model/pool churn grows _last_fire without
+        # bound on a long-lived leader, the same invariant the memory-
+        # ring eviction enforces for _suppressed/_last_id.
+        now = self._clock()
+        with self._lock:
+            for k in [
+                k for k, t in self._last_fire.items()
+                if now - t > 2 * self.trigger_debounce.get(k[0], self.debounce)
+            ]:
+                del self._last_fire[k]
+        # Flush throttled fold counts whose window has passed — the
+        # condition quieted, so the persisted doc must converge to the
+        # true repeat footprint.
+        for iid in list(self._fold_dirty):
+            last = self._fold_flushed.get(iid)
+            if last is None or now - last >= self.debounce:
+                self._ensure_worker()
+                self._q.put({"fold": iid})
+        by_addr = None
+        if self._remote_pages is not None:
+            try:
+                by_addr = self._remote_pages() or {}
+            except Exception:
+                by_addr = {}
+        # Engine-owned counters read the local registry only when fleet
+        # scraping is UNWIRED: with scrapes in play, an in-process
+        # engine's series would be counted twice (registry + its page).
+        # Pod restarts are operator-owned — always local.
+        engine_local = self._remote_pages is None
+        cur = {
+            "restarts": _counter_sources("kubeai_pod_restarts_total"),
+            "reforms": _counter_sources(
+                "kubeai_gang_reforms_total", by_addr, include_local=engine_local
+            ),
+            "requests": _counter_sources(
+                "kubeai_engine_requests_total", by_addr, include_local=engine_local
+            ),
+        }
+        base = self._watch_base
+        if base is None:
+            self._watch_base = {
+                kind: {s: dict(series) for s, series in v.items()}
+                for kind, v in cur.items()
+            }
+            return
+
+        def delta(kind: str) -> dict[tuple, float]:
+            out: dict[tuple, float] = {}
+            for source, series in cur[kind].items():
+                base_series = base.get(kind, {}).get(source)
+                if base_series is None:
+                    continue  # first sighting of this source: seed only
+                for key, v in series.items():
+                    d = v - base_series.get(key, 0.0)
+                    if d > 0:
+                        out[key] = out.get(key, 0.0) + d
+            return out
+
+        deltas = {kind: delta(kind) for kind in cur}
+        # Refresh baselines: present sources replace theirs; absent ones
+        # are RETAINED (failed scrape) until watch_absent_ticks in a row
+        # — then dropped, so weeks of pod churn can't grow them forever.
+        for kind, sources in cur.items():
+            bk = base.setdefault(kind, {})
+            for s, series in sources.items():
+                bk[s] = dict(series)
+                self._watch_absent.pop((kind, s), None)
+            for s in [s for s in bk if s not in sources]:
+                n = self._watch_absent.get((kind, s), 0) + 1
+                if n >= self.watch_absent_ticks:
+                    del bk[s]
+                    self._watch_absent.pop((kind, s), None)
+                else:
+                    self._watch_absent[(kind, s)] = n
+        if not self._leading():
+            return
+
+        for key, d in deltas["restarts"].items():
+            model = dict(key).get("model", "")
+            self.publish(
+                "crash_loop", model=model, detail={"restarts": d}
+            )
+        reform_d = sum(deltas["reforms"].values())
+        if reform_d > 0:
+            self.publish("gang_reform", detail={"reforms": reform_d})
+        req_d = deltas["requests"]
+        total = sum(req_d.values())
+        if total >= self.error_min_requests:
+            bad = sum(
+                v for key, v in req_d.items()
+                if dict(key).get("outcome") == "error"
+            )
+            cancelled = sum(
+                v for key, v in req_d.items()
+                if dict(key).get("outcome") == "cancelled"
+            )
+            if bad / total >= self.error_rate_threshold:
+                self.publish(
+                    "error_spike",
+                    detail={
+                        "errors": bad, "window_requests": total,
+                        "error_rate": round(bad / total, 4),
+                    },
+                )
+            if cancelled / total >= self.error_rate_threshold:
+                self.publish(
+                    "deadline_spike",
+                    detail={
+                        "cancelled": cancelled, "window_requests": total,
+                        "cancelled_rate": round(cancelled / total, 4),
+                    },
+                )
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def start(self) -> None:
+        self._running = True
+        self._stopped = False
+        self._stop_evt.clear()
+        self._watch_thread = threading.Thread(
+            target=self._watch_loop, name="incident-watch", daemon=True
+        )
+        self._watch_thread.start()
+
+    def stop(self) -> None:
+        self._running = False
+        # Refuse new triggers FIRST: a straggler publish (the SLO or
+        # autoscaler thread mid-tick during Manager.stop) must not
+        # respawn a worker after the sentinel below has been consumed —
+        # that thread would block on queue.get() forever, pinning the
+        # operator stack through its source closures.
+        self._stopped = True
+        self._stop_evt.set()
+        if self._watch_thread:
+            self._watch_thread.join(timeout=5)
+        # Terminate the capture worker too: a bare queue.get() would
+        # otherwise strand one daemon thread (whose source closures pin
+        # the whole operator stack) per recorder lifecycle. Throttled
+        # fold counts flush first (forced) — the disk doc is the only
+        # copy that outlives this process.
+        worker = self._worker
+        if worker is not None and worker.is_alive():
+            for iid in list(self._fold_dirty):
+                self._q.put({"fold": iid, "force": True})
+            self._q.put(None)
+            worker.join(timeout=5)
+
+    def _watch_loop(self) -> None:
+        while self._running:
+            if self._stop_evt.wait(self.watch_interval):
+                return
+            try:
+                self.watch_tick()
+            except Exception:
+                log.exception("incident counter watch failed")
+
+    def wait_idle(self, timeout: float = 5.0) -> bool:
+        """Block (bounded) until every enqueued capture has landed —
+        the seam tests and the drill use instead of sleeps."""
+        deadline = time.monotonic() + timeout
+        while self._q.unfinished_tasks and time.monotonic() < deadline:
+            time.sleep(0.01)
+        return not self._q.unfinished_tasks
+
+    # -- read --------------------------------------------------------------
+
+    def snapshot(self, limit: int | None = None) -> list[dict]:
+        """Most-recent-first incident summaries (the list view — full
+        section payloads are fetched per id)."""
+        with self._lock:
+            docs = list(self._ring)
+        docs.reverse()
+        if limit:
+            docs = docs[:limit]
+        return [
+            {
+                "id": d["id"],
+                "t": d["t"],
+                "trigger": d["trigger"],
+                "model": d["model"],
+                "detail": d["detail"],
+                "sections": sorted(d["sections"]),
+                "sections_ok": d["sections_ok"],
+                "capture_seconds": d["capture_seconds"],
+                "suppressed_repeats": self._suppressed.get(d["id"], 0),
+            }
+            for d in docs
+        ]
+
+    def get(self, incident_id: str) -> dict | None:
+        """Full incident document by id: memory ring first, then the
+        disk ring (incidents survive the in-memory ring and restarts)."""
+        with self._lock:
+            for d in self._ring:
+                if d["id"] == incident_id:
+                    doc = dict(d)
+                    doc["suppressed_repeats"] = self._suppressed.get(incident_id, 0)
+                    return doc
+        # The id reaches this path straight from ?id= on an
+        # unauthenticated debug port: anything outside the generated id
+        # alphabet (epoch-ms, seq, trigger name) is rejected BEFORE it
+        # can become path segments — "x/../../etc/creds.json" must not
+        # read files outside the ring.
+        if self.incident_dir and incident_id and all(
+            c.isalnum() or c in "_-" for c in incident_id
+        ):
+            path = os.path.join(self.incident_dir, f"incident-{incident_id}.json")
+            try:
+                with open(path) as f:
+                    return json.load(f)
+            except (OSError, ValueError):
+                return None
+        return None
+
+    def disk_index(self) -> list[str]:
+        """Ids present in the on-disk ring, newest first. The memory
+        ring dies with the process; this index is how a freshly
+        restarted operator (or the report CLI over --url) discovers the
+        evidence that survived — the whole point of the disk ring."""
+        if not self.incident_dir or not os.path.isdir(self.incident_dir):
+            return []
+        try:
+            names = sorted(
+                (
+                    n for n in os.listdir(self.incident_dir)
+                    if n.startswith("incident-") and n.endswith(".json")
+                ),
+                reverse=True,
+            )
+        except OSError:
+            return []
+        return [n[len("incident-"):-len(".json")] for n in names]
+
+    def report(self) -> dict:
+        """The /debug/incidents list payload."""
+        return {
+            "active": self._leading(),
+            "incident_dir": self.incident_dir,
+            "debounce_seconds": self.debounce,
+            "capacity": {"memory": self.capacity, "disk": self.max_disk},
+            "incidents": self.snapshot(),
+            "disk": self.disk_index(),
+        }
+
+
+# ---------------------------------------------------------------------------
+# Snapshot-source helpers
+
+
+def engine_debug_source(addrs_fn, timeout: float = 2.0, per_model_cap: int = 4):
+    """Build a snapshot source that GETs per-endpoint engine debug
+    excerpts (``/debug/engine?limit=25`` step records + the
+    ``/debug/pipeline`` stall report) for every model's endpoints —
+    bounded to *per_model_cap* endpoints per model so a wide fleet can't
+    turn one capture into a scrape storm. Endpoints are fetched through
+    the fleet's shared daemon scrape pool: a capture's wall time is the
+    SLOWEST endpoint (one dead pod = one 2s timeout), not the sum of
+    every timeout across the fleet — incident evidence is only as good
+    as how close to the failure it was taken. *addrs_fn* returns
+    model -> [addr]; unreachable endpoints contribute their error."""
+    import urllib.request
+
+    def fetch_one(item: tuple[str, str]) -> tuple[str, str, dict]:
+        model, addr = item
+        base = addr if addr.startswith("http") else f"http://{addr}"
+        rec: dict[str, object] = {}
+        for key, p in (
+            ("engine", "/debug/engine?limit=25"),
+            ("pipeline", "/debug/pipeline"),
+        ):
+            try:
+                with urllib.request.urlopen(base + p, timeout=timeout) as r:
+                    rec[key] = json.loads(r.read())
+            except Exception as e:
+                rec[key] = {"error": str(e)[:200]}
+        return model, addr, rec
+
+    def fetch():
+        from kubeai_tpu.autoscaler.fleet import shared_scrape_executor
+
+        try:
+            by_model = addrs_fn() or {}
+        except Exception as e:
+            return {"error": str(e)[:200]}
+        items = [
+            (model, addr)
+            for model, addrs in by_model.items()
+            for addr in list(addrs)[:per_model_cap]
+        ]
+        out: dict[str, dict] = {}
+        for model, addr, rec in shared_scrape_executor().map(fetch_one, items):
+            out.setdefault(model, {})[addr] = rec
+        return out
+
+    return fetch
+
+
+def standard_sources(
+    lb,
+    model_client,
+    fleet=None,
+    decision_log=None,
+    slo=None,
+    canary=None,
+    trace_limit: int = 30,
+) -> dict:
+    """The canonical snapshot-source set over the operator's debug
+    surfaces — ONE wiring shared by the Manager and the incident drill
+    so the captured sections can't drift between them. Every source is
+    a zero-arg callable evaluated at capture time."""
+    from kubeai_tpu.obs.recorder import default_recorder
+
+    def model_names() -> list[str]:
+        return [m.meta.name for m in model_client.list_all_models()]
+
+    sources: dict[str, object] = {
+        "endpoints": lambda: {"models": lb.breaker_snapshot()},
+        "requests": lambda: {
+            "requests": default_recorder.snapshot(limit=trace_limit)
+        },
+        "engines": engine_debug_source(
+            lambda: {m: lb.get_all_addresses(m) for m in model_names()}
+        ),
+    }
+    if hasattr(lb, "routing_snapshot"):
+        sources["routing"] = lb.routing_snapshot
+    if slo is not None:
+        sources["slo"] = slo.report
+    if fleet is not None:
+        sources["fleet"] = lambda: fleet.debug_view(model_names())
+    if decision_log is not None:
+        sources["autoscaler"] = lambda: {
+            "decisions": decision_log.snapshot(limit=50)
+        }
+    if canary is not None:
+        sources["canary"] = canary.report
+    return sources
+
+
+# ---------------------------------------------------------------------------
+# Shared /debug HTTP route (both servers chain this next to the faults
+# and recorder handlers). An engine process has no recorder installed
+# and answers 404 with a reason — the black box lives operator-side.
+
+
+def handle_incident_request(path: str, query: str = "") -> tuple[int, str, bytes] | None:
+    if path != "/debug/incidents":
+        return None
+    rec = _recorder
+    if rec is None:
+        return 404, "application/json", json.dumps(
+            {"error": {"message": "no incident recorder installed on this process"}}
+        ).encode()
+    from urllib.parse import parse_qs
+
+    q = parse_qs(query or "")
+    wanted = (q.get("id") or [None])[0]
+    if wanted:
+        doc = rec.get(wanted)
+        if doc is None:
+            return 404, "application/json", json.dumps(
+                {"error": {"message": f"no incident {wanted!r}"}}
+            ).encode()
+        return 200, "application/json", json.dumps(doc).encode()
+    return 200, "application/json", json.dumps(rec.report()).encode()

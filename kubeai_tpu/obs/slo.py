@@ -39,6 +39,7 @@ from collections import deque
 from dataclasses import dataclass
 
 from kubeai_tpu.metrics.registry import Counter, Histogram, default_registry
+from kubeai_tpu.obs.incidents import publish_trigger
 
 M_ATTAIN = default_registry.gauge(
     "kubeai_slo_attainment",
@@ -211,6 +212,12 @@ class SLOMonitor:
         )
         self.interval = interval_seconds
         self._clock = clock
+        # Incident trigger: a burn rate at/above this multiple (with at
+        # least the minimum window volume — a 1-request window burning
+        # "fast" is noise) publishes an slo_burn trigger to the incident
+        # recorder, which captures the correlated cross-layer snapshot.
+        self.burn_trigger = _env_float("KUBEAI_SLO_BURN_TRIGGER", 4.0)
+        self.trigger_min_requests = _env_float("KUBEAI_SLO_TRIGGER_MIN", 10.0)
         self._lock = threading.Lock()
         # (t, {objective: (good, total)}) cumulative snapshots; the
         # oldest in-window snapshot is the delta baseline.
@@ -293,6 +300,7 @@ class SLOMonitor:
             good, total, effective = self._cumulative(o)
             cum[o.name] = (good, total)
             eff[o.name] = effective
+        crossings: list[dict] = []
         with self._lock:
             self._snaps.append((now, cum))
             # Keep the snapshot that STARTS the window as the baseline:
@@ -305,9 +313,10 @@ class SLOMonitor:
                 g1, t1 = cum[o.name]
                 good_d, total_d = max(g1 - g0, 0.0), max(t1 - t0, 0.0)
                 att = good_d / total_d if total_d > 0 else 1.0
+                burn = burn_rate(att, o.target)
                 labels = {"slo": o.name}
                 M_ATTAIN.set(round(att, 6), labels=labels)
-                M_BURN.set(round(burn_rate(att, o.target), 6), labels=labels)
+                M_BURN.set(round(burn, 6), labels=labels)
                 M_WINDOW_REQS.set(total_d, labels=labels)
                 self._state[o.name] = {
                     "name": o.name,
@@ -320,8 +329,24 @@ class SLOMonitor:
                     "requests": total_d,
                     "good": good_d,
                     "attainment": round(att, 6),
-                    "burn_rate": round(burn_rate(att, o.target), 4),
+                    "burn_rate": round(burn, 4),
                 }
+                if (
+                    total_d >= self.trigger_min_requests
+                    and burn >= self.burn_trigger
+                ):
+                    crossings.append({
+                        "slo": o.name,
+                        "burn_rate": round(burn, 3),
+                        "attainment": round(att, 6),
+                        "window_requests": total_d,
+                        "threshold": self.burn_trigger,
+                    })
+        # Publish OUTSIDE the lock: the capture worker reads report()
+        # (which takes it); publish itself never blocks, but there is no
+        # reason to hold state hostage while the bus debounces.
+        for c in crossings:
+            publish_trigger("slo_burn", detail=c, key=c["slo"])
 
     def report(self) -> dict:
         """The /debug/slo payload."""

@@ -20,7 +20,11 @@ import time
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.faults import handle_faults_request
 from kubeai_tpu.metrics import default_registry
-from kubeai_tpu.obs import handle_debug_request
+from kubeai_tpu.obs import (
+    handle_canary_request,
+    handle_debug_request,
+    handle_incident_request,
+)
 from kubeai_tpu.proxy.apiutils import (
     APIError,
     parse_label_selector,
@@ -199,6 +203,11 @@ def _make_handler(srv: OpenAIServer):
             elif path == "/debug/endpoints":
                 # Passive-health visibility: per-model breaker states.
                 self._json(200, {"models": srv.proxy.lb.breaker_snapshot()})
+            elif path == "/debug/routing":
+                # Routing visibility: CHWBL ring snapshot (vnodes, load
+                # factors) + recent pick distribution per model, so
+                # PrefixHash-vs-LeastLoad behavior is inspectable live.
+                self._json(200, {"models": srv.proxy.lb.routing_snapshot()})
             elif path == "/debug/autoscaler":
                 # Scaling decision audit: why the autoscaler did what it
                 # did, one record per tick per model.
@@ -248,7 +257,12 @@ def _make_handler(srv: OpenAIServer):
                     )
                 self._json(200, srv.slo.report())
             elif path.startswith("/debug/"):
-                resp = handle_faults_request(path, query) or handle_debug_request(path, query)
+                resp = (
+                    handle_faults_request(path, query)
+                    or handle_incident_request(path, query)
+                    or handle_canary_request(path, query)
+                    or handle_debug_request(path, query)
+                )
                 if resp is None:
                     return self._json(404, {"error": {"message": f"no route {path}"}})
                 code, ctype, body = resp

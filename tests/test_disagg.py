@@ -531,6 +531,75 @@ class TestPerPoolAutoscaling:
         # The reachable pool still got a real decision.
         assert by_pool[ROLE_PREFILL]["signal"]["source"] == "prefill_queue_wait"
 
+    def test_hold_trigger_fires_on_confirmed_streak_and_keeps_publishing(self):
+        """The autoscaler_hold incident trigger needs TWO consecutive
+        blind ticks (one is a scrape blip, not evidence), then keeps
+        publishing every blind tick — the recorder's debounce folds the
+        repeats into suppressed_repeats, so an hour-long hold leaves a
+        bigger footprint than a 2-tick one. A mode flip back to unified
+        clears the streak state with the pool gauge series."""
+        from kubeai_tpu.obs.incidents import (
+            IncidentRecorder,
+            install_recorder,
+            uninstall_recorder,
+        )
+
+        store = Store()
+        store.create(mt.KIND_MODEL, mk_disagg_model())
+        texts = {
+            "p:1": TestFleetRoles.ENGINE_TEXT.format(q=2, a=1, st=2, pu=0, pt=100),
+        }
+
+        def fetch(addr):
+            if addr == "d:1":
+                raise ConnectionError("dead decode pool")
+            return texts[addr]
+
+        from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        mc = ModelClient(store)
+        lb = TestFleetRoles.RoleStubLB(
+            ["p:1", "d:1"], {"p:1": ROLE_PREFILL, "d:1": ROLE_DECODE}
+        )
+        fleet = FleetCollector(lb, clock=time.monotonic, fetch=fetch)
+        asc = Autoscaler(
+            store, mc, lb, _Lead(), average_window_count=1,
+            fixed_self_metric_addrs=[], fleet=fleet,
+        )
+        rec = IncidentRecorder(
+            sources={"probe": lambda: {}}, incident_dir="",
+            debounce_seconds=300.0,
+        )
+        install_recorder(rec)
+        try:
+            def holds():
+                return [
+                    i for i in rec.snapshot()
+                    if i["trigger"] == "autoscaler_hold"
+                ]
+
+            asc.tick()  # streak 1: a single blind tick is not evidence
+            assert rec.wait_idle()
+            assert holds() == []
+            asc.tick()  # streak 2: confirmed → incident
+            assert rec.wait_idle()
+            assert len(holds()) == 1
+            assert holds()[0]["detail"] == {
+                "pool": ROLE_DECODE, "reason": "no_pool_telemetry",
+            }
+            asc.tick()  # streak 3: still publishing, debounce-folded
+            assert rec.wait_idle()
+            assert len(holds()) == 1
+            assert holds()[0]["suppressed_repeats"] == 1
+            # Flip back to unified: the streak goes with the pool series.
+            assert asc._hold_streak
+            asc._clear_pool_series("dz1")
+            assert asc._hold_streak == {}
+        finally:
+            uninstall_recorder(rec)
+            rec.stop()
+
 
 # ---------------------------------------------------------------------------
 # Tier-1 e2e: proxy → prefill replica → handoff → decode replica

@@ -1,0 +1,927 @@
+"""Incident black box suite: trigger bus + leader-gated recorder +
+bounded disk ring, the synthetic canary prober (fingerprint check), the
+/debug/routing surface, the prefix-cache hit-ratio evidence, and the
+tier-1 fast variant of the end-to-end incident drill.
+
+Deterministic discipline matches test_chaos.py: failpoints + fake
+clocks, bounded waits, no leaked global installs (every test that
+installs a recorder/prober uninstalls it)."""
+
+import json
+import os
+import threading
+import time
+
+import pytest
+
+from kubeai_tpu import faults
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.api.model_types import Model, ModelSpec
+from kubeai_tpu.config.system import System
+from kubeai_tpu.controller.controller import ModelReconciler
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+from kubeai_tpu.loadbalancer.group import Endpoint, EndpointGroup, LEAST_LOAD, PREFIX_HASH
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.obs.canary import CanaryProber, M_PROBES, install_canary, uninstall_canary
+from kubeai_tpu.obs.incident_report import render_incident
+from kubeai_tpu.obs.incidents import (
+    IncidentRecorder,
+    install_recorder,
+    publish_trigger,
+    standard_sources,
+    uninstall_recorder,
+)
+from kubeai_tpu.proxy.handler import ModelProxy
+from kubeai_tpu.proxy.modelclient import ModelClient
+from kubeai_tpu.proxy.server import OpenAIServer
+from kubeai_tpu.runtime.store import ObjectMeta, Store
+from tests.test_chaos import ScriptedSSEEngine, get
+from tests.test_proxy_integration import FakeEngine, await_pods, forge_ready
+
+
+@pytest.fixture(autouse=True)
+def _clean_globals():
+    faults.clear_all()
+    yield
+    faults.clear_all()
+
+
+class FakeClock:
+    def __init__(self, t: float = 1000.0):
+        self.t = t
+
+    def __call__(self) -> float:
+        return self.t
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+class _Election:
+    def __init__(self, leader: bool = True):
+        self.is_leader = threading.Event()
+        if leader:
+            self.is_leader.set()
+
+
+def mk_recorder(tmp_path=None, leader=True, **kw):
+    kw.setdefault("sources", {"probe": lambda: {"alive": True}})
+    kw.setdefault("debounce_seconds", 30.0)
+    rec = IncidentRecorder(
+        incident_dir=str(tmp_path) if tmp_path is not None else "",
+        election=_Election(leader),
+        **kw,
+    )
+    return rec
+
+
+def _await(cond, timeout=5.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out awaiting {msg}")
+
+
+# ---------------------------------------------------------------------------
+# Recorder unit behavior
+
+
+class TestIncidentRecorder:
+    def test_debounce_dedupes_per_trigger_and_key(self, tmp_path):
+        clock = FakeClock()
+        rec = mk_recorder(tmp_path, clock=clock)
+        id1 = rec.publish("breaker_ejection", model="m1")
+        assert id1 is not None
+        # Same (trigger, model) inside the window: suppressed, folded
+        # into the retained incident.
+        assert rec.publish("breaker_ejection", model="m1") is None
+        # Different model or different trigger: separate incidents.
+        id2 = rec.publish("breaker_ejection", model="m2")
+        assert id2 is not None
+        assert rec.publish("canary_error", model="m1") is not None
+        assert rec.wait_idle()
+        # LATE fold (capture already landed): the retained doc — and its
+        # DISK copy, the one that survives an operator restart — both
+        # carry the repeat count (re-persisted by the worker thread;
+        # publish itself must stay enqueue-only).
+        assert rec.publish("breaker_ejection", model="m2") is None
+        assert rec.wait_idle()
+        with open(tmp_path / f"incident-{id2}.json") as f:
+            assert json.load(f)["suppressed_repeats"] == 1
+        clock.advance(31.0)
+        assert rec.publish("breaker_ejection", model="m1") is not None
+        assert rec.wait_idle()
+        assert len(rec.snapshot()) == 4
+        first = rec.get(id1)
+        assert first["suppressed_repeats"] == 1
+        # Early fold (suppressed before the capture landed) was stamped
+        # into the persisted doc at capture time.
+        with open(tmp_path / f"incident-{id1}.json") as f:
+            assert json.load(f)["suppressed_repeats"] == 1
+
+    def test_debounce_slides_under_sustained_condition(self, tmp_path):
+        """An hour-long condition firing every 10s is ONE incident, not
+        120: each suppressed repeat re-anchors the window, so a fresh
+        incident needs the condition to go quiet for a full debounce."""
+        clock = FakeClock()
+        rec = mk_recorder(tmp_path, clock=clock)
+        first = rec.publish("autoscaler_hold", model="m1", key="m1#decode")
+        assert first is not None
+        for _ in range(360):  # one simulated hour at a 10s tick
+            clock.advance(10.0)
+            assert rec.publish("autoscaler_hold", model="m1", key="m1#decode") is None
+        assert rec.wait_idle()
+        assert len(rec.snapshot()) == 1
+        assert rec.get(first)["suppressed_repeats"] == 360
+        # Quiet for a full debounce: the NEXT occurrence is new.
+        clock.advance(31.0)
+        assert rec.publish("autoscaler_hold", model="m1", key="m1#decode") is not None
+
+    def test_slow_cadence_triggers_get_wider_debounce(self, tmp_path):
+        """A steady CrashLoopBackOff restarts at the 60s backoff cap —
+        slower than the 30s default debounce. crash_loop/gang_reform use
+        a wider window so the repeats still fold into one incident
+        instead of churning both rings every minute."""
+        clock = FakeClock()
+        rec = mk_recorder(tmp_path, clock=clock)
+        first = rec.publish("crash_loop", model="m1")
+        assert first is not None
+        for _ in range(30):  # half an hour of restarts at the cap
+            clock.advance(60.0)
+            assert rec.publish("crash_loop", model="m1") is None
+        assert rec.wait_idle()
+        assert len(rec.snapshot()) == 1
+        assert rec.get(first)["suppressed_repeats"] == 30
+        # The ordinary triggers keep the tight window.
+        assert rec.publish("breaker_ejection", model="m1") is not None
+        clock.advance(60.0)
+        assert rec.publish("breaker_ejection", model="m1") is not None
+
+    def test_get_rejects_path_traversal_ids(self, tmp_path):
+        """?id= reaches the disk lookup straight off an unauthenticated
+        debug port: ids with path segments must not read files outside
+        the ring directory."""
+        import pathlib
+
+        secret = pathlib.Path(tmp_path) / "outside" / "secret.json"
+        secret.parent.mkdir()
+        secret.write_text('{"leak": true}')
+        ring = pathlib.Path(tmp_path) / "ring"
+        rec = mk_recorder(ring)
+        iid = rec.publish("breaker_ejection", model="m1")
+        assert rec.wait_idle()
+        assert rec.get(iid) is not None
+        evil = "x/../../outside/secret"
+        assert rec.get(evil) is None
+        assert rec.get("../" + iid) is None
+        assert rec.get("") is None
+
+    def test_publish_after_stop_refused_and_no_worker_respawn(self, tmp_path):
+        rec = mk_recorder(tmp_path)
+        assert rec.publish("canary_error", model="m1") is not None
+        assert rec.wait_idle()
+        rec.stop()  # joins the capture worker via its sentinel
+        assert rec.publish("canary_error", model="m2") is None
+        assert rec._worker is None or not rec._worker.is_alive()
+        # start() re-admits triggers (leadership regained).
+        rec.start()
+        assert rec.publish("canary_error", model="m3") is not None
+        assert rec.wait_idle()
+        rec.stop()
+
+    def test_capture_sections_and_persistence(self, tmp_path):
+        boom = {"n": 0}
+
+        def bad_source():
+            boom["n"] += 1
+            raise RuntimeError("surface offline")
+
+        rec = mk_recorder(
+            tmp_path,
+            sources={"good": lambda: {"x": 1}, "bad": bad_source},
+        )
+        iid = rec.publish("slo_burn", detail={"burn_rate": 9.0}, key="e2e")
+        assert rec.wait_idle()
+        doc = rec.get(iid)
+        assert doc["sections"]["good"] == {"x": 1}
+        assert "surface offline" in doc["sections"]["bad"]["error"]
+        assert doc["sections_ok"] == ["good"]
+        # Atomic on-disk copy, readable after the memory ring is gone.
+        [fname] = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+        with open(tmp_path / fname) as f:
+            assert json.load(f)["id"] == iid
+
+    def test_ring_and_disk_bounds_hold_under_concurrent_triggers(self, tmp_path):
+        rec = mk_recorder(tmp_path, capacity=4, max_disk=5, debounce_seconds=0.0)
+        n_threads, per_thread = 8, 5
+
+        def fire(tid):
+            for i in range(per_thread):
+                rec.publish("canary_error", model=f"m{tid}-{i}")
+
+        threads = [
+            threading.Thread(target=fire, args=(t,)) for t in range(n_threads)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        assert rec.wait_idle(timeout=15)
+        assert len(rec.snapshot()) <= 4
+        files = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+        assert 0 < len(files) <= 5
+        for n in files:  # every survivor is whole (atomic rename)
+            with open(tmp_path / n) as f:
+                json.load(f)
+
+    def test_follower_captures_nothing(self, tmp_path):
+        rec = mk_recorder(tmp_path, leader=False)
+        assert rec.publish("breaker_ejection", model="m1") is None
+        assert rec.wait_idle()
+        assert rec.snapshot() == []
+        assert os.listdir(tmp_path) == []
+        assert rec.report()["active"] is False
+
+    def test_restart_lists_and_serves_disk_incidents(self, tmp_path):
+        """The black-box property end-to-end: after an operator restart
+        the memory ring is gone, but /debug/incidents still INDEXES the
+        persisted evidence (report()["disk"]) and serves it by id —
+        without filesystem access to the incident dir."""
+        rec = mk_recorder(tmp_path)
+        iid = rec.publish("breaker_ejection", model="m1")
+        assert rec.wait_idle()
+        rec.stop()
+        # "Restart": a fresh recorder over the same dir, nothing in memory.
+        rec2 = mk_recorder(tmp_path)
+        rep = rec2.report()
+        assert rep["incidents"] == []
+        assert iid in rep["disk"]
+        assert rec2.get(iid)["trigger"] == "breaker_ejection"
+
+    def test_memory_eviction_falls_back_to_disk(self, tmp_path):
+        clock = FakeClock()
+        rec = mk_recorder(tmp_path, capacity=1, clock=clock, debounce_seconds=0.0)
+        id1 = rec.publish("canary_error", model="a")
+        id2 = rec.publish("canary_error", model="b")
+        assert rec.wait_idle()
+        assert [i["id"] for i in rec.snapshot()] == [id2]
+        assert rec.get(id1)["id"] == id1  # served from the disk ring
+
+    def test_stop_terminates_capture_worker(self, tmp_path):
+        rec = mk_recorder(tmp_path)
+        rec.publish("canary_error", model="m")
+        assert rec.wait_idle()
+        worker = rec._worker
+        assert worker is not None and worker.is_alive()
+        rec.stop()
+        worker.join(timeout=5)
+        assert not worker.is_alive(), "stop() must release the capture worker"
+
+    def test_memory_eviction_prunes_suppressed_bookkeeping(self, tmp_path):
+        clock = FakeClock()
+        rec = mk_recorder(tmp_path, capacity=1, clock=clock, debounce_seconds=30.0)
+        id1 = rec.publish("canary_error", model="a")
+        rec.publish("canary_error", model="a")  # suppressed onto id1
+        clock.advance(31)
+        rec.publish("canary_error", model="b")  # evicts id1 from memory
+        assert rec.wait_idle()
+        assert id1 not in rec._suppressed
+        assert id1 not in rec._last_id.values()
+
+    def test_publish_trigger_noop_without_install_and_routes_when_installed(self, tmp_path):
+        assert publish_trigger("breaker_ejection", model="m") is None
+        rec = mk_recorder(tmp_path)
+        install_recorder(rec)
+        try:
+            assert publish_trigger("breaker_ejection", model="m") is not None
+        finally:
+            uninstall_recorder(rec)
+
+    def test_counter_watch_error_spike_and_crash_loop(self, tmp_path):
+        rec = mk_recorder(tmp_path, debounce_seconds=0.0)
+        m_req = default_registry.counter(
+            "kubeai_engine_requests_total", "terminal request events"
+        )
+        m_restart = default_registry.counter(
+            "kubeai_pod_restarts_total", "pod restarts"
+        )
+        rec.watch_tick()  # seeds the baseline: prior history != incident
+        assert rec.snapshot() == []
+        m_req.inc(7, labels={"outcome": "error"})
+        m_req.inc(3, labels={"outcome": "ok"})
+        m_restart.inc(2, labels={"model": "m-crash"})
+        rec.watch_tick()
+        assert rec.wait_idle()
+        triggers = {i["trigger"]: i for i in rec.snapshot()}
+        assert "error_spike" in triggers
+        assert triggers["error_spike"]["detail"]["errors"] == 7.0
+        assert "crash_loop" in triggers
+        assert triggers["crash_loop"]["model"] == "m-crash"
+        # No further growth: next tick is quiet.
+        before = len(rec.snapshot())
+        rec.watch_tick()
+        assert rec.wait_idle()
+        assert len(rec.snapshot()) == before
+
+    def test_counter_watch_diffs_remote_sources_per_addr(self, tmp_path):
+        """Fleet-scraped counters difference PER ENDPOINT against a
+        RETAINED baseline: an endpoint whose scrape fails for a tick
+        and then recovers diffs against its own pre-gap baseline — its
+        cumulative error history must not read as a one-interval spike,
+        but errors genuinely counted DURING the gap still fire."""
+        pages: dict[str, dict] = {}
+        rec = mk_recorder(
+            tmp_path, debounce_seconds=0.0, remote_pages=lambda: pages
+        )
+
+        def page(err, ok):
+            return {
+                "kubeai_engine_requests_total": [
+                    ({"outcome": "error"}, float(err)),
+                    ({"outcome": "ok"}, float(ok)),
+                ]
+            }
+
+        pages["e1:9100"] = page(90, 10)
+        rec.watch_tick()  # seeds e1's baseline
+        pages.clear()  # e1's scrape fails for one tick
+        rec.watch_tick()
+        pages["e1:9100"] = page(90, 20)  # recovers: full history visible
+        rec.watch_tick()
+        assert rec.wait_idle()
+        spikes = [i for i in rec.snapshot() if i["trigger"] == "error_spike"]
+        assert spikes == [], "recovered endpoint's history read as a spike"
+        # Diffing against its own baseline, a genuine burst fires.
+        pages["e1:9100"] = page(96, 21)
+        rec.watch_tick()
+        assert rec.wait_idle()
+        spikes = [i for i in rec.snapshot() if i["trigger"] == "error_spike"]
+        assert len(spikes) == 1
+        assert spikes[0]["detail"]["errors"] == 6.0
+
+    def test_counter_watch_does_not_double_count_in_process_engine(self, tmp_path):
+        """An in-process engine (dev mode, the drill) registers its
+        counters in the operator's own registry AND is fleet-scraped at
+        its address. With scraping wired, the watch must read the
+        scraped page only — summing both would double every delta and
+        trip the spike volume gate at half the real traffic."""
+        pages: dict[str, dict] = {}
+        rec = mk_recorder(
+            tmp_path, debounce_seconds=0.0, remote_pages=lambda: pages
+        )
+        m_req = default_registry.counter(
+            "kubeai_engine_requests_total", "terminal request events"
+        )
+
+        def page(err, ok):
+            return {
+                "kubeai_engine_requests_total": [
+                    ({"outcome": "error"}, float(err)),
+                    ({"outcome": "ok"}, float(ok)),
+                ]
+            }
+
+        pages["local-engine:9100"] = page(0, 0)
+        rec.watch_tick()  # seeds
+        # The SAME 10 events land in both the registry and the page.
+        m_req.inc(6, labels={"outcome": "error"})
+        m_req.inc(4, labels={"outcome": "ok"})
+        pages["local-engine:9100"] = page(6, 4)
+        rec.watch_tick()
+        assert rec.wait_idle()
+        [spike] = [i for i in rec.snapshot() if i["trigger"] == "error_spike"]
+        assert spike["detail"]["errors"] == 6.0, "in-process engine double-counted"
+        assert spike["detail"]["window_requests"] == 10.0
+
+    def test_throttled_fold_counts_flush_after_quiescence(self, tmp_path):
+        """The disk-flush throttle must not permanently undercount: a
+        condition that folds several repeats inside one debounce window
+        and then quiets still gets its FINAL count persisted (via the
+        watch tick after the window passes, and force-flushed on stop)."""
+        clock = FakeClock()
+        rec = mk_recorder(tmp_path, clock=clock)
+        iid = rec.publish("autoscaler_hold", model="m1")
+        assert rec.wait_idle()
+        for _ in range(5):
+            clock.advance(2.0)
+            rec.publish("autoscaler_hold", model="m1")  # all suppressed
+            assert rec.wait_idle()  # drain each fold so the throttle is observable
+        with open(tmp_path / f"incident-{iid}.json") as f:
+            flushed = json.load(f)["suppressed_repeats"]
+        assert flushed < 5, "throttle should have deferred most folds"
+        clock.advance(31.0)  # window passes; condition stays quiet
+        rec.watch_tick()
+        assert rec.wait_idle()
+        with open(tmp_path / f"incident-{iid}.json") as f:
+            assert json.load(f)["suppressed_repeats"] == 5
+        # And stop() force-flushes anything still pending: the first
+        # fold lands (no prior flush), the second is throttled into
+        # _fold_dirty — only the forced flush can persist count 2.
+        rec.publish("autoscaler_hold", model="m2")
+        assert rec.wait_idle()
+        i2 = rec.snapshot()[0]["id"]
+        rec.publish("autoscaler_hold", model="m2")
+        rec.publish("autoscaler_hold", model="m2")
+        assert rec.wait_idle()
+        rec.stop()
+        with open(tmp_path / f"incident-{i2}.json") as f:
+            assert json.load(f)["suppressed_repeats"] == 2
+
+    def test_counter_watch_counts_errors_across_a_scrape_gap(self, tmp_path):
+        """The correlated failure: an engine starts ERRORING and its
+        /metrics scrape dies at the same time (fleet evicts its page).
+        The retained baseline means the errors counted during the gap
+        fire on the very next successful scrape instead of vanishing
+        into a re-seed — the watch must not go blind exactly when the
+        replica is sick."""
+        pages: dict[str, dict] = {}
+        rec = mk_recorder(
+            tmp_path, debounce_seconds=0.0, remote_pages=lambda: pages
+        )
+
+        def page(err, ok):
+            return {
+                "kubeai_engine_requests_total": [
+                    ({"outcome": "error"}, float(err)),
+                    ({"outcome": "ok"}, float(ok)),
+                ]
+            }
+
+        pages["e1:9100"] = page(0, 50)
+        rec.watch_tick()  # seeds
+        pages.clear()  # replica sick: scrape fails for two ticks...
+        rec.watch_tick()
+        rec.watch_tick()
+        pages["e1:9100"] = page(9, 51)  # ...while it errored 9 times
+        rec.watch_tick()
+        assert rec.wait_idle()
+        spikes = [i for i in rec.snapshot() if i["trigger"] == "error_spike"]
+        assert len(spikes) == 1, "gap-interval errors were lost to a re-seed"
+        assert spikes[0]["detail"]["errors"] == 9.0
+
+
+# ---------------------------------------------------------------------------
+# E2e: breaker ejection drives a correlated incident (the chaos path)
+
+
+@pytest.fixture
+def stack():
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=10)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    engines = []
+    yield store, rec, lb, mc, api, engines
+    api.stop()
+    lb.stop()
+    rec.stop()
+    for e in engines:
+        e.stop()
+
+
+def mk_model(name="m1", **kw):
+    kw.setdefault("url", "hf://org/model")
+    kw.setdefault("resource_profile", "cpu:1")
+    kw.setdefault("min_replicas", 0)
+    return Model(meta=ObjectMeta(name=name), spec=ModelSpec(**kw))
+
+
+def _post(api, body):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"},
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=30) as resp:
+            return resp.status, json.loads(resp.read())
+    except urllib.error.HTTPError as e:
+        return e.code, json.loads(e.read())
+
+
+class TestIncidentChaosE2E:
+    def test_breaker_ejection_lands_correlated_incident(self, stack, tmp_path):
+        """Arm a failpoint, drive a breaker ejection through the REAL
+        proxy, and assert the black box caught it: a persisted incident
+        with >=3 correlated sections whose rendered report interleaves
+        the surfaces."""
+        store, rec_, lb, mc, api, engines = stack
+        recorder = IncidentRecorder(
+            sources=standard_sources(lb, mc),
+            incident_dir=str(tmp_path),
+            debounce_seconds=0.0,
+            election=_Election(True),
+        )
+        install_recorder(recorder)
+        try:
+            store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+            pods = await_pods(store, "m1", 1)
+            eng = FakeEngine()
+            engines.append(eng)
+            forge_ready(store, pods[0].meta.name, eng)
+            status, _ = _post(api, {"model": "m1", "prompt": "healthy"})
+            assert status == 200
+            # Kill every connect to m1's endpoint: 3 attempts on one
+            # request = threshold ejection + a breaker_ejection trigger.
+            faults.arm_spec("proxy.connect", "error")
+            status, _ = _post(api, {"model": "m1", "prompt": "doomed"})
+            assert status == 502
+            faults.clear_fault("proxy.connect")
+            assert recorder.wait_idle(timeout=10)
+            incidents = recorder.snapshot()
+            assert incidents, "ejection did not produce an incident"
+            inc = next(i for i in incidents if i["trigger"] == "breaker_ejection")
+            assert inc["model"] == "m1"
+            doc = recorder.get(inc["id"])
+            assert len(doc["sections_ok"]) >= 3, doc["sections_ok"]
+            # The ejected endpoint is in the snapshot's breaker section.
+            eps = doc["sections"]["endpoints"]["models"]["m1"]
+            assert any(e["state"] == "open" for e in eps)
+            # And the doomed request's trace is in the requests section.
+            outcomes = [
+                t["outcome"] for t in doc["sections"]["requests"]["requests"]
+            ]
+            assert "error" in outcomes
+            # Rendered report interleaves >=3 surfaces.
+            report = render_incident(doc)
+            surfaces = [
+                s for s in ("breaker", "request", "routing", "TRIGGER")
+                if s in report
+            ]
+            assert len(surfaces) >= 3, report
+            # Persisted: the report CLI can read it back after "restart".
+            files = [n for n in os.listdir(tmp_path) if inc["id"] in n]
+            assert files
+            # /debug/incidents on the operator serves it too.
+            code, body = get(api.port, f"/debug/incidents?id={inc['id']}")
+            assert code == 200 and body["id"] == inc["id"]
+            code, body = get(api.port, "/debug/incidents")
+            assert code == 200 and body["active"] is True
+        finally:
+            uninstall_recorder(recorder)
+
+    def test_debug_incidents_404_when_uninstalled(self, stack):
+        _, _, _, _, api, _ = stack
+        code, body = get(api.port, "/debug/incidents")
+        assert code == 404
+
+
+# ---------------------------------------------------------------------------
+# Canary prober
+
+
+CANARY_EVENTS = [
+    '{"choices": [{"index": 0, "text": "tok%d", "finish_reason": null}]}' % i
+    for i in range(3)
+] + [
+    '{"choices": [{"index": 0, "text": "", "finish_reason": "stop"}]}',
+    "[DONE]",
+]
+CORRUPT_EVENTS = [
+    '{"choices": [{"index": 0, "text": "WRONG", "finish_reason": null}]}',
+    '{"choices": [{"index": 0, "text": "", "finish_reason": "stop"}]}',
+    "[DONE]",
+]
+
+
+class TestCanary:
+    def _canary(self, stack, **kw):
+        store, rec, lb, mc, api, engines = stack
+        kw.setdefault("interval_seconds", 3600)
+        kw.setdefault("timeout_seconds", 10)
+        kw.setdefault("enabled", True)
+        return CanaryProber(api.proxy, mc, lb, **kw)
+
+    def test_skips_scaled_to_zero_and_never_wakes_it(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(name="cold", min_replicas=0))
+        time.sleep(0.2)
+        canary = self._canary(stack)
+        before_ok = M_PROBES.value(labels={"outcome": "ok"})
+        before_err = M_PROBES.value(labels={"outcome": "error"})
+        out = canary.probe_model("cold")
+        assert out["outcome"] == "skipped"
+        assert M_PROBES.value(labels={"outcome": "ok"}) == before_ok
+        assert M_PROBES.value(labels={"outcome": "error"}) == before_err
+        # The probe must NOT have scaled the model.
+        assert store.get(mt.KIND_MODEL, "cold").spec.replicas in (0, None)
+
+    def test_ok_probe_pins_fingerprint_and_observes_latency(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = ScriptedSSEEngine(CANARY_EVENTS)
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        _await(lambda: lb.get_all_addresses("m1"), msg="endpoint")
+        canary = self._canary(stack)
+        out = canary.probe_model("m1")
+        assert out["outcome"] == "ok", out
+        assert out["fingerprint"] == out["baseline"]
+        assert out["e2e_s"] is not None and out["ttft_s"] is not None
+        # Deterministic repeat: same fingerprint, still ok.
+        out2 = canary.probe_model("m1")
+        assert out2["outcome"] == "ok"
+        assert out2["fingerprint"] == out["fingerprint"]
+        rep = canary.report()
+        assert rep["models"]["m1"]["outcome"] == "ok"
+
+    def test_fingerprint_flags_injected_corruption(self, stack, tmp_path):
+        """The acceptance case for silent corruption: the model starts
+        answering DIFFERENT (but well-formed, 200-ok) tokens — only the
+        fingerprint check can see it. The probe flags `corrupt`, bumps
+        the outcome counter, and fires a canary_corrupt incident."""
+        store, rec_, lb, mc, api, engines = stack
+        recorder = IncidentRecorder(
+            sources={"canary_ctx": lambda: {"seen": True}},
+            incident_dir=str(tmp_path), debounce_seconds=0.0,
+            election=_Election(True),
+        )
+        install_recorder(recorder)
+        try:
+            store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+            pods = await_pods(store, "m1", 1)
+            events = list(CANARY_EVENTS)
+            good = ScriptedSSEEngine(events)
+            engines.append(good)
+            forge_ready(store, pods[0].meta.name, good)
+            _await(lambda: lb.get_all_addresses("m1"), msg="endpoint")
+            canary = self._canary(stack)
+            assert canary.probe_model("m1")["outcome"] == "ok"
+            # Silently swap the replica's OUTPUT in place (same
+            # endpoint, same 200-ok streaming shape, different tokens):
+            # the injected corrupt response no error metric can see.
+            events[:] = CORRUPT_EVENTS
+            before = M_PROBES.value(labels={"outcome": "corrupt"})
+            out = canary.probe_model("m1")
+            assert out["outcome"] == "corrupt", out
+            assert out["fingerprint"] != out["baseline"]
+            assert M_PROBES.value(labels={"outcome": "corrupt"}) == before + 1
+            assert recorder.wait_idle()
+            [inc] = [
+                i for i in recorder.snapshot() if i["trigger"] == "canary_corrupt"
+            ]
+            assert inc["model"] == "m1"
+            assert inc["detail"]["fingerprint"] != inc["detail"]["baseline"]
+            # Baseline is retained: corruption keeps flagging until an
+            # operator resets it deliberately.
+            assert canary.probe_model("m1")["outcome"] == "corrupt"
+            canary.reset_fingerprint("m1")
+            assert canary.probe_model("m1")["outcome"] == "ok"
+        finally:
+            uninstall_recorder(recorder)
+
+    def test_rollout_re_pins_baseline_instead_of_false_corrupt(self, stack):
+        """A legitimate model update (spec.url rollout) changes the
+        deterministic output. tick() must notice the deployment-identity
+        change and drop the baseline BEFORE probing — otherwise every
+        probe after the rollout reads a permanent false 'corrupt'."""
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        events = list(CANARY_EVENTS)
+        eng = ScriptedSSEEngine(events)
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        _await(lambda: lb.get_all_addresses("m1"), msg="endpoint")
+        canary = self._canary(stack)
+        canary.tick()
+        first = canary.report()["models"]["m1"]
+        assert first["outcome"] == "ok"
+        # Roll the model: new weights url, new (well-formed) output.
+        m = store.get(mt.KIND_MODEL, "m1")
+        m.spec.url = "hf://org/model-v2"
+        store.update(mt.KIND_MODEL, m)
+        events[:] = CORRUPT_EVENTS
+        canary.tick()
+        out = canary.report()["models"]["m1"]
+        assert out["outcome"] == "ok", out
+        assert out["fingerprint"] != first["fingerprint"]
+        assert out["baseline"] == out["fingerprint"]
+        # Same deployment, output flips again: NOW it is corruption.
+        events[:] = CANARY_EVENTS
+        canary.tick()
+        assert canary.report()["models"]["m1"]["outcome"] == "corrupt"
+
+    def test_truncated_stream_is_error_and_never_pins_baseline(self, stack):
+        """A 200 stream that ends without [DONE] is a truncated probe:
+        outcome=error, and crucially the fingerprint baseline is NOT
+        pinned — a degraded first probe must not poison every later
+        healthy probe into a permanent false 'corrupt'."""
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        events = list(CANARY_EVENTS[:-1])  # clean end, no [DONE]
+        eng = ScriptedSSEEngine(events)
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        _await(lambda: lb.get_all_addresses("m1"), msg="endpoint")
+        canary = self._canary(stack)
+        out = canary.probe_model("m1")
+        assert out["outcome"] == "error" and "truncated" in out["error"]
+        # Recovery: the next COMPLETE probe pins the baseline and is ok.
+        events.append("[DONE]")
+        out2 = canary.probe_model("m1")
+        assert out2["outcome"] == "ok", out2
+        assert out2["baseline"] == out2["fingerprint"]
+
+    def test_error_probe_counts_and_triggers(self, stack, tmp_path):
+        store, rec_, lb, mc, api, engines = stack
+        recorder = IncidentRecorder(
+            sources={"ctx": lambda: 1}, incident_dir=str(tmp_path),
+            debounce_seconds=0.0, election=_Election(True),
+        )
+        install_recorder(recorder)
+        try:
+            store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+            pods = await_pods(store, "m1", 1)
+            eng = ScriptedSSEEngine(CANARY_EVENTS)
+            engines.append(eng)
+            forge_ready(store, pods[0].meta.name, eng)
+            _await(lambda: lb.get_all_addresses("m1"), msg="endpoint")
+            faults.arm_spec("proxy.connect", "error")
+            canary = self._canary(stack)
+            before = M_PROBES.value(labels={"outcome": "error"})
+            out = canary.probe_model("m1")
+            assert out["outcome"] == "error"
+            assert M_PROBES.value(labels={"outcome": "error"}) == before + 1
+            assert recorder.wait_idle()
+            assert any(
+                i["trigger"] == "canary_error" for i in recorder.snapshot()
+            )
+        finally:
+            uninstall_recorder(recorder)
+
+    def test_debug_canary_route(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        code, _ = get(api.port, "/debug/canary")
+        assert code == 404  # not installed
+        canary = self._canary(stack)
+        install_canary(canary)
+        try:
+            code, body = get(api.port, "/debug/canary")
+            assert code == 200
+            assert body["enabled"] is True and "models" in body
+        finally:
+            uninstall_canary(canary)
+
+
+# ---------------------------------------------------------------------------
+# /debug/routing
+
+
+class TestRoutingDebug:
+    def test_group_routing_snapshot_shape(self):
+        g = EndpointGroup(name="m1", chwbl_replication=8)
+        g.reconcile_endpoints({
+            "pod-a": Endpoint(address="1.1.1.1:8000"),
+            "pod-b": Endpoint(address="1.1.1.2:8000", role="decode"),
+        })
+        dones = []
+        for _ in range(6):
+            _, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            dones.append(done)
+        _, done = g.get_best_addr(strategy=PREFIX_HASH, prefix="hello", timeout=1)
+        dones.append(done)
+        snap = g.routing_snapshot()
+        assert snap["ring_slots"] == 16 and snap["replication"] == 8
+        assert snap["total_in_flight"] == 7
+        by_name = {e["name"]: e for e in snap["endpoints"]}
+        assert by_name["pod-a"]["vnodes"] == 8
+        assert by_name["pod-b"]["role"] == "decode"
+        assert (
+            by_name["pod-a"]["recent_picks"] + by_name["pod-b"]["recent_picks"]
+            == 7
+        )
+        assert snap["recent_picks"]["total"] == 7
+        assert snap["recent_picks"]["by_strategy"] == {
+            LEAST_LOAD: 6, PREFIX_HASH: 1,
+        }
+        # Load factors are relative to the group mean.
+        assert sum(
+            e["load_factor"] * 0 + e["in_flight"] for e in snap["endpoints"]
+        ) == 7
+        for d in dones:
+            d()
+        assert g.routing_snapshot()["total_in_flight"] == 0
+
+    def test_debug_routing_http(self, stack):
+        store, rec, lb, mc, api, engines = stack
+        store.create(mt.KIND_MODEL, mk_model(replicas=1, min_replicas=1))
+        pods = await_pods(store, "m1", 1)
+        eng = FakeEngine()
+        engines.append(eng)
+        forge_ready(store, pods[0].meta.name, eng)
+        assert _post(api, {"model": "m1", "prompt": "x"})[0] == 200
+        code, body = get(api.port, "/debug/routing")
+        assert code == 200
+        m1 = body["models"]["m1"]
+        assert m1["recent_picks"]["total"] >= 1
+        assert m1["endpoints"][0]["vnodes"] == 256
+
+
+# ---------------------------------------------------------------------------
+# Prefix-cache hit-ratio evidence through the fleet collector
+
+
+ENGINE_PAGE = """\
+kubeai_engine_queue_depth 0
+kubeai_engine_active_slots 1
+kubeai_engine_slots_total 4
+kubeai_engine_kv_pages_used 10
+kubeai_engine_kv_pages_cached 3
+kubeai_engine_kv_pages_total 64
+kubeai_engine_generated_tokens_total 100
+kubeai_engine_prefix_lookup_tokens_total 200
+kubeai_engine_prefix_cached_tokens_total 80
+kubeai_engine_kv_cached_evictions_total 5
+"""
+
+
+class _FakeLB:
+    def __init__(self, addrs):
+        self.addrs = addrs
+
+    def get_all_addresses(self, model):
+        return self.addrs.get(model, [])
+
+
+class TestPrefixRatioEvidence:
+    def test_fleet_surfaces_per_endpoint_and_aggregate_ratio(self):
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        lb = _FakeLB({"m1": ["e1:8000", "e2:8000"]})
+        pages = {"e1:8000": ENGINE_PAGE, "e2:8000": ENGINE_PAGE.replace(
+            "kubeai_engine_prefix_cached_tokens_total 80",
+            "kubeai_engine_prefix_cached_tokens_total 20",
+        )}
+        fc = FleetCollector(lb, fetch=lambda addr: pages[addr])
+        view = fc.collect(["m1"])["m1"]
+        by_addr = {e["address"]: e for e in view["endpoints"]}
+        assert by_addr["e1:8000"]["prefix_hit_ratio"] == 0.4
+        assert by_addr["e2:8000"]["prefix_hit_ratio"] == 0.1
+        assert by_addr["e1:8000"]["kv_cached_evictions"] == 5.0
+        agg = view["aggregate"]
+        assert agg["prefix_lookup_tokens"] == 400.0
+        assert agg["prefix_cached_tokens"] == 100.0
+        assert agg["prefix_hit_ratio"] == 0.25
+        from kubeai_tpu.autoscaler.fleet import M_FLEET_PREFIX_RATIO
+
+        assert M_FLEET_PREFIX_RATIO.value(labels={"model": "m1"}) == 0.25
+
+    def test_no_lookups_reads_none_not_divide_by_zero(self):
+        from kubeai_tpu.autoscaler.fleet import FleetCollector
+
+        page = ENGINE_PAGE.replace(
+            "kubeai_engine_prefix_lookup_tokens_total 200",
+            "kubeai_engine_prefix_lookup_tokens_total 0",
+        )
+        lb = _FakeLB({"m1": ["e1:8000"]})
+        fc = FleetCollector(lb, fetch=lambda addr: page)
+        view = fc.collect(["m1"])["m1"]
+        assert view["endpoints"][0]["prefix_hit_ratio"] is None
+        assert view["aggregate"]["prefix_hit_ratio"] is None
+
+    def test_engine_counts_lookup_denominator_and_evictions(self):
+        """The engine-side halves: lookup tokens counted at admission,
+        pool evictions mirrored into the counter by the scheduler poll."""
+        from kubeai_tpu.engine.paging import PagePool
+
+        pool = PagePool(num_pages=6, page_size=4)
+        pages = pool.allocate(3)
+        pool.register_chain(list(range(12)), (0, 0), pages)
+        pool.release(pages)
+        assert pool.cached_pages() == 3 and pool.evictions == 0
+        # Free list is empty (5 usable pages: 3 cached + 2 free); grab 3
+        # so at least one allocation must evict a cached page.
+        pool.allocate(3)
+        assert pool.evictions == 1
+
+
+# ---------------------------------------------------------------------------
+# Tier-1 fast variant of the end-to-end incident drill (make incident-drill)
+
+
+class TestIncidentDrillFast:
+    def test_drill_fast(self, tmp_path, monkeypatch):
+        from benchmarks.incident_drill import run
+
+        monkeypatch.setenv("KUBEAI_DEBUG_FAULTS", "1")
+        summary = run(fast=True, incident_dir=str(tmp_path), verbose=False)
+        assert summary["ok"] is True
+        assert summary["detection"]["canary_error_probes"] >= 1
+        assert summary["detection"]["within_probe_periods"] == 1
+        assert len(summary["incident"]["correlated_surfaces"]) >= 3
+        assert summary["incident"]["persisted_files"] >= 1
