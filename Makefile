@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -38,6 +38,14 @@ disagg-bench: ## unified vs disaggregated A/B at mixed prompt lengths -> BENCH_d
 	@# comparison block schema: benchmarks/BENCH_SCHEMA.md (perf_gate.py
 	@# validates it). See docs/disaggregation.md.
 	JAX_PLATFORMS=cpu $(PY) benchmarks/disagg_bench.py --json BENCH_disagg.json
+
+loadgen: ## tenant-mix load demo: real proxy+engine, weighted tenant population + mid-run heavy hitter -> /debug/tenants conservation + tenant_flood incident
+	@# Exits nonzero unless >=3 tenants appear at /debug/tenants with
+	@# conserved token totals AND the injected heavy hitter produces a
+	@# tenant_flood incident whose snapshot carries the tenant
+	@# breakdown. Summary under build/tenant-drill/. The fast variant
+	@# runs in tier-1 (tests/test_tenants.py).
+	JAX_PLATFORMS=cpu $(PY) benchmarks/tenant_drill.py
 
 incident-drill: ## e2e incident-black-box smoke: real proxy+engine, injected mid-stream kill, canary detection, persisted incident + rendered report
 	@# Exits nonzero unless an incident lands with >=3 correlated

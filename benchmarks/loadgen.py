@@ -43,14 +43,47 @@ from kubeai_tpu.obs.slo import attainment_block, error_rate_block
 
 
 class ThreadStats:
-    def __init__(self):
+    def __init__(self, tenant: str = ""):
+        self.tenant = tenant  # tenant NAME from --tenant-mix ("" = untagged)
         self.ttfts: list[float] = []
         self.itls: list[float] = []
         self.turn_latencies: list[float] = []
         # Per-turn (decode_time, token_count) for TPOT.
         self.turn_decode: list[tuple[float, int]] = []
         self.output_tokens = 0
+        self.prompt_tokens = 0
+        self.usage_completion_tokens = 0
         self.failures = 0
+
+
+def parse_tenant_mix(spec: str) -> list[tuple[str, float]]:
+    """``"a:8,b:1,c:1"`` -> [("a", 8.0), ("b", 1.0), ("c", 1.0)] — the
+    weighted tenant population --tenant-mix sends traffic as. Each
+    tenant gets a distinct API key (``loadgen-<name>-key``), so the
+    operator's tenant accountant sees distinct hashed ids."""
+    out: list[tuple[str, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        name = name.strip()
+        if not name:
+            raise ValueError(f"bad tenant-mix segment {part!r}")
+        try:
+            weight = float(w) if w else 1.0
+        except ValueError:
+            raise ValueError(f"bad tenant-mix weight in {part!r}")
+        if weight <= 0:
+            raise ValueError(f"tenant-mix weight must be positive: {part!r}")
+        out.append((name, weight))
+    if not out:
+        raise ValueError(f"empty tenant mix {spec!r}")
+    return out
+
+
+def tenant_api_key(name: str) -> str:
+    return f"loadgen-{name}-key"
 
 
 def load_sharegpt(path: str, max_turn_chars: int = 2000) -> list[list[str]]:
@@ -99,7 +132,7 @@ def synthetic_turns(seed: str, turns: int, pad_chars: int = 0) -> list[str]:
     return out
 
 
-def run_conversation(base_url: str, model: str, user_turns: list[str], max_tokens: int, stats: ThreadStats, temperature: float = 0.7):
+def run_conversation(base_url: str, model: str, user_turns: list[str], max_tokens: int, stats: ThreadStats, temperature: float = 0.7, headers: dict | None = None):
     messages: list[dict] = []
     for content in user_turns:
         messages.append({"role": "user", "content": content})
@@ -110,10 +143,14 @@ def run_conversation(base_url: str, model: str, user_turns: list[str], max_token
             "temperature": temperature,
             "stream": True,
         }
+        if stats.tenant:
+            # Exact per-tenant token evidence for the conservation
+            # check: the usage chunk arrives as the final data event.
+            body["stream_options"] = {"include_usage": True}
         req = urllib.request.Request(
             f"{base_url}/v1/chat/completions",
             data=json.dumps(body).encode(),
-            headers={"Content-Type": "application/json"},
+            headers={"Content-Type": "application/json", **(headers or {})},
         )
         t_start = time.monotonic()
         t_first = None
@@ -129,9 +166,17 @@ def run_conversation(base_url: str, model: str, user_turns: list[str], max_token
                     payload = line[6:]
                     if payload == "[DONE]":
                         break
-                    delta = (
-                        json.loads(payload)["choices"][0].get("delta", {}).get("content")
-                    )
+                    obj = json.loads(payload)
+                    usage = obj.get("usage")
+                    if isinstance(usage, dict) and not obj.get("choices"):
+                        # The usage-only terminal chunk (stream_options
+                        # include_usage): exact token evidence per turn.
+                        stats.prompt_tokens += int(usage.get("prompt_tokens") or 0)
+                        stats.usage_completion_tokens += int(
+                            usage.get("completion_tokens") or 0
+                        )
+                        continue
+                    delta = obj["choices"][0].get("delta", {}).get("content")
                     if not delta:
                         continue
                     now = time.monotonic()
@@ -235,18 +280,38 @@ def run_benchmark(
     slo_target: float = 0.95,
     slo_e2e_target: float = 0.99,
     kill_replica_at: float | None = None,
+    tenant_mix: list[tuple[str, float]] | None = None,
+    flood_tenant: str | None = None,
+    flood_at: float | None = None,
+    flood_conversations: int = 0,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy). With
     *kill_replica_at*, one replica's streams are killed that many
     seconds into the run and the summary gains a ``recovery`` block
     (replayed/hedged/error-retried counts from the operator's proxy
-    counters over the run)."""
+    counters over the run).
+
+    *tenant_mix* (see parse_tenant_mix) assigns each conversation a
+    tenant by weight; every request carries that tenant's API key, so
+    the operator's tenant accountant attributes the traffic, and the
+    summary gains a per-tenant block plus the operator's own
+    ``/debug/tenants`` view. *flood_tenant*/*flood_at* arm the
+    heavy-hitter scenario: *flood_conversations* extra conversations,
+    ALL for one tenant, arrive *flood_at* seconds into the run — the
+    ``tenant_flood`` trigger should fire and the summary reports the
+    resulting incident."""
     base = operator_base(base_url)
     retries_before = scrape_retry_counters(base)
     if kill_replica_at is not None:
         schedule_replica_kill(base, kill_replica_at)
     rng = random.Random(seed)
+    names = [n for n, _ in (tenant_mix or [])]
+    weights = [w for _, w in (tenant_mix or [])]
+
+    def pick_tenant() -> str:
+        return rng.choices(names, weights=weights)[0] if names else ""
+
     convo_turns: list[list[str]] = []
     for i in range(conversations):
         if dataset:
@@ -256,19 +321,56 @@ def run_benchmark(
                 synthetic_turns(f"conversation-{i}", turns, pad_chars=prefix_pad_chars)
             )
 
-    stats = [ThreadStats() for _ in range(conversations)]
+    stats = [ThreadStats(tenant=pick_tenant()) for _ in range(conversations)]
     sem = threading.Semaphore(max_concurrency) if max_concurrency > 0 else None
 
-    def run(i):
+    def run_one(st: ThreadStats, turns_i: list[str]):
+        headers = (
+            {"X-API-Key": tenant_api_key(st.tenant)} if st.tenant else None
+        )
         if sem:
             sem.acquire()
         try:
-            run_conversation(base_url, model, convo_turns[i], max_tokens, stats[i], temperature)
+            run_conversation(
+                base_url, model, turns_i, max_tokens, st, temperature,
+                headers=headers,
+            )
         finally:
             if sem:
                 sem.release()
 
-    threads = [threading.Thread(target=run, args=(i,), daemon=True) for i in range(conversations)]
+    threads = [
+        threading.Thread(target=run_one, args=(stats[i], convo_turns[i]), daemon=True)
+        for i in range(conversations)
+    ]
+    # Heavy-hitter arrival mid-run: extra conversations, all one tenant.
+    flood_stats: list[ThreadStats] = []
+    flood_threads: list[threading.Thread] = []
+    flood_spawned = threading.Event()
+    if flood_tenant and flood_at is not None:
+        n_flood = flood_conversations or 2 * conversations
+
+        def launch_flood():
+            time.sleep(flood_at)
+            try:
+                for j in range(n_flood):
+                    st = ThreadStats(tenant=flood_tenant)
+                    flood_stats.append(st)
+                    th = threading.Thread(
+                        target=run_one,
+                        args=(st, synthetic_turns(f"flood-{j}", max(turns // 2, 1))),
+                        daemon=True,
+                    )
+                    # Started BEFORE it becomes joinable: appending
+                    # first would let the main thread join() an
+                    # unstarted thread (RuntimeError).
+                    th.start()
+                    flood_threads.append(th)
+            finally:
+                # Set even on failure so the main join never hangs.
+                flood_spawned.set()
+
+        threading.Thread(target=launch_flood, daemon=True, name="loadgen-flood").start()
     t0 = time.monotonic()
     for i, t in enumerate(threads):
         t.start()
@@ -279,6 +381,15 @@ def run_benchmark(
             time.sleep(rng.expovariate(request_rate))
     for t in threads:
         t.join()
+    if flood_tenant and flood_at is not None:
+        # The launcher sleeps flood_at before spawning; wait until it
+        # has spawned the WHOLE flood (not just the first thread — a
+        # partial snapshot would join some threads while others are
+        # still mutating their ThreadStats), then join every one.
+        flood_spawned.wait(timeout=flood_at + 30.0)
+        for t in flood_threads:
+            t.join()
+        stats = stats + flood_stats
     elapsed = time.monotonic() - t0
 
     ttfts = [x for s in stats for x in s.ttfts]
@@ -312,10 +423,70 @@ def run_benchmark(
         # End scrape failed: emit recovery: null rather than fabricating
         # numbers from a missing sample.
 
+    # Per-tenant client-side summary + the operator's attributed view
+    # (/debug/tenants) and any tenant_flood incident the heavy-hitter
+    # scenario produced. Hashed ids are recomputed client-side so the
+    # two views join without ever shipping the raw keys.
+    tenants_block = None
+    if tenant_mix:
+        from kubeai_tpu.obs.tenants import hash_tenant_key
+
+        per: dict[str, dict] = {}
+        for st in stats:
+            if not st.tenant:
+                continue
+            b = per.setdefault(st.tenant, {
+                "tenant_id": hash_tenant_key(tenant_api_key(st.tenant)),
+                "requests": 0, "failures": 0, "output_tokens": 0,
+                "usage_prompt_tokens": 0, "usage_completion_tokens": 0,
+                "ttfts": [],
+            })
+            b["requests"] += len(st.turn_latencies)
+            b["failures"] += st.failures
+            b["output_tokens"] += st.output_tokens
+            b["usage_prompt_tokens"] += st.prompt_tokens
+            b["usage_completion_tokens"] += st.usage_completion_tokens
+            b["ttfts"].extend(st.ttfts)
+        for name, b in per.items():
+            ttfts_t = b.pop("ttfts")
+            b["ttft_p95_ms"] = (
+                round(pct(ttfts_t, 95) * 1000, 1) if ttfts_t else None
+            )
+        tenants_block = {"mix": dict(tenant_mix), "client": per}
+        try:
+            with urllib.request.urlopen(base + "/debug/tenants", timeout=5) as r:
+                tenants_block["operator"] = json.load(r)
+        except Exception as e:
+            tenants_block["operator"] = {"error": str(e)[:200]}
+        if flood_tenant and flood_at is not None:
+            flood_info = {
+                "tenant": flood_tenant,
+                "tenant_id": hash_tenant_key(tenant_api_key(flood_tenant)),
+                "at_s": flood_at,
+                "conversations": len(flood_stats),
+                "incident": None,
+            }
+            try:
+                with urllib.request.urlopen(
+                    base + "/debug/incidents", timeout=5
+                ) as r:
+                    listing = json.load(r)
+                for inc in listing.get("incidents") or []:
+                    if inc.get("trigger") == "tenant_flood":
+                        flood_info["incident"] = {
+                            "id": inc["id"], "detail": inc.get("detail"),
+                            "sections": inc.get("sections"),
+                        }
+                        break
+            except Exception as e:
+                flood_info["incident_error"] = str(e)[:200]
+            tenants_block["flood"] = flood_info
+
     return {
         "requests": n_requests,
         "failures": failures,
         "recovery": recovery,
+        "tenants": tenants_block,
         "elapsed_s": round(elapsed, 2),
         "req_per_s": round(n_requests / elapsed, 2) if elapsed else 0,
         "output_tok_per_s": round(total_tokens / elapsed, 2) if elapsed else 0,
@@ -382,6 +553,28 @@ def main():
              "block reports replayed/hedged counts",
     )
     parser.add_argument(
+        "--tenant-mix", default=None, metavar="NAME:W,NAME:W",
+        help="weighted tenant population, e.g. 'a:8,b:1,c:1' — each "
+             "conversation is assigned a tenant by weight and sends that "
+             "tenant's API key (X-API-Key: loadgen-<name>-key), so the "
+             "operator's /debug/tenants attributes the traffic; the "
+             "summary gains per-tenant client + operator blocks",
+    )
+    parser.add_argument(
+        "--flood-tenant", default=None, metavar="NAME",
+        help="heavy-hitter scenario: this tenant floods mid-run "
+             "(requires --tenant-mix and --flood-at); the summary "
+             "reports whether the operator's tenant_flood incident fired",
+    )
+    parser.add_argument(
+        "--flood-at", type=float, default=None, metavar="T",
+        help="seconds into the run the flood arrives",
+    )
+    parser.add_argument(
+        "--flood-conversations", type=int, default=0,
+        help="flood size (default 2x --conversations)",
+    )
+    parser.add_argument(
         "--slo-ttft-ms", type=float, default=2000.0,
         help="TTFT SLO objective (ms) for the emitted slo block",
     )
@@ -399,6 +592,13 @@ def main():
              "(matches bench.py / the SLO monitor default)",
     )
     args = parser.parse_args()
+    if args.flood_tenant and not args.tenant_mix:
+        parser.error("--flood-tenant requires --tenant-mix (the summary's "
+                     "tenant/flood readback only exists for a metered mix)")
+    if args.flood_tenant and args.flood_at is None:
+        parser.error("--flood-tenant requires --flood-at (when the flood arrives)")
+    if args.flood_at is not None and not args.flood_tenant:
+        parser.error("--flood-at requires --flood-tenant")
 
     dataset = load_sharegpt(args.dataset) if args.dataset else None
     summary = run_benchmark(
@@ -412,6 +612,10 @@ def main():
         slo_target=args.slo_target,
         slo_e2e_target=args.slo_e2e_target,
         kill_replica_at=args.kill_replica_at,
+        tenant_mix=parse_tenant_mix(args.tenant_mix) if args.tenant_mix else None,
+        flood_tenant=args.flood_tenant,
+        flood_at=args.flood_at,
+        flood_conversations=args.flood_conversations,
     )
     print(json.dumps(summary, indent=1))
 

@@ -1,0 +1,363 @@
+"""Tenant-attribution drill: the acceptance proof for per-tenant
+observability against a REAL serving stack — store → reconciler →
+balancer → proxy/OpenAI server → a real (CPU) engine — driven by
+benchmarks/loadgen.py's ``--tenant-mix`` machinery.
+
+The drill:
+
+1. serves a weighted multi-tenant population (``a:6,b:3,c:1``, each
+   tenant a distinct API key) through the full proxy→engine path;
+2. injects a heavy hitter mid-run (tenant ``hog`` floods with enough
+   conversations to cross ``KUBEAI_TENANT_FLOOD_SHARE`` of the rolling
+   window);
+3. verifies the acceptance bar:
+   - ``/debug/tenants`` reports >= 3 tenants;
+   - **conservation** — the per-tenant completion-token totals sum to
+     the client-observed usage totals AND to the engine's global
+     ``kubeai_engine_generated_tokens_total`` delta over the run
+     (nothing double-counted, nothing dropped);
+   - a ``tenant_flood`` incident landed at ``/debug/incidents`` naming
+     the offending tenant, and its snapshot carries the ``tenants``
+     breakdown section.
+
+Run: ``make loadgen`` (summary under build/tenant-drill/). ``--fast``
+is the tier-1 variant (tests/test_tenants.py runs it). Exit 0 = every
+check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.loadgen import (  # noqa: E402
+    parse_tenant_mix,
+    run_benchmark,
+    tenant_api_key,
+)
+
+from kubeai_tpu.api import model_types as mt  # noqa: E402
+from kubeai_tpu.api.core_types import KIND_POD  # noqa: E402
+from kubeai_tpu.api.model_types import Model, ModelSpec  # noqa: E402
+from kubeai_tpu.config.system import System  # noqa: E402
+from kubeai_tpu.controller.controller import ModelReconciler  # noqa: E402
+from kubeai_tpu.engine.core import EngineConfig, build_test_engine  # noqa: E402
+from kubeai_tpu.engine.sampling import SamplingParams  # noqa: E402
+from kubeai_tpu.engine.server import EngineServer  # noqa: E402
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer  # noqa: E402
+from kubeai_tpu.metrics import default_registry  # noqa: E402
+from kubeai_tpu.obs.incidents import (  # noqa: E402
+    IncidentRecorder,
+    install_recorder,
+    standard_sources,
+    uninstall_recorder,
+)
+from kubeai_tpu.obs.tenants import default_accountant, hash_tenant_key  # noqa: E402
+from kubeai_tpu.proxy.handler import ModelProxy  # noqa: E402
+from kubeai_tpu.proxy.modelclient import ModelClient  # noqa: E402
+from kubeai_tpu.proxy.server import OpenAIServer  # noqa: E402
+from kubeai_tpu.runtime.store import ObjectMeta, Store  # noqa: E402
+
+MODEL = "tenant-drill-model"
+
+
+class _AlwaysLeader:
+    def __init__(self):
+        self.is_leader = threading.Event()
+        self.is_leader.set()
+
+
+def _await(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out awaiting {msg}")
+
+
+def run(fast: bool = False, verbose: bool = True) -> dict:
+    """Execute the drill; returns the summary dict. Raises
+    AssertionError on a failed acceptance check."""
+    t_start = time.monotonic()
+    acct = default_accountant
+    saved = (acct.window, acct.interval, acct.flood_min, acct.flood_share)
+    acct.reset()
+    acct.window = 12.0
+    acct.interval = 0.4
+    acct.flood_min = 8.0
+    acct.flood_share = 0.5
+
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=30)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    recorder = IncidentRecorder(
+        sources=standard_sources(lb, mc),
+        incident_dir=os.path.join("build", "tenant-drill", "incidents"),
+        debounce_seconds=2.0,
+        election=_AlwaysLeader(),
+    )
+    install_recorder(recorder)
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(
+            max_slots=4, max_seq_len=512, prefill_buckets=(32, 64, 128),
+            max_queue=64, decode_chunk=2,
+        )
+    )
+    srv = EngineServer(eng, MODEL, host="127.0.0.1", port=0)
+    srv.start()
+    summary: dict = {"fast": fast}
+    try:
+        # Warm the compile cache OUTSIDE the metered run (un-attributed
+        # direct submit: records no tenant cost by design).
+        eng.generate(
+            eng.tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=180,
+        )
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name=MODEL),
+                spec=ModelSpec(
+                    url="hf://drill/model", resource_profile="cpu:1",
+                    replicas=1, min_replicas=1,
+                ),
+            ),
+        )
+        _await(
+            lambda: len(store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})) == 1,
+            msg="model pod",
+        )
+        [pod] = store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})
+
+        def forge(p):
+            p.status.ready = True
+            p.status.pod_ip = "127.0.0.1"
+            p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+            p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(srv.port)
+
+        store.mutate(KIND_POD, pod.meta.name, forge)
+        _await(lambda: lb.get_all_addresses(MODEL), msg="endpoint")
+
+        gen_before = default_registry.get(
+            "kubeai_engine_generated_tokens_total"
+        ).value()
+
+        # -- the metered run: weighted mix + mid-run heavy hitter ----------
+        convs = 4 if fast else 8
+        flood_convs = 12 if fast else 24
+        bench = run_benchmark(
+            f"http://127.0.0.1:{api.port}/openai",
+            MODEL,
+            conversations=convs,
+            turns=2,
+            max_tokens=6,
+            temperature=0.0,
+            tenant_mix=parse_tenant_mix("a:6,b:3,c:1"),
+            flood_tenant="hog",
+            flood_at=0.5,
+            flood_conversations=flood_convs,
+        )
+        assert bench["failures"] == 0, f"load run had failures: {bench['failures']}"
+        # Generated-token delta captured NOW: the canary probe below is
+        # excluded from accounting but still generates tokens.
+        gen_delta = default_registry.get(
+            "kubeai_engine_generated_tokens_total"
+        ).value() - gen_before
+        summary["load"] = {
+            "requests": bench["requests"],
+            "req_per_s": bench["req_per_s"],
+            "client_tenants": bench["tenants"]["client"],
+        }
+
+        # Let the accountant tick past the flood and the capture land.
+        deadline = time.monotonic() + (6 if fast else 12)
+        while time.monotonic() < deadline:
+            acct.tick()
+            if any(
+                i["trigger"] == "tenant_flood" for i in recorder.snapshot()
+            ):
+                break
+            time.sleep(0.3)
+        recorder.wait_idle(timeout=15)
+
+        # -- check 1: /debug/tenants reports the population ----------------
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/debug/tenants", timeout=10
+        ) as r:
+            view = json.load(r)
+        rows = {row["tenant"]: row for row in view["tenants"]}
+        assert len(rows) >= 3, f"expected >=3 tenants, got {sorted(rows)}"
+
+        # -- check 2: conservation ------------------------------------------
+        client = dict(bench["tenants"]["client"])
+        client_completion = sum(
+            b["usage_completion_tokens"] for b in client.values()
+        )
+        client_prompt = sum(b["usage_prompt_tokens"] for b in client.values())
+        totals = acct.totals()
+        assert totals["completion_tokens"] == client_completion, (
+            f"completion tokens not conserved: accountant "
+            f"{totals['completion_tokens']} != client-observed {client_completion}"
+        )
+        assert totals["prompt_tokens"] == client_prompt, (
+            f"prompt tokens not conserved: accountant "
+            f"{totals['prompt_tokens']} != client-observed {client_prompt}"
+        )
+        # Per-tenant: the operator's view joins the client's on hashed id.
+        for name, b in client.items():
+            row = rows.get(b["tenant_id"])
+            assert row is not None, f"tenant {name} ({b['tenant_id']}) missing from /debug/tenants"
+            assert row["tokens"]["completion"] == b["usage_completion_tokens"], (
+                f"tenant {name}: operator says {row['tokens']['completion']} "
+                f"completion tokens, client observed {b['usage_completion_tokens']}"
+            )
+        # Global: the engine generated exactly what the tenants were billed.
+        assert gen_delta == totals["completion_tokens"], (
+            f"engine generated {gen_delta} tokens but tenants account for "
+            f"{totals['completion_tokens']}"
+        )
+        # Cost proxies flowed from the scheduler.
+        assert totals["slot_seconds"] > 0 and totals["kv_page_seconds"] > 0, (
+            "no engine-side cost attribution recorded"
+        )
+        summary["conservation"] = {
+            "completion_tokens": totals["completion_tokens"],
+            "prompt_tokens": totals["prompt_tokens"],
+            "engine_generated_delta": gen_delta,
+            "slot_seconds": round(totals["slot_seconds"], 3),
+            "kv_page_seconds": round(totals["kv_page_seconds"], 3),
+        }
+
+        # -- check 3: the heavy hitter produced a tenant_flood incident ----
+        hog_id = hash_tenant_key(tenant_api_key("hog"))
+        floods = [
+            i for i in recorder.snapshot() if i["trigger"] == "tenant_flood"
+        ]
+        assert floods, "no tenant_flood incident captured"
+        flood = floods[0]
+        assert flood["detail"].get("tenant") == hog_id, (
+            f"flood incident names {flood['detail'].get('tenant')}, "
+            f"expected the hog ({hog_id})"
+        )
+        doc = recorder.get(flood["id"])
+        assert "tenants" in doc["sections"], (
+            "flood incident snapshot lacks the tenants breakdown section"
+        )
+        sec_rows = {
+            r["tenant"]: r for r in doc["sections"]["tenants"].get("tenants", [])
+        }
+        assert hog_id in sec_rows, "snapshot tenants section lacks the hog"
+        summary["flood"] = {
+            "incident_id": flood["id"],
+            "tenant": hog_id,
+            "share": flood["detail"].get("share"),
+            "window_requests": flood["detail"].get("window_requests"),
+            "sections_ok": doc["sections_ok"],
+        }
+
+        # -- check 4: canary exclusion (after conservation — the spoof
+        # request below is deliberately metered) ---------------------------
+        # A probe marked IN PROCESS (the prober calls proxy.handle
+        # directly, exactly like obs/canary.py) must not move tenant
+        # accounting — requests, tokens, or engine-side cost.
+        probe_body = json.dumps({
+            "model": MODEL, "prompt": "canary probe", "max_tokens": 4,
+            "temperature": 0,
+        }).encode()
+        before = acct.totals()
+        excluded_before = acct.report()["canary_excluded"]
+        result = proxy.handle(
+            probe_body, "/openai/v1/completions",
+            {"Content-Type": "application/json", "X-KubeAI-Canary": "1"},
+        )
+        for _ in result.body_iter:
+            pass
+        after = acct.totals()
+        assert after["requests"] == before["requests"], (
+            "canary-marked probe was counted as tenant traffic"
+        )
+        assert after["slot_seconds"] == before["slot_seconds"], (
+            "canary-marked probe accrued engine-side tenant cost"
+        )
+        assert acct.report()["canary_excluded"] > excluded_before
+        # ...but an EXTERNAL client carrying the marker cannot opt out:
+        # the HTTP boundary strips it, so the request is metered.
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{api.port}/openai/v1/completions",
+            data=probe_body,
+            headers={
+                "Content-Type": "application/json",
+                "X-KubeAI-Canary": "1",
+                "X-API-Key": tenant_api_key("a"),
+            },
+        )
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            r.read()
+        assert acct.totals()["requests"] == after["requests"] + 1, (
+            "external client opted out of metering via a spoofed canary marker"
+        )
+        summary["canary_excluded"] = True
+        summary["ok"] = True
+        summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
+        if verbose:
+            hot = max(
+                view["tenants"], key=lambda r: r["requests"]["total"]
+            )
+            print(
+                f"tenant drill: {len(rows)} tenants, "
+                f"{totals['completion_tokens']} completion tokens conserved, "
+                f"hitter {hot['tenant']} share={hot['share']}, "
+                f"incident {flood['id']}"
+            )
+        return summary
+    finally:
+        uninstall_recorder(recorder)
+        recorder.stop()
+        srv.stop()
+        api.stop()
+        lb.stop()
+        rec.stop()
+        acct.stop()
+        acct.reset()
+        acct.window, acct.interval, acct.flood_min, acct.flood_share = saved
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("tenant-drill")
+    parser.add_argument("--fast", action="store_true", help="tier-1 variant: smaller population")
+    parser.add_argument("--json", default=os.path.join("build", "tenant-drill", "summary.json"))
+    args = parser.parse_args(argv)
+    try:
+        summary = run(fast=args.fast)
+    except AssertionError as e:
+        print(f"TENANT DRILL FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -56,6 +56,7 @@ from kubeai_tpu.models import llama
 from kubeai_tpu.models.base import ModelConfig
 from kubeai_tpu.obs import default_recorder
 from kubeai_tpu.obs import perf as perf_obs
+from kubeai_tpu.obs.tenants import default_accountant as tenant_accountant
 from kubeai_tpu.obs.recorder import (
     register_engine_debug_section,
     unregister_engine_debug_section,
@@ -215,6 +216,11 @@ class Request:
     # request concurrently — submit()'s shutdown race vs _fail_inflight —
     # must not double-count metrics or double-decrement _in_system.
     finished: bool = False
+    # Hashed tenant id (X-KubeAI-Tenant from the proxy): the scheduler
+    # attributes this request's slot/page-seconds to it at release.
+    # Empty = un-attributed (direct submits, canary probes) — no cost
+    # accounting, by design.
+    tenant: str = ""
 
 
 @dataclass
@@ -226,6 +232,10 @@ class _Slot:
     committed_text: str = ""  # decodable text so far (incomplete UTF-8 held back)
     delivered_chars: int = 0  # prefix of committed_text already sent to client
     budget: int = 0  # max new tokens for this request
+    # Slot admission instant: the base of the per-tenant cost proxies
+    # (slot-seconds held, x pages reserved = KV-page-seconds) recorded
+    # once at release (obs/tenants.py).
+    admitted_at: float = field(default_factory=time.monotonic)
 
     @property
     def holdback(self) -> int:
@@ -882,6 +892,7 @@ class Engine:
                     slot.req, "error",
                     error=message, completion_tokens=slot.generated,
                 )
+                self._record_slot_cost(slot, i)
                 self._release_slot_pages(i)
         self._n_active = 0
         self._h_active[:] = False
@@ -955,6 +966,7 @@ class Engine:
         adapter: str | None = None,
         trace_ctx: TraceContext | None = None,
         deadline: float | None = None,
+        tenant: str = "",
     ) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
         retries another replica, and the server maps it to 429 +
@@ -982,12 +994,16 @@ class Engine:
             raise RuntimeError("engine is not running")
         req = Request(
             prompt_ids=prompt_ids, params=params, adapter=adapter,
-            deadline=deadline,
+            deadline=deadline, tenant=tenant,
         )
         req.trace = RequestTrace(
             ctx=trace_ctx, component="engine", t0_mono=req.arrival
         )
         req.trace.attrs["prompt_tokens"] = len(prompt_ids)
+        if tenant:
+            # Tenant-filterable flight-recorder timelines (the proxy
+            # stamps its span the same way).
+            req.trace.attrs["tenant"] = tenant
         with self._in_system_lock:
             self._in_system += 1
         try:
@@ -1928,6 +1944,23 @@ class Engine:
         self._slot_pages[slot_idx] = []
         self._page_table[slot_idx, :] = 0
 
+    def _record_slot_cost(self, slot: "_Slot", slot_idx: int) -> None:
+        """Per-tenant cost proxies, recorded ONCE per request at slot
+        release (before the page row is cleared): slot-seconds = wall
+        time the decode slot was held, KV-page-seconds = that time x
+        the pages _plan_admission reserved. These price what the
+        request actually occupied on the device — a short prompt that
+        sat decoding for a minute costs more than a long prompt that
+        finished fast, which token counts alone cannot express.
+        Un-attributed requests (no X-KubeAI-Tenant: direct submits,
+        canary probes) record nothing. Scheduler-thread cheap: one
+        monotonic read + one locked dict update in the accountant."""
+        if not slot.req.tenant:
+            return
+        held = max(time.monotonic() - slot.admitted_at, 0.0)
+        pages = len(self._slot_pages[slot_idx])
+        tenant_accountant.record_cost(slot.req.tenant, held, held * pages)
+
     def _bucket(self, n: int) -> int:
         for b in self.cfg.prefill_buckets:
             if n <= b:
@@ -2455,6 +2488,7 @@ class Engine:
         # Host-side only: the next dispatch uploads active=False; any
         # in-flight chunk's stale writes clamp to the trash page.
         self._h_active[slot_idx] = False
+        self._record_slot_cost(slot, slot_idx)
         self._release_slot_pages(slot_idx, register=True)
         if deliver:
             if flush:

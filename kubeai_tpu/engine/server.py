@@ -37,12 +37,15 @@ from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.faults import FaultError, fault, handle_faults_request
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs import (
+    debug_index_response,
     extract_context,
     handle_canary_request,
     handle_debug_request,
     handle_incident_request,
+    handle_tenant_request,
 )
 from kubeai_tpu.obs.perf import handle_perf_request
+from kubeai_tpu.obs.tenants import TENANT_HEADER, sanitize_tenant
 
 log = logging.getLogger("kubeai_tpu.engine.server")
 
@@ -341,6 +344,13 @@ def _make_handler(srv: EngineServer):
                     self._json(200, ready)
                 else:
                     self._json(503, {"status": "engine not ready", "model": srv.model_name})
+            elif path in ("/debug", "/debug/"):
+                code, ctype, body = debug_index_response("engine")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path.startswith("/debug/"):
                 # Perf X-ray routes get the live engine (stall window,
                 # gang profile fan-out); the shared recorder routes and
@@ -354,6 +364,9 @@ def _make_handler(srv: EngineServer):
                     # one globally, and the route must exist either way.
                     or handle_incident_request(path, query)
                     or handle_canary_request(path, query)
+                    # An engine process's accountant carries its own
+                    # cost accumulations (slot/page-seconds by tenant).
+                    or handle_tenant_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:
@@ -418,6 +431,11 @@ def _make_handler(srv: EngineServer):
             # is to regenerate identically (prompt prefill may hit the
             # shared-prefix cache); the hint is surfaced for logs and
             # the flight recorder.
+            # Tenant attribution: the proxy's internal header carries
+            # the HASHED tenant id (never a raw credential); the
+            # scheduler prices the request's slot/page-seconds to it.
+            # Absent (direct clients, canary probes) = un-attributed.
+            tenant = sanitize_tenant(self.headers.get(TENANT_HEADER, ""))
             resume_tokens = 0
             rt_hdr = self.headers.get("X-Resume-Tokens", "")
             if rt_hdr:
@@ -458,12 +476,12 @@ def _make_handler(srv: EngineServer):
                 if path == "/v1/completions":
                     self._completions(
                         body, chat=False, trace_ctx=trace_ctx, deadline=deadline,
-                        resume_tokens=resume_tokens,
+                        resume_tokens=resume_tokens, tenant=tenant,
                     )
                 elif path == "/v1/chat/completions":
                     self._completions(
                         body, chat=True, trace_ctx=trace_ctx, deadline=deadline,
-                        resume_tokens=resume_tokens,
+                        resume_tokens=resume_tokens, tenant=tenant,
                     )
                 elif path == "/v1/embeddings":
                     self._embeddings(body)
@@ -556,7 +574,7 @@ def _make_handler(srv: EngineServer):
                 return None, None
             return prompt, None
 
-        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None, resume_tokens=0):
+        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None, resume_tokens=0, tenant=""):
             tok = srv.engine.tokenizer
             prompt_ids = None
             if chat:
@@ -725,7 +743,7 @@ def _make_handler(srv: EngineServer):
                     # one child span per choice.
                     r = srv.engine.submit(
                         prompt_ids, p_i, adapter=adapter, trace_ctx=trace_ctx,
-                        deadline=deadline,
+                        deadline=deadline, tenant=tenant,
                     )
                     if r.trace is not None:
                         r.trace.model = srv.model_name
@@ -765,6 +783,7 @@ def _make_handler(srv: EngineServer):
                     reqs, rid, created, chat, want_logprobs, echo_text, top_n,
                     include_usage=bool(so.get("include_usage")),
                     handoff_cap=handoff_cap,
+                    prompt_tokens_hint=len(prompt_ids),
                 )
             else:
                 self._full_response(
@@ -884,7 +903,7 @@ def _make_handler(srv: EngineServer):
                 "model": srv.model_name, "choices": choices, "usage": usage,
             })
 
-        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0, include_usage=False, handoff_cap=False):
+        def _stream_response(self, reqs, rid, created, chat, want_logprobs=False, echo_text="", top_n=0, include_usage=False, handoff_cap=False, prompt_tokens_hint=0):
             self.send_response(200)
             self.send_header("Content-Type", "text/event-stream")
             self.send_header("Cache-Control", "no-cache")
@@ -943,6 +962,26 @@ def _make_handler(srv: EngineServer):
             remaining = len(reqs)
             prompt_tokens = 0
             completion_tokens = 0
+            # Tokens emitted per still-running choice: the best-effort
+            # usage a terminal ERROR path reports (OpenAI semantics —
+            # include_usage promises a usage block on EVERY terminal
+            # path, and billing/metering consumers need the partial
+            # counts of a cancelled/errored/deadline-aborted stream,
+            # not silence).
+            emitted_live: dict[int, int] = {}
+
+            def usage_chunk() -> str:
+                live = sum(emitted_live.values())
+                return json.dumps({
+                    "id": rid, "object": obj, "created": created,
+                    "model": srv.model_name, "choices": [],
+                    "usage": {
+                        "prompt_tokens": prompt_tokens or prompt_tokens_hint,
+                        "completion_tokens": completion_tokens + live,
+                        "total_tokens": (prompt_tokens or prompt_tokens_hint)
+                        + completion_tokens + live,
+                    },
+                })
             # Budget-capped streams hold the detokenizer's text-only
             # tail flush (ev token id -1) until the finish reason is
             # known: a handoff finish must NOT emit it — the decode
@@ -978,6 +1017,11 @@ def _make_handler(srv: EngineServer):
                     else:
                         idx, ev = merged.get()
                     if ev[0] == "token":
+                        if ev[1] >= 0:
+                            # Counted BEFORE the empty-delta skip: a
+                            # held-back token is still an emitted token
+                            # for the error-path usage block.
+                            emitted_live[idx] = emitted_live.get(idx, 0) + 1
                         has_lp = (
                             want_logprobs and ev[1] >= 0 and len(ev) > 3
                             and ev[3] is not None
@@ -1016,6 +1060,7 @@ def _make_handler(srv: EngineServer):
                         remaining -= 1
                         prompt_tokens = fin.prompt_tokens
                         completion_tokens += fin.completion_tokens
+                        emitted_live.pop(idx, None)  # exact count landed
                         # Budget-capped prefill finish: "length" here
                         # means "the handoff budget ran out", not "the
                         # client's max_tokens ran out" — the proxy keys
@@ -1052,15 +1097,7 @@ def _make_handler(srv: EngineServer):
                             # OpenAI stream_options semantics: usage
                             # arrives as its own final chunk with EMPTY
                             # choices (SDK consumers key on that shape).
-                            send_chunk(json.dumps({
-                                "id": rid, "object": obj, "created": created,
-                                "model": srv.model_name, "choices": [],
-                                "usage": {
-                                    "prompt_tokens": prompt_tokens,
-                                    "completion_tokens": completion_tokens,
-                                    "total_tokens": prompt_tokens + completion_tokens,
-                                },
-                            }))
+                            send_chunk(usage_chunk())
                         if remaining == 0:
                             send_chunk("[DONE]")
                             self.wfile.write(b"0\r\n\r\n")
@@ -1068,6 +1105,14 @@ def _make_handler(srv: EngineServer):
                             return
                     else:
                         _cancel_all(reqs)
+                        if include_usage:
+                            # Terminal-path contract: errored/deadline-
+                            # aborted/timed-out streams deliver a best-
+                            # effort usage block too — the old code only
+                            # emitted it at remaining == 0, so every
+                            # non-ok stream ended usage-less and its
+                            # tokens were unbillable.
+                            send_chunk(usage_chunk())
                         send_chunk(json.dumps({"error": {"message": ev[1]}}))
                         self.wfile.write(b"0\r\n\r\n")
                         return

@@ -23,8 +23,15 @@ from kubeai_tpu.obs.incidents import (
 from kubeai_tpu.obs.recorder import (
     DEBUG_PATHS,
     FlightRecorder,
+    debug_index_response,
     default_recorder,
     handle_debug_request,
+)
+from kubeai_tpu.obs.tenants import (
+    TenantAccountant,
+    default_accountant,
+    extract_tenant,
+    handle_tenant_request,
 )
 from kubeai_tpu.obs.slo import (
     SLObjective,
@@ -54,8 +61,13 @@ __all__ = [
     "uninstall_recorder",
     "DEBUG_PATHS",
     "FlightRecorder",
+    "debug_index_response",
     "default_recorder",
     "handle_debug_request",
+    "TenantAccountant",
+    "default_accountant",
+    "extract_tenant",
+    "handle_tenant_request",
     "SLObjective",
     "SLOMonitor",
     "attainment_block",

@@ -184,6 +184,10 @@ class CanaryProber:
         headers = {
             "Content-Type": "application/json",
             "X-Request-ID": f"canary-{model_name}-{n}",
+            # Tenant-accounting exclusion marker: synthetic probes must
+            # not skew per-tenant shares or trip the flood trigger
+            # (obs/tenants.py skips canary-marked requests end to end).
+            "X-KubeAI-Canary": "1",
             # One bounded budget across await/connect/stream: a hung
             # engine becomes a probe ERROR, not a hung prober thread.
             "X-Request-Timeout": f"{self.timeout:.3f}",

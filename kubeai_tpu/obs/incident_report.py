@@ -169,6 +169,29 @@ def _fleet_entries(section: dict, captured_at: float) -> list:
     return out
 
 
+def _tenant_entries(section: dict, captured_at: float, limit: int = 6) -> list:
+    """Heavy-hitter-ranked tenant breakdown at capture time: who was
+    driving the traffic when the trigger fired (a tenant_flood names
+    the hitter; any other trigger gets the context for free)."""
+    out = []
+    for row in (section.get("tenants") or [])[:limit]:
+        req = row.get("requests") or {}
+        tok = row.get("tokens") or {}
+        cost = row.get("cost") or {}
+        lat = row.get("latency") or {}
+        line = (
+            f"#{row.get('rank')} {row.get('tenant')}: share={row.get('share')}"
+            f" window_req={req.get('window')} ({req.get('per_second')}/s)"
+            f" tokens={tok.get('prompt')}p/{tok.get('completion')}c"
+        )
+        if lat.get("ttft_attainment") is not None:
+            line += f" ttft_att={lat['ttft_attainment']:.3f}"
+        if cost.get("kv_page_seconds"):
+            line += f" kv_page_s={cost['kv_page_seconds']}"
+        out.append(_entry(captured_at, "tenant", line))
+    return out
+
+
 def _routing_entries(section: dict, captured_at: float) -> list:
     out = []
     for model, snap in sorted(section.items()):
@@ -229,6 +252,7 @@ def render_incident(doc: dict) -> str:
         "engines": lambda s: _engine_entries(s, t0),
         "fleet": lambda s: _fleet_entries(s, t0),
         "routing": lambda s: _routing_entries(s, t0),
+        "tenants": lambda s: _tenant_entries(s, t0),
     }
     for name, fn in handlers.items():
         sec = sections.get(name)

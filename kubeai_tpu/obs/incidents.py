@@ -36,7 +36,10 @@ Trigger sources wired in-tree (grep ``publish_trigger(`` for ground
 truth): ``slo_burn`` (obs/slo.py burn-rate crossing), ``breaker_ejection``
 (loadbalancer/group.py), ``autoscaler_clamp`` / ``autoscaler_hold``
 (autoscaler decision outcomes), ``canary_error`` / ``canary_corrupt``
-(obs/canary.py), and this module's own counter watch: ``crash_loop``
+(obs/canary.py), ``tenant_flood`` (obs/tenants.py heavy-hitter
+detection — one tenant's rolling-window request share crossed
+``KUBEAI_TENANT_FLOOD_SHARE``), and this module's own counter watch:
+``crash_loop``
 (kubeai_pod_restarts_total), ``gang_reform`` (kubeai_gang_reforms_total,
 local + fleet-scraped), ``error_spike`` / ``deadline_spike``
 (kubeai_engine_requests_total outcome deltas).
@@ -817,6 +820,7 @@ def standard_sources(
     so the captured sections can't drift between them. Every source is
     a zero-arg callable evaluated at capture time."""
     from kubeai_tpu.obs.recorder import default_recorder
+    from kubeai_tpu.obs.tenants import default_accountant
 
     def model_names() -> list[str]:
         return [m.meta.name for m in model_client.list_all_models()]
@@ -829,6 +833,10 @@ def standard_sources(
         "engines": engine_debug_source(
             lambda: {m: lb.get_all_addresses(m) for m in model_names()}
         ),
+        # Tenant attribution rides EVERY incident: a tenant_flood
+        # capture names the hitter, and any other trigger's snapshot
+        # shows who was driving the traffic when it fired.
+        "tenants": default_accountant.report,
     }
     if hasattr(lb, "routing_snapshot"):
         sources["routing"] = lb.routing_snapshot

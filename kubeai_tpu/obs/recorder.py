@@ -307,12 +307,23 @@ def handle_debug_request(
     if path == "/debug/requests":
         limit = intq("limit", 50)
         wanted = (q.get("id") or [None])[0]
-        tls = rec.snapshot(limit=None if wanted else limit)
+        tenant = (q.get("tenant") or [None])[0]
+        tls = rec.snapshot(limit=None if (wanted or tenant) else limit)
         if wanted:
             tls = [
                 t for t in tls
                 if wanted in (t.get("trace_id"), t.get("request_id"))
-            ][:limit]
+            ]
+        if tenant:
+            # Tenant-attributed timelines (the proxy/engine stamp the
+            # hashed tenant id into span attrs): one tenant's requests
+            # isolated from the ring in one GET.
+            tls = [
+                t for t in tls
+                if (t.get("attrs") or {}).get("tenant") == tenant
+            ]
+        if wanted or tenant:
+            tls = tls[:limit]
         body = json.dumps({"requests": tls}).encode()
         return 200, "application/json", body
     if path == "/debug/engine":
@@ -331,3 +342,58 @@ def handle_debug_request(
         body = json.dumps(rec.chrome_trace(intq("limit", 200))).encode()
         return 200, "application/json", body
     return None
+
+
+# ---------------------------------------------------------------------------
+# The /debug index: one GET listing every debug surface a server mounts
+# with a one-line description — ten-plus endpoints exist and were only
+# discoverable via docs. Keyed by which server ("operator" | "engine")
+# serves each route; descriptions stay one line by contract (the full
+# story lives in docs/observability.md).
+
+DEBUG_INDEX: tuple[tuple[str, str, str], ...] = (
+    ("/debug/requests", "both",
+     "completed request timelines, most recent first (?limit=&id=&tenant=)"),
+    ("/debug/engine", "both",
+     "last scheduler step records: batch composition, tokens, kernel, KV pages (?limit=)"),
+    ("/debug/trace", "both",
+     "Chrome trace-event JSON for Perfetto: request lanes + scheduler lane (?limit=)"),
+    ("/debug/faults", "both",
+     "fault-injection failpoints: list armed faults; arm/disarm via ?set=/?clear= (gated by KUBEAI_DEBUG_FAULTS)"),
+    ("/debug/incidents", "both",
+     "incident black box: triggered cross-layer snapshots (?id= for the full document; operator-side)"),
+    ("/debug/canary", "both",
+     "synthetic canary prober state per model (operator-side)"),
+    ("/debug/tenants", "both",
+     "per-tenant usage metering: rolling-window share, tokens, latency attainment, cost proxies, heavy-hitter ranking"),
+    ("/debug/endpoints", "operator",
+     "per-model circuit-breaker view: endpoint states, consecutive failures, in-flight"),
+    ("/debug/routing", "operator",
+     "CHWBL ring snapshot + recent pick distribution per model"),
+    ("/debug/autoscaler", "operator",
+     "scaling decision audit: one record per tick per model/pool (?limit=&model=)"),
+    ("/debug/fleet", "operator",
+     "fleet saturation: per-endpoint engine scrapes, per-model aggregates, capacity headroom"),
+    ("/debug/slo", "operator",
+     "SLO monitor report: attainment + burn rate per objective over the rolling window"),
+    ("/debug/pipeline", "engine",
+     "windowed decode stall attribution (dispatch/host_overlap/fetch_wait/emit) + live MFU/roofline"),
+    ("/debug/profile", "engine",
+     "on-demand jax.profiler device trace (?seconds=; gated by KUBEAI_DEBUG_PROFILE)"),
+)
+
+
+def debug_index_response(server: str) -> tuple[int, str, bytes]:
+    """The ``GET /debug`` payload for one server kind ("operator" |
+    "engine"): every route it mounts, with descriptions."""
+    endpoints = [
+        {"path": p, "description": desc}
+        for p, kind, desc in DEBUG_INDEX
+        if kind in ("both", server)
+    ]
+    body = json.dumps({
+        "server": server,
+        "endpoints": endpoints,
+        "docs": "docs/observability.md",
+    }).encode()
+    return 200, "application/json", body

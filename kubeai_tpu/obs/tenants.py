@@ -1,0 +1,857 @@
+"""Tenant-attributed observability: per-tenant usage metering, cost
+attribution, and heavy-hitter detection.
+
+The stack was tenant-blind: every trace, histogram, SLO window, and
+incident snapshot aggregated over all callers, so one tenant's burst
+starving everyone's TTFT was invisible as anything but a global SLO
+burn. This module is the attribution seam the multi-tenant QoS roadmap
+item hangs on — pure observability, so the enforcement arm (priority
+lanes, preemption) can land later against measured per-tenant data.
+
+Three pieces, all dependency-free:
+
+- **Identity** (`extract_tenant`) — the proxy derives a tenant id from
+  the request's credentials (``Authorization: Bearer`` or
+  ``X-API-Key``), **hashed** (sha256 prefix) so the raw key never
+  reaches a log line, metric label, or debug payload; absent
+  credentials map to ``anonymous``. The hash is unsalted by design:
+  the same key must map to the same tenant id across operator
+  restarts and replicas (dashboards and incident timelines join on
+  it). The id rides the internal ``X-KubeAI-Tenant`` header
+  proxy→engine; inbound copies from outside are stripped — a client
+  cannot choose its own attribution bucket.
+
+- **Metering** (`TenantAccountant`) — a bounded **top-K space-saving
+  sketch**: at most *topk* tenants are tracked exactly; when a new
+  tenant arrives at capacity, the smallest-weight tracked tenant is
+  **folded into the ``__other__`` overflow bucket** (its metric series
+  removed, its accumulations added to ``__other__``'s — global sums
+  conserve across evictions) and the newcomer inherits its sketch
+  weight (classic space-saving, so persistent heavy hitters can never
+  be displaced by a long tail of one-shot keys). Metric cardinality is
+  therefore **fixed at topk + 2** (``anonymous`` and ``__other__`` are
+  permanent residents) no matter how many API keys exist. Everything
+  carrying a ``tenant`` label is registered HERE and only here —
+  tests/test_metrics_lint.py AST-enforces that, so an unbounded-
+  cardinality tenant label can't sneak in later.
+
+- **Detection** — a rolling window (snapshot-differencing, the SLO
+  monitor's discipline: no hot-path instrumentation beyond one dict
+  update per request) yields per-tenant request share, req/s, token
+  share, p95 e2e, and TTFT/e2e attainment. A tenant whose window
+  share reaches ``KUBEAI_TENANT_FLOOD_SHARE`` (default 0.5) with at
+  least ``KUBEAI_TENANT_FLOOD_MIN`` window requests publishes a
+  ``tenant_flood`` trigger onto the PR 9 incident bus — the black box
+  captures a correlated snapshot *naming the offending tenant*, and
+  ``/debug/tenants`` is a standard snapshot source so every incident
+  carries the tenant breakdown.
+
+Cost proxies: the engine scheduler (engine/core.py) calls
+``record_cost`` once per request at slot release with the slot-seconds
+(wall time the request held a decode slot) and KV-page-seconds
+(slot-seconds × pages reserved) it consumed — the two quantities that
+actually price a request on the device, independent of token counts.
+
+Canary probes (obs/canary.py, marked with ``X-KubeAI-Canary``) are
+excluded from all accounting so synthetic traffic can't skew shares.
+
+Surface: ``GET /debug/tenants`` on both HTTP servers (the operator's
+carries request/token data; an engine process's carries its cost
+accumulations). Knobs: ``KUBEAI_TENANT_TOPK`` (32),
+``KUBEAI_TENANT_WINDOW_SECONDS`` (60), ``KUBEAI_TENANT_FLOOD_SHARE``
+(0.5), ``KUBEAI_TENANT_FLOOD_MIN`` (20).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import re
+import threading
+import time
+from bisect import bisect_left
+from collections import deque
+
+from kubeai_tpu.metrics.registry import default_registry
+from kubeai_tpu.obs.incidents import publish_trigger
+from kubeai_tpu.utils import env_float
+
+# Internal hop header carrying the (already hashed) tenant id
+# proxy→engine; inbound copies from outside the mesh are stripped.
+TENANT_HEADER = "X-KubeAI-Tenant"
+# Trusted marker the canary prober stamps on its probes so synthetic
+# traffic is excluded from tenant accounting end to end.
+CANARY_HEADER = "X-KubeAI-Canary"
+ANONYMOUS = "anonymous"
+OTHER = "__other__"
+
+# Tenant ids land in metric labels and debug payloads: safe charset,
+# bounded length (hashes are 16 hex chars; ANONYMOUS/OTHER fit too).
+_TENANT_RE = re.compile(r"[^A-Za-z0-9._\-]")
+
+
+def sanitize_tenant(t: str) -> str:
+    return _TENANT_RE.sub("", str(t))[:64]
+
+
+def hash_tenant_key(raw: str) -> str:
+    """Stable (restart- and replica-independent) tenant id from a raw
+    credential. sha256 prefix: irreversible, collision-safe at any
+    realistic key population, and NEVER logged raw."""
+    return hashlib.sha256(raw.encode()).hexdigest()[:16]
+
+
+def extract_tenant(headers) -> str:
+    """Tenant id from inbound request credentials (case-insensitive
+    header match): ``Authorization: Bearer <key>`` wins, then
+    ``X-API-Key``; no credential = ``anonymous``. Only the HASH of the
+    credential escapes this function."""
+    auth = api_key = ""
+    for k in headers:
+        lk = k.lower()
+        if lk == "authorization" and not auth:
+            auth = str(headers[k])
+        elif lk == "x-api-key" and not api_key:
+            api_key = str(headers[k])
+    if auth:
+        scheme, _, token = auth.partition(" ")
+        if scheme.lower() == "bearer" and token.strip():
+            return hash_tenant_key(token.strip())
+    if api_key.strip():
+        return hash_tenant_key(api_key.strip())
+    return ANONYMOUS
+
+
+# ---------------------------------------------------------------------------
+# Metrics. EVERY metric carrying a `tenant` label is registered in this
+# module and written only by TenantAccountant under its lock — the
+# bounded-cardinality contract tests/test_metrics_lint.py enforces.
+
+M_T_REQUESTS = default_registry.counter(
+    "kubeai_tenant_requests_total",
+    "terminal proxied requests by tenant and outcome (ok|error|cancelled); "
+    "cardinality bounded by the top-K accountant (evicted tenants fold "
+    "into __other__)",
+)
+M_T_TOKENS = default_registry.counter(
+    "kubeai_tenant_tokens_total",
+    "prompt/completion tokens consumed per tenant (kind=prompt|completion), "
+    "from response usage blocks; sums are conserved across top-K evictions",
+)
+M_T_SLOT_SECONDS = default_registry.counter(
+    "kubeai_tenant_slot_seconds_total",
+    "decode-slot occupancy seconds per tenant (engine-side cost proxy: "
+    "wall time the tenant's requests held a decode slot)",
+)
+M_T_PAGE_SECONDS = default_registry.counter(
+    "kubeai_tenant_kv_page_seconds_total",
+    "KV-page occupancy seconds per tenant (engine-side cost proxy: "
+    "slot-seconds x pages reserved for the request)",
+)
+M_T_SHARE = default_registry.gauge(
+    "kubeai_tenant_share",
+    "fraction of rolling-window requests attributed to each tenant "
+    "(the tenant_flood trigger's input)",
+)
+M_T_TRACKED = default_registry.gauge(
+    "kubeai_tenant_tracked",
+    "tenants currently tracked exactly by the top-K accountant "
+    "(excludes the __other__ overflow bucket)",
+)
+M_T_EVICTIONS = default_registry.counter(
+    "kubeai_tenant_evictions_total",
+    "tenants folded into __other__ by top-K pressure (high rate = long "
+    "tail of distinct keys; raise KUBEAI_TENANT_TOPK if rankings matter)",
+)
+
+# Latency buckets for the internal (non-exported) per-tenant
+# histograms: cover the default TTFT (2s) and e2e (30s) objectives
+# exactly so attainment needs no rounding at the defaults.
+LATENCY_BUCKETS = (0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0, 60.0, 120.0)
+
+
+class _TenantStats:
+    """Exact-since-tracking accumulators for one tenant. `weight` is the
+    space-saving sketch count (inherited on eviction) used ONLY for
+    eviction ranking; the metered quantities are exact."""
+
+    __slots__ = (
+        "weight", "requests", "outcomes", "prompt_tokens",
+        "completion_tokens", "slot_seconds", "page_seconds",
+        "e2e_buckets", "e2e_count", "ttft_buckets", "ttft_count",
+        "first_seen", "last_seen", "seq",
+    )
+
+    def __init__(self, weight: float = 0.0, now: float = 0.0, seq: int = 0):
+        self.seq = seq  # admission order (eviction tie-break)
+        self.weight = weight
+        self.requests = 0
+        self.outcomes: dict[str, int] = {}
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+        self.slot_seconds = 0.0
+        self.page_seconds = 0.0
+        self.e2e_buckets = [0] * (len(LATENCY_BUCKETS) + 1)
+        self.e2e_count = 0
+        self.ttft_buckets = [0] * (len(LATENCY_BUCKETS) + 1)
+        self.ttft_count = 0
+        self.first_seen = now
+        self.last_seen = now
+
+    def fold_from(self, other: "_TenantStats") -> None:
+        """Absorb *other*'s accumulations (top-K eviction into the
+        overflow bucket) — every summed quantity is conserved."""
+        self.requests += other.requests
+        for k, v in other.outcomes.items():
+            self.outcomes[k] = self.outcomes.get(k, 0) + v
+        self.prompt_tokens += other.prompt_tokens
+        self.completion_tokens += other.completion_tokens
+        self.slot_seconds += other.slot_seconds
+        self.page_seconds += other.page_seconds
+        for i, v in enumerate(other.e2e_buckets):
+            self.e2e_buckets[i] += v
+        self.e2e_count += other.e2e_count
+        for i, v in enumerate(other.ttft_buckets):
+            self.ttft_buckets[i] += v
+        self.ttft_count += other.ttft_count
+
+    def window_key(self) -> tuple:
+        """The cumulative state the rolling window differences."""
+        return (
+            self.requests, self.prompt_tokens, self.completion_tokens,
+            tuple(self.e2e_buckets), self.e2e_count,
+            tuple(self.ttft_buckets), self.ttft_count,
+        )
+
+
+def _key_add(a: tuple, b: tuple) -> tuple:
+    """Elementwise sum of two window_key tuples (scalar counters plus
+    the two bucket tuples)."""
+    return (
+        a[0] + b[0], a[1] + b[1], a[2] + b[2],
+        tuple(x + y for x, y in zip(a[3], b[3])), a[4] + b[4],
+        tuple(x + y for x, y in zip(a[5], b[5])), a[6] + b[6],
+    )
+
+
+def _bucket_observe(buckets: list[int], value: float) -> None:
+    buckets[bisect_left(LATENCY_BUCKETS, value)] += 1
+
+
+def _bucket_p95(deltas: list[float], count: float) -> float | None:
+    """Upper-bound p95 from non-cumulative bucket deltas (None with no
+    samples; +Inf overflow reports the largest finite bound)."""
+    if count <= 0:
+        return None
+    target = 0.95 * count
+    cum = 0.0
+    for i, c in enumerate(deltas):
+        cum += c
+        if cum >= target:
+            return LATENCY_BUCKETS[min(i, len(LATENCY_BUCKETS) - 1)]
+    return LATENCY_BUCKETS[-1]
+
+
+def _bucket_attainment(deltas: list[float], count: float, threshold_s: float) -> float | None:
+    """Fraction of window samples at or under *threshold_s*, resolved to
+    the smallest bucket bound >= threshold (the SLO monitor's rounding
+    rule; LATENCY_BUCKETS covers the default objectives exactly)."""
+    if count <= 0:
+        return None
+    k = min(bisect_left(LATENCY_BUCKETS, threshold_s), len(LATENCY_BUCKETS) - 1)
+    return min(sum(deltas[: k + 1]) / count, 1.0)
+
+
+class TenantAccountant:
+    """Bounded per-tenant accounting: top-K space-saving sketch over
+    tenant ids, cumulative counters + internal latency buckets per
+    tracked tenant, a rolling snapshot window for shares/attainment,
+    and the ``tenant_flood`` heavy-hitter trigger.
+
+    Thread-safe; `clock` is injectable for tests. The module-global
+    ``default_accountant`` is the live instance both servers and the
+    engine scheduler feed; its window ticker starts lazily on first
+    record, so a bare proxy (no Manager) still detects floods.
+    """
+
+    def __init__(
+        self,
+        topk: int | None = None,
+        window_seconds: float | None = None,
+        interval_seconds: float | None = None,
+        flood_share: float | None = None,
+        flood_min: float | None = None,
+        clock=time.monotonic,
+        registry=None,
+        auto_tick: bool = False,
+    ):
+        # auto_tick: lazily start the window ticker on the first
+        # recorded request (the module-global default_accountant runs
+        # this way so a bare proxy detects floods with no Manager).
+        # OFF by default: a test-constructed accountant with an
+        # injected clock must never spawn a real-clock ticker that
+        # keeps publishing its frozen window at recorders installed
+        # later in the process.
+        self.auto_tick = auto_tick
+        self.topk = int(
+            topk if topk is not None else env_float("KUBEAI_TENANT_TOPK", 32)
+        )
+        self.topk = max(self.topk, 1)
+        self.window = (
+            window_seconds
+            if window_seconds is not None
+            else env_float("KUBEAI_TENANT_WINDOW_SECONDS", 60.0)
+        )
+        self.interval = (
+            interval_seconds
+            if interval_seconds is not None
+            else max(min(self.window / 6.0, 10.0), 1.0)
+        )
+        self.flood_share = (
+            flood_share
+            if flood_share is not None
+            else env_float("KUBEAI_TENANT_FLOOD_SHARE", 0.5)
+        )
+        self.flood_min = (
+            flood_min
+            if flood_min is not None
+            else env_float("KUBEAI_TENANT_FLOOD_MIN", 20.0)
+        )
+        self.ttft_threshold_s = env_float("KUBEAI_SLO_TTFT_SECONDS", 2.0)
+        self.e2e_threshold_s = env_float("KUBEAI_SLO_E2E_SECONDS", 30.0)
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tracked: dict[str, _TenantStats] = {}
+        self._other = _TenantStats()
+        self._admit_seq = 0
+        self._evictions = 0
+        self._canary_excluded = 0
+        # (t, {tenant: window_key tuple}) cumulative snapshots; entry 0
+        # is the window baseline (same discipline as obs/slo.py). An
+        # empty baseline is seeded NOW so the first tick reports real
+        # deltas instead of differencing a snapshot against itself.
+        self._snaps: deque[tuple[float, dict[str, tuple]]] = deque()
+        self._snaps.append((self._clock(), {}))
+        self._shares: dict[str, float] = {}
+        self._window_state: dict[str, dict] = {}
+        self._last_flood: dict | None = None
+        self._ticker: threading.Thread | None = None
+        self._ticker_lock = threading.Lock()
+        self._stop_evt = threading.Event()
+
+    # -- sketch ------------------------------------------------------------
+
+    def _ensure(self, tenant: str) -> tuple[str, _TenantStats]:
+        """Resolve *tenant* to its stats bucket (must hold the lock):
+        tracked exactly, newly tracked (possibly evicting the smallest-
+        weight tenant into __other__), or the overflow bucket itself."""
+        tenant = sanitize_tenant(tenant) or ANONYMOUS
+        if tenant == OTHER:
+            return OTHER, self._other
+        st = self._tracked.get(tenant)
+        if st is not None:
+            return tenant, st
+        now = self._clock()
+        # anonymous (the shared unauthenticated bucket) rides free of
+        # the top-K budget: it must always be addressable, and counting
+        # it would shrink the identified-tenant capacity by one.
+        occupied = len(self._tracked) - (1 if ANONYMOUS in self._tracked else 0)
+        self._admit_seq += 1
+        if tenant == ANONYMOUS or occupied < self.topk:
+            st = _TenantStats(now=now, seq=self._admit_seq)
+            self._tracked[tenant] = st
+            M_T_TRACKED.set(len(self._tracked))
+            return tenant, st
+        # At capacity: evict the minimum-weight tenant (never anonymous
+        # — it is the shared unauthenticated bucket and must stay
+        # addressable) and fold its accumulations into __other__ so
+        # every global sum is conserved. Weight ties evict the NEWEST
+        # admission (largest seq): equal evidence keeps the established
+        # tenant — stability over churn, and a persistent heavy hitter
+        # can never be displaced by a tie with a one-shot key.
+        candidates = [t for t in self._tracked if t != ANONYMOUS]
+        if not candidates:
+            return OTHER, self._other
+        victim = min(
+            candidates,
+            key=lambda t: (self._tracked[t].weight, -self._tracked[t].seq),
+        )
+        vst = self._tracked.pop(victim)
+        self._fold_into_other(victim, vst)
+        st = _TenantStats(weight=vst.weight, now=now, seq=self._admit_seq)
+        self._tracked[tenant] = st
+        self._evictions += 1
+        M_T_EVICTIONS.inc()
+        M_T_TRACKED.set(len(self._tracked))
+        return tenant, st
+
+    def _fold_into_other(self, victim: str, vst: _TenantStats) -> None:
+        """Move the victim's exported series into __other__ and drop its
+        labeled series — the scrape-visible half of conservation."""
+        self._other.fold_from(vst)
+        # Window hygiene (holds the lock via the caller): the fold just
+        # bumped __other__'s CUMULATIVE state by the victim's lifetime
+        # totals. Without compensating, the next tick's snapshot diff
+        # would report that whole lifetime as __other__ *window*
+        # traffic — inflating total_req and diluting every real
+        # tenant's share exactly during long-tail key churn, the regime
+        # flood detection exists for. Shifting every RETAINED
+        # snapshot's __other__ baseline by the same amount cancels the
+        # jump (post-fold __other__ deltas stay window-local); the
+        # victim's own stale entries are dropped so a later re-admission
+        # is measured fresh, not clamped against its old history.
+        vkey = vst.window_key()
+        zero = _TenantStats().window_key()
+        for _, snap in self._snaps:
+            snap[OTHER] = _key_add(snap.get(OTHER, zero), vkey)
+            snap.pop(victim, None)
+        for outcome, n in vst.outcomes.items():
+            M_T_REQUESTS.remove({"tenant": victim, "outcome": outcome})
+            if n:
+                M_T_REQUESTS.inc(n, labels={"tenant": OTHER, "outcome": outcome})
+        for kind, n in (
+            ("prompt", vst.prompt_tokens), ("completion", vst.completion_tokens)
+        ):
+            M_T_TOKENS.remove({"tenant": victim, "kind": kind})
+            if n:
+                M_T_TOKENS.inc(n, labels={"tenant": OTHER, "kind": kind})
+        M_T_SLOT_SECONDS.remove({"tenant": victim})
+        if vst.slot_seconds:
+            M_T_SLOT_SECONDS.inc(vst.slot_seconds, labels={"tenant": OTHER})
+        M_T_PAGE_SECONDS.remove({"tenant": victim})
+        if vst.page_seconds:
+            M_T_PAGE_SECONDS.inc(vst.page_seconds, labels={"tenant": OTHER})
+        M_T_SHARE.remove({"tenant": victim})
+        self._shares.pop(victim, None)
+        self._window_state.pop(victim, None)
+
+    # -- recording ---------------------------------------------------------
+
+    def record_request(
+        self,
+        tenant: str,
+        outcome: str,
+        e2e_s: float,
+        ttft_s: float | None = None,
+        prompt_tokens: int = 0,
+        completion_tokens: int = 0,
+        canary: bool = False,
+    ) -> None:
+        """Terminal accounting for one proxied request. Cheap by
+        contract (a handful of dict updates under one lock) — called
+        once per request on the proxy's terminal paths."""
+        if canary:
+            with self._lock:
+                self._canary_excluded += 1
+            return
+        with self._lock:
+            name, st = self._ensure(tenant)
+            now = self._clock()
+            st.weight += 1
+            st.requests += 1
+            st.outcomes[outcome] = st.outcomes.get(outcome, 0) + 1
+            st.prompt_tokens += prompt_tokens
+            st.completion_tokens += completion_tokens
+            st.last_seen = now
+            _bucket_observe(st.e2e_buckets, e2e_s)
+            st.e2e_count += 1
+            if ttft_s is not None:
+                _bucket_observe(st.ttft_buckets, ttft_s)
+                st.ttft_count += 1
+            M_T_REQUESTS.inc(labels={"tenant": name, "outcome": outcome})
+            if prompt_tokens:
+                M_T_TOKENS.inc(prompt_tokens, labels={"tenant": name, "kind": "prompt"})
+            if completion_tokens:
+                M_T_TOKENS.inc(
+                    completion_tokens, labels={"tenant": name, "kind": "completion"}
+                )
+        self._ensure_ticker()
+
+    def record_cost(self, tenant: str, slot_seconds: float, page_seconds: float) -> None:
+        """Engine-side cost attribution: called by the scheduler once
+        per request at slot release (wall time the slot was held, and
+        that time multiplied by the KV pages reserved). Scheduler-
+        thread-cheap: one lock, a few float adds."""
+        if not tenant:
+            return  # un-attributed direct submits (bench harnesses)
+        with self._lock:
+            name, st = self._ensure(tenant)
+            st.weight += 1
+            st.slot_seconds += slot_seconds
+            st.page_seconds += page_seconds
+            st.last_seen = self._clock()
+            M_T_SLOT_SECONDS.inc(slot_seconds, labels={"tenant": name})
+            M_T_PAGE_SECONDS.inc(page_seconds, labels={"tenant": name})
+
+    # -- rolling window ----------------------------------------------------
+
+    def tick(self) -> None:
+        """Push one cumulative snapshot, difference against the window
+        baseline, refresh shares, and run heavy-hitter detection. The
+        flood trigger publishes OUTSIDE the lock (incident capture
+        sources may read report(), which takes it)."""
+        now = self._clock()
+        floods: list[dict] = []
+        with self._lock:
+            snap = {t: st.window_key() for t, st in self._tracked.items()}
+            snap[OTHER] = self._other.window_key()
+            self._snaps.append((now, snap))
+            while len(self._snaps) >= 2 and self._snaps[1][0] <= now - self.window:
+                self._snaps.popleft()
+            base_t, base = self._snaps[0]
+            span = max(now - base_t, 1e-9)
+            zero = _TenantStats().window_key()
+            total_req = 0.0
+            deltas: dict[str, dict] = {}
+            for t, cur in snap.items():
+                b = base.get(t, zero)
+                req_d = max(cur[0] - b[0], 0)
+                e2e_d = [max(c - x, 0) for c, x in zip(cur[3], b[3])]
+                ttft_d = [max(c - x, 0) for c, x in zip(cur[5], b[5])]
+                deltas[t] = {
+                    "requests": req_d,
+                    "prompt_tokens": max(cur[1] - b[1], 0),
+                    "completion_tokens": max(cur[2] - b[2], 0),
+                    "e2e_deltas": e2e_d,
+                    "e2e_count": max(cur[4] - b[4], 0),
+                    "ttft_deltas": ttft_d,
+                    "ttft_count": max(cur[6] - b[6], 0),
+                }
+                total_req += req_d
+            state: dict[str, dict] = {}
+            for t, d in deltas.items():
+                share = d["requests"] / total_req if total_req > 0 else 0.0
+                state[t] = {
+                    "window_requests": d["requests"],
+                    "requests_per_second": round(d["requests"] / span, 4),
+                    "share": round(share, 4),
+                    "window_prompt_tokens": d["prompt_tokens"],
+                    "window_completion_tokens": d["completion_tokens"],
+                    "e2e_p95_s": _bucket_p95(d["e2e_deltas"], d["e2e_count"]),
+                    "e2e_attainment": _bucket_attainment(
+                        d["e2e_deltas"], d["e2e_count"], self.e2e_threshold_s
+                    ),
+                    "ttft_p95_s": _bucket_p95(d["ttft_deltas"], d["ttft_count"]),
+                    "ttft_attainment": _bucket_attainment(
+                        d["ttft_deltas"], d["ttft_count"], self.ttft_threshold_s
+                    ),
+                }
+            # Share gauge: present tenants set, vanished series removed
+            # (a departed tenant's share must not freeze at its last
+            # value — same rule as the demoted SLO leader's gauges).
+            for t in list(self._shares):
+                if t not in state:
+                    M_T_SHARE.remove({"tenant": t})
+                    del self._shares[t]
+            for t, s in state.items():
+                M_T_SHARE.set(s["share"], labels={"tenant": t})
+                self._shares[t] = s["share"]
+            self._window_state = state
+            # Heavy-hitter detection: one IDENTIFIED tenant dominating
+            # the window. __other__ (the fold bucket) and anonymous
+            # (every unauthenticated caller) are mixtures of many
+            # clients, not one hitter — naming either would send the
+            # operator chasing a tenant that doesn't exist. Both are
+            # excluded by construction; their shares are still visible
+            # in /debug/tenants and kubeai_tenant_share.
+            if total_req >= self.flood_min:
+                for t, s in state.items():
+                    if t in (OTHER, ANONYMOUS):
+                        continue
+                    if s["share"] >= self.flood_share:
+                        floods.append({
+                            "tenant": t,
+                            "share": s["share"],
+                            "window_requests": s["window_requests"],
+                            "window_seconds": round(span, 3),
+                            "threshold": self.flood_share,
+                        })
+            if floods:
+                self._last_flood = dict(floods[0], at=time.time())
+        for f in floods:
+            publish_trigger("tenant_flood", detail=f, key=f["tenant"])
+
+    # -- report ------------------------------------------------------------
+
+    def report(self) -> dict:
+        """The /debug/tenants payload: heavy-hitter-ranked per-tenant
+        rolling-window and cumulative accounting."""
+        with self._lock:
+            rows = []
+            for t, st in list(self._tracked.items()) + [(OTHER, self._other)]:
+                if st.requests == 0 and st.slot_seconds == 0.0:
+                    continue
+                w = self._window_state.get(t, {})
+                rows.append({
+                    "tenant": t,
+                    "requests": {
+                        "total": st.requests,
+                        "window": w.get("window_requests", 0),
+                        "per_second": w.get("requests_per_second", 0.0),
+                    },
+                    "share": w.get("share", 0.0),
+                    "outcomes": dict(st.outcomes),
+                    "tokens": {
+                        "prompt": st.prompt_tokens,
+                        "completion": st.completion_tokens,
+                        "window_prompt": w.get("window_prompt_tokens", 0),
+                        "window_completion": w.get("window_completion_tokens", 0),
+                    },
+                    "latency": {
+                        "e2e_p95_s": w.get("e2e_p95_s"),
+                        "e2e_attainment": w.get("e2e_attainment"),
+                        "ttft_p95_s": w.get("ttft_p95_s"),
+                        "ttft_attainment": w.get("ttft_attainment"),
+                    },
+                    "cost": {
+                        "slot_seconds": round(st.slot_seconds, 4),
+                        "kv_page_seconds": round(st.page_seconds, 4),
+                    },
+                })
+            rows.sort(
+                key=lambda r: (r["requests"]["window"], r["requests"]["total"]),
+                reverse=True,
+            )
+            for i, r in enumerate(rows):
+                r["rank"] = i + 1
+            return {
+                "window_seconds": self.window,
+                "interval_seconds": self.interval,
+                "topk": self.topk,
+                "tracked": len(self._tracked),
+                "evictions": self._evictions,
+                "canary_excluded": self._canary_excluded,
+                "thresholds": {
+                    "ttft_s": self.ttft_threshold_s,
+                    "e2e_s": self.e2e_threshold_s,
+                },
+                "flood": {
+                    "share_threshold": self.flood_share,
+                    "min_window_requests": self.flood_min,
+                    "last": self._last_flood,
+                },
+                "tenants": rows,
+            }
+
+    def totals(self) -> dict:
+        """Cross-tenant sums (tracked + __other__) — the conservation
+        check harnesses assert against global counters."""
+        with self._lock:
+            allst = list(self._tracked.values()) + [self._other]
+            return {
+                "requests": sum(s.requests for s in allst),
+                "prompt_tokens": sum(s.prompt_tokens for s in allst),
+                "completion_tokens": sum(s.completion_tokens for s in allst),
+                "slot_seconds": sum(s.slot_seconds for s in allst),
+                "kv_page_seconds": sum(s.page_seconds for s in allst),
+            }
+
+    # -- lifecycle ---------------------------------------------------------
+
+    def _ensure_ticker(self) -> None:
+        """Lazy daemon ticker (FlightRecorder discipline): the first
+        recorded request starts the window loop, so a bare OpenAIServer
+        + ModelProxy (no Manager) still computes shares and detects
+        floods. Tests that want determinism construct their own
+        accountant (auto_tick off) and call tick() with an injected
+        clock."""
+        if not self.auto_tick:
+            return
+        if self._ticker is not None and self._ticker.is_alive():
+            return
+        with self._ticker_lock:
+            if self._ticker is not None and self._ticker.is_alive():
+                return
+            self._stop_evt.clear()
+            self._ticker = threading.Thread(
+                target=self._loop, name="tenant-accountant", daemon=True
+            )
+            self._ticker.start()
+
+    def stop(self) -> None:
+        self._stop_evt.set()
+
+    def _loop(self) -> None:
+        while not self._stop_evt.wait(self.interval):
+            try:
+                self.tick()
+            except Exception:  # pragma: no cover - defensive
+                import logging
+
+                logging.getLogger("kubeai_tpu.tenants").exception(
+                    "tenant accountant tick failed"
+                )
+
+    def reset(self) -> None:
+        """Drop all state AND the exported kubeai_tenant_* series (test
+        isolation for the process-global default accountant)."""
+        with self._lock:
+            for t, st in list(self._tracked.items()) + [(OTHER, self._other)]:
+                for outcome in st.outcomes:
+                    M_T_REQUESTS.remove({"tenant": t, "outcome": outcome})
+                for kind in ("prompt", "completion"):
+                    M_T_TOKENS.remove({"tenant": t, "kind": kind})
+                M_T_SLOT_SECONDS.remove({"tenant": t})
+                M_T_PAGE_SECONDS.remove({"tenant": t})
+                M_T_SHARE.remove({"tenant": t})
+            self._tracked.clear()
+            self._other = _TenantStats()
+            self._snaps.clear()
+            # Re-seed the empty window baseline (same as construction):
+            # without it the first post-reset tick's snapshot — possibly
+            # taken mid-burst — becomes the baseline and silently hides
+            # every request that landed before it.
+            self._snaps.append((self._clock(), {}))
+            self._shares.clear()
+            self._window_state.clear()
+            self._evictions = 0
+            self._canary_excluded = 0
+            self._last_flood = None
+            M_T_TRACKED.set(0)
+
+
+default_accountant = TenantAccountant(auto_tick=True)
+
+
+# ---------------------------------------------------------------------------
+# Per-request meter (proxy side): collects TTFT/usage/outcome during the
+# response and lands exactly one record_request at the terminal.
+
+
+# Non-streaming bodies are buffered for the usage parse only up to this
+# many bytes; larger bodies (audio, giant embedding matrices) skip it.
+BODY_PARSE_CAP = 4 * 1024 * 1024
+
+
+class RequestMeter:
+    """One per proxied request, created at tenant extraction and
+    finished (idempotently) on whichever terminal path the request
+    takes. Canary probes construct one too, but finish() drops them —
+    the single choke point for canary exclusion."""
+
+    __slots__ = (
+        "tenant", "canary", "accountant", "t0", "ttft",
+        "prompt_tokens", "completion_tokens", "usage_seen",
+        "strip_usage", "_done", "_buf", "_buf_len",
+    )
+
+    def __init__(self, tenant: str, canary: bool = False, accountant: TenantAccountant | None = None):
+        self.tenant = tenant
+        self.canary = canary
+        self.accountant = accountant or default_accountant
+        self.t0 = time.monotonic()
+        self.ttft: float | None = None
+        self.prompt_tokens = 0
+        self.completion_tokens = 0
+        self.usage_seen = False
+        # Set when the proxy injected stream_options.include_usage the
+        # client never asked for: the usage chunk is metered here and
+        # withheld from the client stream.
+        self.strip_usage = False
+        self._done = False
+        self._buf: list[bytes] = []
+        self._buf_len = 0
+
+    def first_byte(self) -> None:
+        if self.ttft is None:
+            self.ttft = time.monotonic() - self.t0
+
+    def observe_usage(self, usage) -> None:
+        if not isinstance(usage, dict):
+            return
+        pt = usage.get("prompt_tokens")
+        ct = usage.get("completion_tokens")
+        if ct is None:
+            # Prompt-only usage shapes (embeddings; some third-party
+            # engines): completion is total minus prompt — falling back
+            # to total_tokens directly would bill the prompt twice.
+            # Clamped at 0: a malformed block (total < prompt) must not
+            # become a negative count that DECREMENTS the token counter.
+            total = usage.get("total_tokens")
+            if isinstance(total, (int, float)) and isinstance(pt, (int, float)):
+                ct = max(total - pt, 0)
+        if isinstance(pt, (int, float)):
+            self.prompt_tokens = int(pt)
+            self.usage_seen = True
+        if isinstance(ct, (int, float)):
+            self.completion_tokens = int(ct)
+            self.usage_seen = True
+
+    def observe_event(self, event: bytes) -> bool:
+        """Inspect one SSE event for a usage block. Returns True when
+        the event is the usage-only chunk (empty ``choices``) AND the
+        proxy injected the request's include_usage — i.e. the caller
+        must strip it from the client stream. The substring pre-filter
+        keeps the JSON parse off the per-token path."""
+        if b'"usage"' not in event or not event.startswith(b"data:"):
+            return False
+        payload = event[5:].strip()
+        if payload == b"[DONE]":
+            return False
+        try:
+            obj = json.loads(payload)
+        except ValueError:
+            return False
+        if not isinstance(obj, dict):
+            return False
+        usage = obj.get("usage")
+        if not isinstance(usage, dict):
+            return False
+        self.observe_usage(usage)
+        return self.strip_usage and obj.get("choices") == []
+
+    def feed(self, chunk: bytes) -> None:
+        """Accumulate a non-streaming response body (bounded) for the
+        terminal usage parse. Crossing the cap drops everything
+        buffered so far — parse_body() is guaranteed to skip an
+        over-cap body, so holding the accumulated megabytes for the
+        rest of the request would be dead memory."""
+        if self._buf_len > BODY_PARSE_CAP:
+            return
+        self._buf_len += len(chunk)
+        if self._buf_len > BODY_PARSE_CAP:
+            self._buf = []
+            return
+        self._buf.append(chunk)
+
+    def parse_body(self) -> None:
+        if not self._buf or self._buf_len > BODY_PARSE_CAP:
+            return
+        try:
+            obj = json.loads(b"".join(self._buf))
+        except ValueError:
+            return
+        if isinstance(obj, dict):
+            self.observe_usage(obj.get("usage"))
+
+    def finish(self, outcome: str) -> None:
+        """Idempotent terminal record — first caller's outcome wins
+        (mirrors SpanBuilder.finish, and is called beside it)."""
+        if self._done:
+            return
+        self._done = True
+        self._buf = []
+        self.accountant.record_request(
+            self.tenant,
+            outcome,
+            e2e_s=time.monotonic() - self.t0,
+            ttft_s=self.ttft,
+            prompt_tokens=self.prompt_tokens,
+            completion_tokens=self.completion_tokens,
+            canary=self.canary,
+        )
+
+
+# ---------------------------------------------------------------------------
+# Shared /debug HTTP route (both servers chain this beside the faults /
+# incident / canary handlers).
+
+
+def handle_tenant_request(path: str, query: str = "") -> tuple[int, str, bytes] | None:
+    if path != "/debug/tenants":
+        return None
+    return (
+        200,
+        "application/json",
+        json.dumps(default_accountant.report()).encode(),
+    )

@@ -49,6 +49,14 @@ class Request:
     # point — endpoint selection prefers this pool and fails open to
     # the surviving one.
     role: str = ""
+    # Tenant attribution (obs/tenants.py): the HASHED tenant id derived
+    # from the request's credentials (never the raw key), forwarded
+    # engine-ward as X-KubeAI-Tenant. canary marks synthetic probes
+    # excluded from all tenant accounting; meter is the per-request
+    # RequestMeter the terminal paths finish (duck-typed, import-light).
+    tenant: str = ""
+    canary: bool = False
+    meter: object = None
 
     @property
     def load_balancing(self) -> mt.LoadBalancing:

@@ -20,11 +20,18 @@ import logging
 import threading
 import time
 
+from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.disagg.handoff import is_handoff_event as _is_handoff_event
 from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
 from kubeai_tpu.obs import SpanBuilder, extract_context
+from kubeai_tpu.obs.tenants import (
+    CANARY_HEADER,
+    TENANT_HEADER,
+    RequestMeter,
+    extract_tenant,
+)
 from kubeai_tpu.proxy.apiutils import APIError, Request, parse_request
 from kubeai_tpu.proxy.recovery import (
     M_BUDGET_REMAINING,
@@ -51,6 +58,18 @@ class ProxyResult:
         self.status = status
         self.headers = headers
         self.body_iter = body_iter
+
+
+def _chunk_reader(resp):
+    """One-chunk-at-a-time reader for SSE re-framing. read1 (at most one
+    chunk per call) over read: a bulk read(N) on a chunked response
+    that died mid-stream raises IncompleteRead WITHOUT surfacing the
+    chunks it already buffered — events the client could have had would
+    vanish and the resume cursor would undercount."""
+    read1 = getattr(resp, "read1", None)
+    if read1 is not None:
+        return lambda: read1(65536)
+    return lambda: resp.read(65536)
 
 
 class _HedgeFailed(Exception):
@@ -101,9 +120,21 @@ class ModelProxy:
         # X-Request-ID, else generated): even parse failures get a
         # recorded timeline.
         tb = SpanBuilder(extract_context(headers), component="proxy")
+        # Tenant attribution (obs/tenants.py): derived from credentials
+        # BEFORE parsing so even a 400 is attributed; only the hash of
+        # the credential survives this point. Canary probes carry the
+        # trusted exclusion marker and are metered by the accountant as
+        # excluded, never as traffic.
+        tenant = extract_tenant(headers)
+        is_canary = any(k.lower() == CANARY_HEADER.lower() for k in headers)
+        meter = RequestMeter(tenant, canary=is_canary)
+        tb.attrs["tenant"] = tenant
         try:
             with tb.span("parse"):
                 req = parse_request(self.model_client, raw_body, path, headers)
+            req.tenant = tenant
+            req.canary = is_canary
+            req.meter = meter
             # Honor an inbound correlation id; otherwise use the parsed id.
             from kubeai_tpu.proxy.apiutils import sanitize_request_id
 
@@ -127,6 +158,7 @@ class ModelProxy:
             self.active.add(1, labels=labels)
             release = lambda: self.active.add(-1, labels=labels)
         except APIError as e:
+            meter.finish("error")
             tb.finish("error", status=e.code, error=e.message)
             raise
 
@@ -136,6 +168,9 @@ class ModelProxy:
             return self._proxy_with_retries(req, path, headers, release, cancelled)
         except BaseException as e:
             release()
+            meter.finish(
+                "cancelled" if cancelled is not None and cancelled.is_set() else "error"
+            )
             tb.finish(
                 "error",
                 status=getattr(e, "code", 0) or 500,
@@ -144,6 +179,33 @@ class ModelProxy:
             raise
 
     def _proxy_with_retries(self, req: Request, path: str, headers: dict[str, str], release, cancelled):
+        # Token metering for streams: the usage block is the only exact
+        # source of prompt/completion counts, but OpenAI only sends it
+        # when the client asked (stream_options.include_usage). For our
+        # own engine the proxy INJECTS the flag engine-ward and strips
+        # the resulting usage chunk from the client stream unless the
+        # client requested it — every streamed request gets exact
+        # per-tenant token accounting with zero client-visible change.
+        # Gated to TPUEngine models: a third-party engine image may
+        # reject an option its build predates.
+        meter: RequestMeter | None = req.meter
+        if (
+            meter is not None
+            and req.body is not None
+            and req.body.stream
+            and req.raw_body is None
+            and isinstance(req.body.data, dict)
+            and req.model_obj is not None
+            and getattr(req.model_obj.spec, "engine", "") == mt.ENGINE_TPU
+        ):
+            so = req.body.data.get("stream_options")
+            # parse_request already 400'd non-dict stream_options; the
+            # isinstance guard keeps direct callers safe too.
+            if not (isinstance(so, dict) and so.get("include_usage")):
+                req.body.data["stream_options"] = dict(
+                    so if isinstance(so, dict) else {}, include_usage=True
+                )
+                meter.strip_usage = True
         body = req.body_bytes()
         t0 = time.monotonic()
         # Every handled request feeds the retry budget (the deposit side
@@ -213,10 +275,18 @@ class ModelProxy:
             k: v for k, v in headers.items()
             if k.lower() not in (
                 "x-request-id", "traceparent", "x-request-deadline",
-                "x-handoff-planned",
+                "x-handoff-planned", "x-kubeai-tenant",
             )
         }
         headers["X-Request-ID"] = req.id
+        # Internal tenant hop: inbound copies were stripped above (an
+        # external client must not choose its attribution bucket); the
+        # engine's cost accounting keys on this header. Canary probes
+        # stay un-attributed so engine-side slot/page-seconds exclude
+        # synthetic traffic too (their X-KubeAI-Canary marker passes
+        # through untouched).
+        if req.tenant and not req.canary:
+            headers[TENANT_HEADER] = req.tenant
         if handoff_planned:
             # Prefill replicas cap ONLY streams the proxy will actually
             # hand off: an ineligible stream that failed open onto the
@@ -378,12 +448,24 @@ class ModelProxy:
                 body_iter = self._stream_with_replay(
                     req, path, dict(headers), body, release, cancelled, tb,
                     resp, conn, done, addr, t_conn, failed_addrs, remaining,
-                    handoff=dspec if handoff_planned else None,
+                    handoff=dspec if handoff_planned else None, meter=meter,
                 )
             else:
+                # Non-replayable SSE is still re-framed event-at-a-time
+                # (recovery.sse_events, the repo's ONE SSE rule): the
+                # meter needs whole events to spot the usage chunk, and
+                # an injected usage chunk must be strippable here too.
+                ctype = (resp.getheader("Content-Type") or "").lower()
+                is_sse = resp.status == 200 and ctype.startswith("text/event-stream")
+                # Buffer-for-usage only when a usage block can exist:
+                # tee-ing every large non-JSON body (audio, base64
+                # embedding matrices) would pin up to the parse cap per
+                # in-flight request for nothing.
                 body_iter = self._body_iter(
                     resp, conn, done, release, tb=tb, t_conn=t_conn,
-                    cancelled=cancelled, report=report,
+                    cancelled=cancelled, report=report, meter=meter,
+                    sse=is_sse,
+                    parse_json=ctype.startswith("application/json"),
                 )
             return ProxyResult(resp.status, resp_headers, body_iter)
         log.info(
@@ -537,7 +619,7 @@ class ModelProxy:
         _, a, d, resp, conn, t_start = winner
         return resp, conn, a, d, t_start
 
-    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining, handoff=None):
+    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining, handoff=None, meter=None):
         """Stream an SSE body with mid-stream replay: events are
         forwarded whole (a half-event from a dying upstream never
         reaches the client); when the upstream dies after N delivered
@@ -565,34 +647,30 @@ class ModelProxy:
         replays = 0
         completed = False
 
-        def reader(r):
-            # read1 (at most one chunk per call) over read: a bulk
-            # read(N) on a chunked response that died mid-stream raises
-            # IncompleteRead WITHOUT surfacing the chunks it already
-            # buffered — events the client could have had would vanish
-            # and the resume cursor would undercount.
-            read1 = getattr(r, "read1", None)
-            if read1 is not None:
-                return lambda: read1(65536)
-            return lambda: r.read(65536)
-
         try:
             while True:
                 died: Exception | None = None
                 cutover = False
                 try:
-                    for ev in sse_events(reader(resp)):
+                    for ev in sse_events(_chunk_reader(resp)):
                         if handoff is not None and _is_handoff_event(ev):
                             # The prefill engine's budget-cap marker:
                             # never forwarded — the decode stream owns
                             # the real finish.
                             cutover = True
                             break
+                        if meter is not None and meter.observe_event(ev):
+                            # Proxy-injected usage chunk: metered, then
+                            # withheld — the client never asked for it,
+                            # and it must not perturb the resume cursor.
+                            continue
                         if is_token_event(ev):
                             if suppress:
                                 suppress -= 1
                                 continue
                             forwarded += 1
+                        if meter is not None:
+                            meter.first_byte()
                         yield ev
                 except Exception as e:
                     died = e
@@ -670,13 +748,15 @@ class ModelProxy:
             if done is not None:
                 done()
             release()
+            if cancelled is not None and cancelled.is_set():
+                outcome = "cancelled"
+            elif completed:
+                outcome = "ok"
+            else:
+                outcome = "error"
+            if meter is not None:
+                meter.finish(outcome)
             if tb is not None:
-                if cancelled is not None and cancelled.is_set():
-                    outcome = "cancelled"
-                elif completed:
-                    outcome = "ok"
-                else:
-                    outcome = "error"
                 tb.attrs["replays"] = replays
                 tb.finish(outcome, status=200)
 
@@ -824,7 +904,7 @@ class ModelProxy:
         return resp, conn, t_conn, None
 
     @staticmethod
-    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None):
+    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None, meter=None, sse=False, parse_json=False):
         """Stream the upstream body; exactly-once cleanup on exhaustion or
         generator close (client disconnect). The proxy timeline closes
         HERE — the upstream span covers connect through last byte, so
@@ -833,22 +913,46 @@ class ModelProxy:
         *report* (breaker feed) fires at most once: ok=True on clean
         exhaustion, ok=False when the UPSTREAM read dies mid-stream.
         Client disconnects (generator close) report nothing — they say
-        nothing about endpoint health."""
+        nothing about endpoint health.
+
+        *meter* (tenant accounting) gets first-byte TTFT, the buffered
+        JSON body's usage block (non-SSE), and — with *sse* — each
+        whole event, so a proxy-injected usage chunk can be metered and
+        withheld. *sse* re-frames the body via recovery.sse_events: a
+        half-event from a dying upstream is discarded, which is what
+        the raw IncompleteRead delivered to the client anyway."""
+        clean = False
         try:
-            while True:
-                try:
-                    chunk = resp.read(65536)
-                except Exception:
-                    # Endpoint died mid-stream: passive health must see it
-                    # (this is exactly the "dead endpoint keeps receiving
-                    # fresh requests" window the breaker closes).
-                    if report is not None:
-                        report(False)
-                        report = None
-                    raise
-                if not chunk:
-                    break
-                yield chunk
+            try:
+                if sse:
+                    # flush_tail: this is a passthrough (no resume
+                    # cursor to protect) — a third-party stream whose
+                    # final event lacks the terminating blank line
+                    # still delivers every byte on clean EOF.
+                    for ev in sse_events(_chunk_reader(resp), flush_tail=True):
+                        if meter is not None:
+                            if meter.observe_event(ev):
+                                continue  # injected usage chunk: strip
+                            meter.first_byte()
+                        yield ev
+                else:
+                    while True:
+                        chunk = resp.read(65536)
+                        if not chunk:
+                            break
+                        if meter is not None:
+                            meter.first_byte()
+                            if parse_json:
+                                meter.feed(chunk)
+                        yield chunk
+            except Exception:
+                # Endpoint died mid-stream: passive health must see it
+                # (this is exactly the "dead endpoint keeps receiving
+                # fresh requests" window the breaker closes).
+                if report is not None:
+                    report(False)
+                    report = None
+                raise
             # http.client's bounded read() returns b"" on early EOF
             # instead of raising (CPython compat choice) — without this
             # check a Content-Length body truncated by endpoint death
@@ -859,6 +963,9 @@ class ModelProxy:
                     report(False)
                     report = None
                 raise http.client.IncompleteRead(b"", expected)
+            if meter is not None and parse_json:
+                meter.parse_body()
+            clean = True
             if report is not None:
                 report(True)
                 report = None
@@ -866,6 +973,14 @@ class ModelProxy:
             conn.close()
             done()
             release()
+            if cancelled is not None and cancelled.is_set():
+                outcome = "cancelled"
+            elif not clean:
+                outcome = "error"
+            else:
+                outcome = "ok" if resp.status < 400 else "error"
+            if meter is not None:
+                meter.finish(outcome)
             if tb is not None:
                 if t_conn is not None:
                     tb.add_span(
@@ -873,10 +988,6 @@ class ModelProxy:
                         endpoint=tb.attrs.get("endpoint", ""),
                         status=resp.status,
                     )
-                if cancelled is not None and cancelled.is_set():
-                    outcome = "cancelled"
-                else:
-                    outcome = "ok" if resp.status < 400 else "error"
                 tb.finish(outcome, status=resp.status)
 
     @staticmethod

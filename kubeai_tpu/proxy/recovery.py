@@ -168,18 +168,27 @@ def request_replayable(body) -> bool:
     return greedy or data.get("seed") is not None
 
 
-def sse_events(read_chunk):
+def sse_events(read_chunk, flush_tail: bool = False):
     """Re-frame a byte stream into complete SSE events (blank-line
     delimited blocks, delimiter included; both LF and CRLF line endings
     — third-party engine images behind the operator may emit either).
     *read_chunk* is a no-arg callable returning the next bytes chunk
     (b"" on EOF). Trailing bytes that never completed an event are
     DISCARDED — that is the point: a half-event from a dying upstream
-    must not reach the client."""
+    must not reach the client.
+
+    *flush_tail* yields the trailing remainder on a CLEAN EOF instead:
+    the passthrough (non-replay) proxy path uses it so a third-party
+    engine whose final event lacks the terminating blank line still
+    delivers every byte the upstream sent — only clean exhaustion
+    flushes; a mid-stream death still raises out of *read_chunk*
+    before the flush is reached."""
     buf = b""
     while True:
         chunk = read_chunk()
         if not chunk:
+            if flush_tail and buf:
+                yield buf
             return
         buf += chunk
         while True:

@@ -21,9 +21,11 @@ from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.faults import handle_faults_request
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs import (
+    debug_index_response,
     handle_canary_request,
     handle_debug_request,
     handle_incident_request,
+    handle_tenant_request,
 )
 from kubeai_tpu.proxy.apiutils import (
     APIError,
@@ -256,11 +258,21 @@ def _make_handler(srv: OpenAIServer):
                         404, {"error": {"message": "no SLO monitor attached"}}
                     )
                 self._json(200, srv.slo.report())
+            elif path in ("/debug", "/debug/"):
+                # Discoverability: every debug surface this server
+                # mounts, with one-line descriptions.
+                code, ctype, body = debug_index_response("operator")
+                self.send_response(code)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
             elif path.startswith("/debug/"):
                 resp = (
                     handle_faults_request(path, query)
                     or handle_incident_request(path, query)
                     or handle_canary_request(path, query)
+                    or handle_tenant_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:
@@ -313,8 +325,15 @@ def _make_handler(srv: OpenAIServer):
             # responses (400/404/502) echo it — sanitized, since it goes
             # into headers and log lines.
             rid = sanitize_request_id(self.headers.get("X-Request-ID", "")) or uuid.uuid4().hex
+            # The canary exclusion marker is trusted only from the
+            # IN-PROCESS prober (which calls proxy.handle directly and
+            # never passes through this server): an external client
+            # carrying it would opt itself out of tenant accounting and
+            # flood detection — strip it at the boundary, like the
+            # internal tenant header the proxy strips itself.
             headers = {
-                k: v for k, v in self.headers.items() if k.lower() != "x-request-id"
+                k: v for k, v in self.headers.items()
+                if k.lower() not in ("x-request-id", "x-kubeai-canary")
             }
             headers["X-Request-ID"] = rid
             srv._track(1)

@@ -70,6 +70,69 @@ def test_registered_metrics_have_help_and_prefix():
     assert not problems, "\n".join(problems)
 
 
+ACCOUNTANT = pathlib.Path("kubeai_tpu") / "obs" / "tenants.py"
+
+
+def test_tenant_metrics_registered_only_through_accountant():
+    """Registration rule: every kubeai_tenant_* metric lives in the
+    bounded top-K accountant module. Registering one anywhere else
+    bypasses the eviction/fold machinery that keeps cardinality fixed."""
+    violations = [
+        f"{path}:{lineno}: {name} registered outside the tenant accountant"
+        for path, lineno, name, _ in _registration_calls()
+        if name is not None
+        and name.startswith("kubeai_tenant_")
+        and path != ACCOUNTANT
+    ]
+    assert not violations, "\n".join(violations)
+    assert any(
+        name is not None and name.startswith("kubeai_tenant_") and path == ACCOUNTANT
+        for path, _, name, _ in _registration_calls()
+    ), "tenant metrics vanished from the accountant — lint scan broken?"
+
+
+def test_tenant_label_written_only_by_accountant():
+    """Cardinality rule: any metric write whose labels dict carries a
+    `tenant` key must be inside kubeai_tpu/obs/tenants.py, where the
+    top-K accountant bounds the label population. A tenant label
+    written anywhere else is unbounded cardinality (one series per API
+    key) and fails this lint."""
+    _WRITERS = {"inc", "set", "observe", "add", "remove"}
+
+    def labels_dicts(call: ast.Call):
+        for node in list(call.args) + [kw.value for kw in call.keywords]:
+            if isinstance(node, ast.Dict):
+                yield node
+
+    violations = []
+    hits = 0
+    for path in sorted(PKG.rglob("*.py")):
+        rel = path.relative_to(REPO)
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _WRITERS
+            ):
+                continue
+            for d in labels_dicts(node):
+                has_tenant = any(
+                    isinstance(k, ast.Constant) and k.value == "tenant"
+                    for k in d.keys
+                )
+                if not has_tenant:
+                    continue
+                hits += 1
+                if rel != ACCOUNTANT:
+                    violations.append(
+                        f"{rel}:{node.lineno}: metric written with a "
+                        "`tenant` label outside the bounded accountant"
+                    )
+    assert hits > 0, "no tenant-labeled writes found at all — lint scan broken?"
+    assert not violations, "\n".join(violations)
+
+
 def test_doc_metric_names_exist_in_code():
     code_names = {
         name for _, _, name, _ in _registration_calls() if name is not None
