@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -63,13 +63,17 @@ incident-report: ## render the latest captured incident as a correlated timeline
 	    $(if $(INCIDENT_ID),--id $(INCIDENT_ID))
 
 OPERATOR_URL ?= http://localhost:8000
-fleet-snapshot: ## dump /debug/fleet + /debug/autoscaler + /debug/slo (runbook capture)
+fleet-snapshot: ## dump EVERY surface the operator's GET /debug index lists (runbook capture)
 	@# Usage: make fleet-snapshot [OPERATOR_URL=http://host:8000] — prints
-	@# one JSON document; redirect to a file for incident timelines.
-	$(PY) -c "import json, urllib.request; \
-	base = '$(OPERATOR_URL)'; \
-	get = lambda p: json.load(urllib.request.urlopen(base + p, timeout=10)); \
-	print(json.dumps({p: get(p) for p in ('/debug/fleet', '/debug/autoscaler', '/debug/slo')}, indent=1))"
+	@# one JSON document keyed by path; redirect to a file for incident
+	@# timelines. Surfaces come from the live /debug index, so new debug
+	@# endpoints ride along without Makefile edits.
+	$(PY) benchmarks/fleet_snapshot.py --url $(OPERATOR_URL)
+
+bench-trend: ## render the committed BENCH_r*.json perf trajectory as a table
+	@# tok/s, MFU, rate-controlled TTFT per round; CPU-fallback and
+	@# failed rounds are flagged, not plotted as real numbers.
+	$(PY) benchmarks/bench_trend.py
 
 dryrun:  ## multi-chip sharding dryrun on 8 virtual CPU devices
 	$(PY) __graft_entry__.py 8

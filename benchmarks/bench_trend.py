@@ -1,0 +1,111 @@
+"""Perf trajectory table: the committed ``BENCH_r*.json`` rounds as one
+human-readable table — tok/s, MFU, rate-controlled TTFT per round, with
+CPU-fallback and failed rounds flagged instead of plotted as real
+numbers.
+
+The repo's own perf history was invisible without opening five JSON
+files; this shares perf_gate's loading/comparability logic so the trend
+and the gate can never disagree about which rounds are real TPU
+measurements.
+
+    python benchmarks/bench_trend.py [--glob 'BENCH_r*.json'] [--json]
+    make bench-trend
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import sys
+
+try:  # imported as a package (tests) or run as a script (make bench-trend)
+    from benchmarks.perf_gate import _rc_ttft, _round_number, comparable, load_bench
+except ImportError:
+    from perf_gate import _rc_ttft, _round_number, comparable, load_bench
+
+
+def trend_rows(paths: list[str]) -> list[dict]:
+    rows = []
+    for path in sorted(paths, key=_round_number):
+        row: dict = {"round": _round_number(path), "file": path}
+        try:
+            doc = load_bench(path)
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            row.update(flag="unreadable", note=str(e)[:120])
+            rows.append(row)
+            continue
+        row.update(
+            preset=doc.get("preset"),
+            toks_per_sec=doc.get("value"),
+            mfu_pct=doc.get("mfu_pct"),
+            rc_p50_ttft_ms=_rc_ttft(doc),
+        )
+        note = str(doc.get("note", "") or "")
+        if doc.get("error"):
+            row["flag"] = "error"
+            row["note"] = str(doc["error"])[:120]
+        elif "CPU fallback" in note or "not a TPU number" in note:
+            row["flag"] = "cpu-fallback"
+            row["note"] = note[:120]
+        elif not comparable(doc, doc.get("preset") or ""):
+            row["flag"] = "not-comparable"
+        else:
+            row["flag"] = ""
+        rows.append(row)
+    return rows
+
+
+def render_table(rows: list[dict]) -> str:
+    def fmt(v, nd=2):
+        return f"{v:.{nd}f}" if isinstance(v, (int, float)) else "-"
+
+    headers = ("round", "preset", "tok/s", "mfu%", "rc-ttft-ms", "flag")
+    table = [headers]
+    for r in rows:
+        table.append((
+            f"r{r['round']:02d}" if r["round"] >= 0 else r["file"],
+            str(r.get("preset") or "-"),
+            fmt(r.get("toks_per_sec")),
+            fmt(r.get("mfu_pct")),
+            fmt(r.get("rc_p50_ttft_ms"), 1),
+            r.get("flag") or "ok",
+        ))
+    widths = [max(len(row[i]) for row in table) for i in range(len(headers))]
+    lines = []
+    for i, row in enumerate(table):
+        lines.append("  ".join(c.ljust(w) for c, w in zip(row, widths)).rstrip())
+        if i == 0:
+            lines.append("  ".join("-" * w for w in widths))
+    flagged = [r for r in rows if r.get("flag")]
+    if flagged:
+        lines.append("")
+        for r in flagged:
+            lines.append(f"  r{r['round']:02d}: {r['flag']}" + (
+                f" — {r['note']}" if r.get("note") else ""
+            ))
+    return "\n".join(lines)
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "kubeai-bench-trend",
+        description="Render the committed bench-round trajectory as a table.",
+    )
+    parser.add_argument("--glob", default="BENCH_r*.json")
+    parser.add_argument("--json", action="store_true", help="emit rows as JSON")
+    args = parser.parse_args(argv)
+    paths = glob.glob(args.glob)
+    if not paths:
+        print(f"bench-trend: no files match {args.glob!r}", file=sys.stderr)
+        return 1
+    rows = trend_rows(paths)
+    if args.json:
+        print(json.dumps(rows, indent=1))
+    else:
+        print(render_table(rows))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,74 @@
+"""Runbook capture: dump EVERY debug surface a live operator mounts as
+one JSON document.
+
+The old ``make fleet-snapshot`` hardcoded three paths and silently
+missed every surface added since (/debug/tenants, /debug/incidents,
+/debug/routing, /debug/history, ...). This asks the operator itself —
+``GET /debug`` is the authoritative index of what it serves — so new
+surfaces ride along automatically and a failed surface is captured as
+an error entry instead of aborting the whole snapshot.
+
+    python benchmarks/fleet_snapshot.py [--url http://op:8000]
+    make fleet-snapshot [OPERATOR_URL=http://host:8000] > snap.json
+
+Output contract (unchanged): ONE JSON document keyed by path.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+import urllib.request
+
+
+def capture(base: str, timeout: float = 10.0) -> dict:
+    base = base.rstrip("/")
+
+    def get(path: str):
+        with urllib.request.urlopen(base + path, timeout=timeout) as r:
+            return json.load(r)
+
+    index = get("/debug")
+    doc: dict = {
+        "/debug": index,
+        "captured_at": time.time(),
+        "operator": base,
+    }
+    for ep in index.get("endpoints", []):
+        path = ep.get("path")
+        if not path or path == "/debug":
+            continue
+        try:
+            doc[path] = get(path)
+        except Exception as e:  # one dead surface must not void the capture
+            doc[path] = {"error": str(e)[:300]}
+    return doc
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        "kubeai-fleet-snapshot",
+        description="Capture every operator debug surface as one JSON document.",
+    )
+    parser.add_argument("--url", default="http://localhost:8000")
+    parser.add_argument("--timeout", type=float, default=10.0)
+    args = parser.parse_args(argv)
+    try:
+        doc = capture(args.url, timeout=args.timeout)
+    except Exception as e:
+        print(f"fleet-snapshot: cannot reach {args.url}/debug: {e}", file=sys.stderr)
+        return 1
+    print(json.dumps(doc, indent=1))
+    failed = sorted(
+        p for p, v in doc.items()
+        if isinstance(v, dict) and set(v) == {"error"}
+    )
+    if failed:
+        print(f"fleet-snapshot: {len(failed)} surface(s) failed: {', '.join(failed)}", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

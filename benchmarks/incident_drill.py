@@ -18,7 +18,13 @@ The drill:
    the acceptance bar: a PERSISTED incident whose report correlates
    >= 3 surfaces (SLO / fleet / autoscaler / traces / breaker) around
    the injected failure, and ``kubeai_canary_probes_total{outcome=
-   "error"}`` incremented.
+   "error"}`` incremented;
+5. proves the telemetry-flight-recorder closed loop: the incident
+   embeds a non-empty pre-trigger history window (samples predating
+   the trigger), the report renders it as sparklines,
+   ``/debug/history`` answers range queries on BOTH servers, and a
+   store restarted over the same directory serves the pre-restart
+   trajectories with an explicit ``restart`` gap marker.
 
 Run: ``make incident-drill`` (artifacts under build/incident-drill/).
 ``--fast`` is the tier-1 variant (tests/test_incidents.py runs it).
@@ -50,6 +56,12 @@ from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.engine.server import EngineServer
 from kubeai_tpu.loadbalancer.balancer import LoadBalancer
 from kubeai_tpu.obs.canary import CanaryProber, M_PROBES, install_canary, uninstall_canary
+from kubeai_tpu.obs.history import (
+    HistoryStore,
+    RegistrySampler,
+    install_history,
+    uninstall_history,
+)
 from kubeai_tpu.obs.incident_report import render_incident
 from kubeai_tpu.obs.incidents import (
     IncidentRecorder,
@@ -113,6 +125,11 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
     for stale in os.listdir(incident_dir):
         if stale.startswith("incident-"):
             os.remove(os.path.join(incident_dir, stale))
+    history_dir = os.path.join(os.path.dirname(incident_dir), "history")
+    os.makedirs(history_dir, exist_ok=True)
+    for stale in os.listdir(history_dir):
+        if stale.startswith("history-"):
+            os.remove(os.path.join(history_dir, stale))
 
     # -- the real stack ----------------------------------------------------
     store = Store()
@@ -140,10 +157,18 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
         proxy, mc, lb, interval_seconds=0.5, timeout_seconds=10,
         max_tokens=4, election=election, enabled=True,
     )
+    # Telemetry flight recorder, wired exactly as the manager wires it:
+    # registry sampler (ticked manually — the drill owns its clock), the
+    # fleet collector feeding per-endpoint scrapes in, and the store as
+    # an incident snapshot source. Installed BEFORE the engine server
+    # starts so both HTTP servers share this one store in-process.
+    history = HistoryStore(history_dir=history_dir, flush_seconds=0.0)
+    history_sampler = RegistrySampler(history, interval_seconds=0.5)
+    fleet.history = history
     recorder = IncidentRecorder(
         sources=standard_sources(
             lb, mc, fleet=fleet, decision_log=autoscaler.decisions,
-            slo=slo, canary=canary,
+            slo=slo, canary=canary, history=history,
         ),
         incident_dir=incident_dir,
         debounce_seconds=2.0,
@@ -151,6 +176,7 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
     )
     install_recorder(recorder)
     install_canary(canary)
+    install_history(history)
 
     eng = build_test_engine(
         engine_config=EngineConfig(
@@ -195,6 +221,10 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
         _await(lambda: lb.get_all_addresses(MODEL), msg="endpoint")
 
         # -- phase 1: healthy baseline ------------------------------------
+        # First sampler sweep anchors every counter; the sweep after the
+        # healthy streams turns the deltas into rates — the pre-trigger
+        # trajectory the incident snapshot must carry.
+        history_sampler.tick()
         body = {
             "model": MODEL, "prompt": "count with me", "stream": True,
             "temperature": 0, "max_tokens": 4,
@@ -207,6 +237,8 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
         assert baseline["outcome"] == "ok", f"canary baseline not ok: {baseline}"
         autoscaler.tick()
         slo.tick()
+        history_sampler.tick()
+        t_pre_trigger = time.time()
         summary["baseline"] = {
             "canary_fingerprint": baseline["fingerprint"],
             "canary_e2e_s": baseline["e2e_s"],
@@ -231,6 +263,7 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
         canary.tick()
         autoscaler.tick()
         slo.tick()
+        history_sampler.tick()
         recorder.wait_idle(timeout=15)
         incidents = recorder.snapshot()
         assert incidents, "no incident captured after the injected failure"
@@ -272,6 +305,67 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
             "persisted_files": len(on_disk),
             "correlated_surfaces": correlated,
         }
+
+        # -- phase 4: telemetry flight recorder closed loop ---------------
+        # (a) the snapshot embeds a non-empty pre-incident window whose
+        # samples predate the trigger — the "what led up to this" record
+        # that outlives scrape intervals and pod restarts.
+        assert "history" in doc["sections_ok"], (
+            f"incident captured without the history section: {doc['sections_ok']}"
+        )
+        hist_section = doc["sections"]["history"]
+        sample_ts = [
+            pt[0]
+            for s in hist_section.get("series", {}).values()
+            for pt in s.get("points", ())
+        ]
+        assert sample_ts, "incident history section carries no samples"
+        assert min(sample_ts) <= t_pre_trigger, (
+            "history window holds no pre-trigger samples "
+            f"(earliest {min(sample_ts):.1f} vs pre-trigger {t_pre_trigger:.1f})"
+        )
+        assert min(sample_ts) < doc["t"], (
+            "history samples do not predate the trigger timestamp"
+        )
+        # (b) the report renders the pre-trigger window as sparklines.
+        assert f"  {'history':<10s}" in report, (
+            "report carries no history timeline entries"
+        )
+        assert any(ch in report for ch in "▁▂▃▄▅▆▇█·"), (
+            "report history entries carry no sparkline cells"
+        )
+        # (c) /debug/history answers range queries on BOTH servers — the
+        # drill installs one shared store before the engine starts, so
+        # operator and engine serve the same trajectories in-process.
+        history_points = {}
+        for side, port in (("operator", api.port), ("engine", srv.port)):
+            url = (
+                f"http://127.0.0.1:{port}/debug/history"
+                "?series=kubeai_*,fleet.*&since=600"
+            )
+            with urllib.request.urlopen(url, timeout=10) as resp:
+                hdoc = json.loads(resp.read().decode())
+            pts = sum(len(s["points"]) for s in hdoc["series"].values())
+            assert pts > 0, f"{side} /debug/history answered no points ({url})"
+            history_points[side] = pts
+        # (d) restart survival: a fresh store over the same directory
+        # serves the pre-restart trajectories from disk and marks the
+        # outage honestly instead of papering over it.
+        history.save(force=True)
+        restarted = HistoryStore(history_dir=history_dir)
+        carried = set(restarted.series_names()) & set(history.series_names())
+        assert carried, "restarted store recovered no pre-restart series"
+        restart_gaps = [g for g in restarted.gaps() if g["reason"] == "restart"]
+        assert restart_gaps, "restarted store did not mark the restart gap"
+        summary["history"] = {
+            "context_series": len(hist_section.get("series", {})),
+            "earliest_sample_before_trigger_s": round(
+                doc["t"] - min(sample_ts), 3
+            ),
+            "debug_history_points": history_points,
+            "restart_series_recovered": len(carried),
+            "restart_gap_marked": True,
+        }
         summary["ok"] = True
         summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
         if verbose:
@@ -281,6 +375,7 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
         faults.clear_all()
         uninstall_canary(canary)
         uninstall_recorder(recorder)
+        uninstall_history(history)
         # Join the capture worker too: a stranded daemon thread's source
         # closures would pin this whole stack for the rest of the
         # process (the fast drill runs in-process under pytest).

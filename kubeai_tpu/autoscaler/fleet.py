@@ -198,6 +198,12 @@ class FleetCollector:
         # pool that disappears (mode flipped back to unified) has its
         # series REMOVED rather than frozen at the last pre-flip value.
         self._pool_roles: dict[str, set[str]] = {}
+        # Optional HistoryStore sink (manager wires it): every collect
+        # feeds the per-endpoint scrape values into the operator-side
+        # history, so a crashed engine pod's trajectory outlives the
+        # pod — the replica that died is exactly the one whose local
+        # history is lost.
+        self.history = None
 
     # -- scraping ----------------------------------------------------------
 
@@ -365,6 +371,11 @@ class FleetCollector:
                 self._addr_seen.pop(addr, None)
                 self._prev_tokens.pop(addr, None)
                 self._last_pages.pop(addr, None)
+        if self.history is not None:
+            try:
+                self.history.record_fleet(views)
+            except Exception:
+                pass  # a history sink bug must never break the scrape path
         return views
 
     # -- consumers ---------------------------------------------------------

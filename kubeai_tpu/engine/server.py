@@ -36,13 +36,23 @@ from kubeai_tpu.engine.core import Engine
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.faults import FaultError, fault, handle_faults_request
 from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.metrics.buildinfo import set_build_info
 from kubeai_tpu.obs import (
     debug_index_response,
     extract_context,
     handle_canary_request,
     handle_debug_request,
+    handle_history_request,
     handle_incident_request,
     handle_tenant_request,
+)
+from kubeai_tpu.obs.history import (
+    HistoryStore,
+    RegistrySampler,
+    history_dir_default,
+    install_history,
+    installed_history,
+    uninstall_history,
 )
 from kubeai_tpu.obs.perf import handle_perf_request
 from kubeai_tpu.obs.tenants import TENANT_HEADER, sanitize_tenant
@@ -117,8 +127,22 @@ class EngineServer:
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
         self._thread: threading.Thread | None = None
+        # Engine-local telemetry flight recorder (only when this process
+        # doesn't already run one — in-process test stacks colocate an
+        # operator whose store then serves both servers). Ownership is
+        # tracked so stop() only tears down what start() installed.
+        self._history = None
+        self._history_sampler = None
 
     def start(self):
+        set_build_info("engine")
+        if installed_history() is None:
+            self._history = HistoryStore(
+                history_dir=os.path.join(history_dir_default(), "engine"),
+            )
+            self._history_sampler = RegistrySampler(self._history)
+            install_history(self._history)
+            self._history_sampler.start()
         if self.engine is not None:
             self.engine.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
@@ -145,6 +169,13 @@ class EngineServer:
             if self.engine is not None:
                 self.engine.stop()
         finally:
+            if self._history_sampler is not None:
+                self._history_sampler.stop()
+                self._history_sampler = None
+            if self._history is not None:
+                # Identity-checked: a newer owner's install survives.
+                uninstall_history(self._history)
+                self._history = None
             self.httpd.shutdown()
             self.stopped_event.set()
 
@@ -367,6 +398,7 @@ def _make_handler(srv: EngineServer):
                     # An engine process's accountant carries its own
                     # cost accumulations (slot/page-seconds by tenant).
                     or handle_tenant_request(path, query)
+                    or handle_history_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:

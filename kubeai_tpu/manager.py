@@ -21,6 +21,13 @@ from kubeai_tpu.autoscaler.autoscaler import Autoscaler
 from kubeai_tpu.autoscaler.fleet import FleetCollector
 from kubeai_tpu.autoscaler.leader import Election
 from kubeai_tpu.obs.canary import CanaryProber, install_canary, uninstall_canary
+from kubeai_tpu.obs.history import (
+    HistoryStore,
+    RegistrySampler,
+    history_dir_default,
+    install_history,
+    uninstall_history,
+)
 from kubeai_tpu.obs.incidents import (
     IncidentRecorder,
     install_recorder,
@@ -137,6 +144,18 @@ class Manager:
         self.canary = CanaryProber(
             self.proxy, self.model_client, self.lb, election=self.election
         )
+        # Telemetry flight recorder: tiered on-disk history of the live
+        # registry plus the fleet collector's per-endpoint scrapes (so a
+        # crashed engine pod's trajectory outlives the pod). The
+        # "operator" subdir keeps dev-mode colocated operator+engine
+        # processes from clobbering each other's ring.
+        self.history = HistoryStore(
+            history_dir=os.path.join(history_dir_default(), "operator"),
+        )
+        self.history_sampler = RegistrySampler(
+            self.history, election=self.election
+        )
+        self.fleet.history = self.history
         self.incidents = IncidentRecorder(
             sources=standard_sources(
                 self.lb,
@@ -145,6 +164,7 @@ class Manager:
                 decision_log=self.autoscaler.decisions,
                 slo=self.slo,
                 canary=self.canary,
+                history=self.history,
             ),
             election=self.election,
             # By-ADDR pages (not the flat list): the counter watch
@@ -156,6 +176,7 @@ class Manager:
         )
         install_recorder(self.incidents)
         install_canary(self.canary)
+        install_history(self.history)
         self.messengers = [
             Messenger(
                 stream.requests_url,
@@ -177,6 +198,7 @@ class Manager:
         self.election.start()
         self.autoscaler.start()
         self.slo.start()
+        self.history_sampler.start()
         self.incidents.start()
         self.canary.start()
         if self.local_runtime:
@@ -205,6 +227,8 @@ class Manager:
         # (tests build several per process) must survive this stop.
         uninstall_canary(self.canary)
         uninstall_recorder(self.incidents)
+        self.history_sampler.stop()
+        uninstall_history(self.history)
         self.slo.stop()
         self.autoscaler.stop()
         self.election.stop()

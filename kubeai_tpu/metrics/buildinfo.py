@@ -1,0 +1,42 @@
+"""kubeai_build_info: the Prometheus build-identity idiom (value
+always 1, identity in labels) so scrapes, fleet snapshots, and incident
+evidence all state what build produced them. jax's version comes from
+package metadata, NEVER ``import jax`` — setting a gauge must not pull
+a TPU runtime into the operator process."""
+
+from __future__ import annotations
+
+import platform
+
+from kubeai_tpu.metrics.registry import default_registry
+
+M_BUILD_INFO = default_registry.gauge(
+    "kubeai_build_info",
+    "Build identity (value 1; version/server/python/jax in labels)",
+)
+
+
+def _jax_version() -> str:
+    try:
+        from importlib.metadata import version
+
+        return version("jax")
+    except Exception:
+        return "absent"
+
+
+def set_build_info(server: str) -> None:
+    """Publish the build-info series for this process. *server* is the
+    kind exposing it ("operator" | "engine"); both servers call this at
+    start so a mixed-version fleet is visible from the scrape alone."""
+    from kubeai_tpu import __version__
+
+    M_BUILD_INFO.set(
+        1.0,
+        labels={
+            "version": __version__,
+            "server": server,
+            "python": platform.python_version(),
+            "jax": _jax_version(),
+        },
+    )

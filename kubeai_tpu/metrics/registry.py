@@ -232,6 +232,13 @@ class Registry:
         with self._lock:
             return self._metrics.get(name)
 
+    def metrics(self) -> dict[str, _Metric]:
+        """Point-in-time copy of the full name -> metric map — the seam
+        the history sampler iterates to auto-discover every registered
+        series without hardcoding names."""
+        with self._lock:
+            return dict(self._metrics)
+
     def _get_or_create(self, name, help_, cls, factory=None):
         with self._lock:
             m = self._metrics.get(name)

@@ -13,6 +13,15 @@ from kubeai_tpu.obs.canary import (
     install_canary,
     uninstall_canary,
 )
+from kubeai_tpu.obs.history import (
+    HistoryStore,
+    RegistrySampler,
+    handle_history_request,
+    install_history,
+    installed_history,
+    sparkline,
+    uninstall_history,
+)
 from kubeai_tpu.obs.incidents import (
     IncidentRecorder,
     handle_incident_request,
@@ -54,6 +63,13 @@ __all__ = [
     "handle_canary_request",
     "install_canary",
     "uninstall_canary",
+    "HistoryStore",
+    "RegistrySampler",
+    "handle_history_request",
+    "install_history",
+    "installed_history",
+    "sparkline",
+    "uninstall_history",
     "IncidentRecorder",
     "handle_incident_request",
     "install_recorder",

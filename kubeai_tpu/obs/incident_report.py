@@ -221,6 +221,67 @@ def _routing_entries(section: dict, captured_at: float) -> list:
     return out
 
 
+def _history_entries(section: dict, t0: float, limit: int = 12) -> list:
+    """The pre-trigger window as sparklines: each curated series drawn
+    over the captured context window, min..max annotated so the shape
+    reads in absolute terms. Entries are stamped at the window START so
+    they sort BEFORE the trigger — the timeline literally begins with
+    what led up to it."""
+    from kubeai_tpu.obs.history import sparkline
+
+    out = []
+    since = section.get("since", t0)
+    window = section.get("window_seconds")
+    series = section.get("series") or {}
+    # Widest dynamic range first: the series that MOVED are the story.
+    def spread(rows):
+        vals = [r[5] for r in (rows.get("points") or []) if isinstance(r, list)]
+        if not vals:
+            return -1.0
+        lo, hi = min(vals), max(vals)
+        return (hi - lo) / (abs(hi) + 1e-9)
+
+    ranked = sorted(series.items(), key=lambda kv: -spread(kv[1]))
+    shown = 0
+    for name, rows in ranked:
+        pts = rows.get("points") or []
+        if not pts:
+            continue
+        if shown >= limit:
+            out.append(_entry(
+                since, "history",
+                f"(+{len(ranked) - limit} more series in sections.history)",
+            ))
+            break
+        shown += 1
+        # Bucket the LAST values onto a fixed grid so gaps render as
+        # holes; per-bucket max would also be defensible, but last
+        # matches what an operator watching a gauge would have seen.
+        step = rows.get("step_seconds") or 1.0
+        until = section.get("until", t0)
+        n_cells = max(min(int((until - since) / step) + 1, 60), 1)
+        cells: list[float | None] = [None] * n_cells
+        lo = hi = None
+        for r in pts:
+            idx = int((r[0] - since) / max((until - since) / n_cells, 1e-9))
+            if 0 <= idx < n_cells:
+                cells[idx] = r[5]
+            lo = r[3] if lo is None else min(lo, r[3])
+            hi = r[4] if hi is None else max(hi, r[4])
+        out.append(_entry(
+            since, "history",
+            f"{name} [{lo:.4g}..{hi:.4g}] {sparkline(cells)}"
+            + (f" ({window:.0f}s window)" if window else ""),
+        ))
+    for g in section.get("gaps") or []:
+        out.append(_entry(
+            g.get("since", since), "history",
+            f"<gap: {g.get('reason')} "
+            f"{max(g.get('until', 0) - g.get('since', 0), 0):.0f}s — no samples>",
+        ))
+    return out
+
+
 def render_incident(doc: dict) -> str:
     """The human-readable correlated timeline for one incident doc."""
     t0 = doc.get("t", 0.0)
@@ -253,6 +314,7 @@ def render_incident(doc: dict) -> str:
         "fleet": lambda s: _fleet_entries(s, t0),
         "routing": lambda s: _routing_entries(s, t0),
         "tenants": lambda s: _tenant_entries(s, t0),
+        "history": lambda s: _history_entries(s, t0),
     }
     for name, fn in handlers.items():
         sec = sections.get(name)

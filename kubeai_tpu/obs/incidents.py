@@ -813,6 +813,7 @@ def standard_sources(
     decision_log=None,
     slo=None,
     canary=None,
+    history=None,
     trace_limit: int = 30,
 ) -> dict:
     """The canonical snapshot-source set over the operator's debug
@@ -850,6 +851,11 @@ def standard_sources(
         }
     if canary is not None:
         sources["canary"] = canary.report
+    if history is not None:
+        # The flight recorder's pre-trigger window: the last
+        # KUBEAI_INCIDENT_CONTEXT_SECONDS of the curated key-series set,
+        # so every snapshot answers "what changed before it broke".
+        sources["history"] = history.context_block
     return sources
 
 

@@ -376,6 +376,8 @@ DEBUG_INDEX: tuple[tuple[str, str, str], ...] = (
      "fleet saturation: per-endpoint engine scrapes, per-model aggregates, capacity headroom"),
     ("/debug/slo", "operator",
      "SLO monitor report: attainment + burn rate per objective over the rolling window"),
+    ("/debug/history", "both",
+     "embedded time-series history: tiered metric trajectories with gap markers (?series=&since=&step=)"),
     ("/debug/pipeline", "engine",
      "windowed decode stall attribution (dispatch/host_overlap/fetch_wait/emit) + live MFU/roofline"),
     ("/debug/profile", "engine",

@@ -99,6 +99,29 @@ def default_objectives() -> list[SLObjective]:
     ]
 
 
+def bucket_quantile(bounds, counts, q: float) -> float | None:
+    """Quantile estimate from per-bucket observation counts
+    (NON-cumulative, +Inf slot last — ``Histogram.snapshot()`` layout):
+    the upper bound of the bucket the q-th observation lands in. Returns
+    None with no observations. A quantile landing in the +Inf overflow
+    clamps DOWN to the largest finite bound — the estimate is then a
+    floor, honest the same way the latency objectives' threshold clamp
+    is: it can understate a spike, never invent one. Shared by the SLO
+    math and the history sampler's p50/p95 derivation, so the two can't
+    disagree about what a histogram says."""
+    total = sum(counts)
+    if total <= 0 or not bounds:
+        return None
+    target = q * total
+    cum = 0.0
+    finite = list(bounds)
+    for b, c in zip(finite + [float("inf")], counts):
+        cum += c
+        if cum >= target - 1e-9:
+            return float(b) if b != float("inf") else float(finite[-1])
+    return float(finite[-1])
+
+
 def burn_rate(attainment: float, target: float) -> float:
     """Error-budget burn multiple: 1.0 = failing exactly (1-target) of
     requests; >1 = budget burning faster than it accrues."""

@@ -20,10 +20,12 @@ import time
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.faults import handle_faults_request
 from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.metrics.buildinfo import set_build_info
 from kubeai_tpu.obs import (
     debug_index_response,
     handle_canary_request,
     handle_debug_request,
+    handle_history_request,
     handle_incident_request,
     handle_tenant_request,
 )
@@ -74,6 +76,7 @@ class OpenAIServer:
         self.election = None
 
     def start(self):
+        set_build_info("operator")
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("openai server on :%d", self.port)
@@ -273,6 +276,7 @@ def _make_handler(srv: OpenAIServer):
                     or handle_incident_request(path, query)
                     or handle_canary_request(path, query)
                     or handle_tenant_request(path, query)
+                    or handle_history_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:

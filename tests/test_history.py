@@ -1,0 +1,437 @@
+"""Telemetry flight recorder (kubeai_tpu/obs/history.py): tiered
+downsample conservation, counter-reset re-anchoring, restart survival
+with honest gap markers, memory/disk bounds, concurrent
+sample-vs-query safety, and the /debug/history HTTP contract."""
+
+import json
+import os
+import threading
+
+import pytest
+
+from kubeai_tpu.metrics.registry import Registry
+from kubeai_tpu.obs.history import (
+    DEFAULT_TIERS,
+    HistoryStore,
+    RegistrySampler,
+    handle_history_request,
+    install_history,
+    installed_history,
+    sparkline,
+    uninstall_history,
+)
+
+
+class FakeWall:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def make_store(tmp_path=None, **kw):
+    kw.setdefault("wall", FakeWall())
+    return HistoryStore(
+        history_dir=str(tmp_path) if tmp_path is not None else "",
+        **kw,
+    )
+
+
+class TestDownsampleConservation:
+    def test_bucket_stats_exact_vs_hand_computed(self):
+        wall = FakeWall(1000.0)
+        s = make_store(wall=wall)
+        # 13 samples inside one 60s bucket, spanning several 5s buckets.
+        values = [3.0, 1.0, 4.0, 1.0, 5.0, 9.0, 2.0, 6.0, 5.0, 3.0, 5.0, 8.0, 9.0]
+        for i, v in enumerate(values):
+            s.record("m", v, t=1200.0 + i * 4.0)
+        wall.t = 1300.0
+        q = s.query(["m"], since=1190.0, step=60.0)
+        pts = q["series"]["m"]["points"]
+        assert len(pts) == 1
+        t0, n, total, lo, hi, last = pts[0]
+        assert t0 == 1200.0
+        assert n == len(values)
+        assert total == pytest.approx(sum(values))
+        assert lo == min(values) and hi == max(values)
+        assert last == values[-1]
+
+    def test_rebucket_merge_conserves_across_buckets(self):
+        wall = FakeWall(1100.0)
+        s = make_store(wall=wall)
+        for i in range(20):
+            s.record("m", float(i), t=1000.0 + i * 5.0)
+        q = s.query(["m"], since=995.0, step=20.0)
+        pts = q["series"]["m"]["points"]
+        assert [p[0] for p in pts] == [1000.0, 1020.0, 1040.0, 1060.0, 1080.0]
+        assert sum(p[1] for p in pts) == 20
+        assert sum(p[2] for p in pts) == pytest.approx(sum(range(20)))
+        assert pts[0][3] == 0.0 and pts[-1][4] == 19.0
+        assert pts[-1][5] == 19.0  # last of the latest bucket
+
+    def test_every_tier_accumulates_independently(self):
+        wall = FakeWall(1000.0)
+        s = make_store(wall=wall)
+        for i in range(100):
+            s.record("m", 1.0, t=1000.0 + i * 5.0)
+        with s._lock:
+            series = s._series["m"]
+            for (step, _), buckets in zip(s.tiers, series.tiers):
+                assert sum(b[1] for b in buckets) == 100, f"tier {step}s lost samples"
+                assert sum(b[2] for b in buckets) == pytest.approx(100.0)
+
+    def test_spike_survives_coarsest_tier(self):
+        wall = FakeWall(1000.0)
+        s = make_store(wall=wall)
+        for i in range(200):
+            s.record("m", 1000.0 if i == 117 else 1.0, t=1000.0 + i * 5.0)
+        # Ask at 600s granularity: the max column still carries the spike.
+        wall.t = 1000.0 + 200 * 5.0
+        q = s.query(["m"], since=900.0, step=600.0)
+        assert max(p[4] for p in q["series"]["m"]["points"]) == 1000.0
+
+    def test_tier_fallback_when_finest_no_longer_covers(self):
+        wall = FakeWall(1000.0)
+        s = make_store(wall=wall)
+        s.record("m", 7.0, t=1000.0)
+        # 2 days later the 5s and 60s tiers can't reach back that far.
+        wall.t = 1000.0 + 2 * 86400
+        q = s.query(["m"], since=990.0)
+        assert q["series"]["m"]["tier_step_seconds"] == DEFAULT_TIERS[-1][0]
+        assert q["series"]["m"]["points"][0][5] == 7.0
+
+
+class TestSampler:
+    def _setup(self):
+        reg = Registry()
+        wall = FakeWall(2000.0)
+        mono = FakeWall(0.0)
+        store = make_store(wall=wall)
+        samp = RegistrySampler(
+            store, registry=reg, interval_seconds=5.0,
+            clock=mono, wall=wall,
+        )
+        return reg, store, samp, mono, wall
+
+    def test_counter_becomes_rate(self):
+        reg, store, samp, mono, wall = self._setup()
+        c = reg.counter("kubeai_x_total", "h")
+        c.inc(10)
+        samp.tick()  # anchor only
+        assert store.series_names() == []
+        mono.advance(5); wall.advance(5)
+        c.inc(25)
+        samp.tick()
+        pts = store.query(["kubeai_x_total"], since=1990.0)["series"]["kubeai_x_total"]["points"]
+        assert pts[-1][5] == pytest.approx(5.0)  # 25 over 5s
+
+    def test_counter_reset_reanchors_no_negative_rate(self):
+        reg, store, samp, mono, wall = self._setup()
+        c = reg.counter("kubeai_x_total", "h")
+        c.inc(100)
+        samp.tick()
+        mono.advance(5); wall.advance(5)
+        with c._lock:
+            c._values.clear()  # process restart: counter starts over
+        c.inc(3)
+        samp.tick()  # backwards total: re-anchor, record nothing
+        mono.advance(5); wall.advance(5)
+        c.inc(12)
+        samp.tick()
+        pts = store.query(["kubeai_x_total"], since=1990.0)["series"]["kubeai_x_total"]["points"]
+        vals = [p[5] for p in pts]
+        assert all(v >= 0 for v in vals)
+        assert vals[-1] == pytest.approx(12 / 5)
+
+    def test_gauge_sampled_per_label_series(self):
+        reg, store, samp, mono, wall = self._setup()
+        g = reg.gauge("kubeai_g", "h")
+        g.set(3.0, labels={"model": "m1"})
+        g.set(9.0, labels={"model": "m2"})
+        samp.tick()
+        names = store.series_names()
+        assert "kubeai_g{model=m1}" in names and "kubeai_g{model=m2}" in names
+
+    def test_key_histogram_p50_p95_from_window_deltas(self):
+        reg, store, samp, mono, wall = self._setup()
+        h = reg.histogram("kubeai_engine_ttft_seconds", "h")
+        h.observe(0.2)
+        samp.tick()  # baseline snapshot
+        mono.advance(5); wall.advance(5)
+        for _ in range(18):
+            h.observe(0.07)
+        h.observe(4.0)
+        h.observe(4.0)  # two slow outliers in THIS window
+        samp.tick()
+        q = store.query(
+            ["kubeai_engine_ttft_seconds_p50", "kubeai_engine_ttft_seconds_p95"],
+            since=1990.0,
+        )
+        p50 = q["series"]["kubeai_engine_ttft_seconds_p50"]["points"][-1][5]
+        p95 = q["series"]["kubeai_engine_ttft_seconds_p95"]["points"][-1][5]
+        assert p50 == pytest.approx(0.1)   # bucket bound above 0.07
+        assert p95 == pytest.approx(5.0)   # bucket bound above 4.0
+        # The pre-window 0.2 observation did NOT leak into this
+        # window's quantiles, and the derived series only exists for
+        # windows with traffic: exactly one point.
+        assert len(q["series"]["kubeai_engine_ttft_seconds_p50"]["points"]) == 1
+
+    def test_stalled_cadence_marks_gap(self):
+        reg, store, samp, mono, wall = self._setup()
+        samp.tick()
+        mono.advance(100); wall.advance(100)  # >3x the 5s interval
+        samp.tick()
+        assert any(g["reason"] == "sampler_stall" for g in store.gaps())
+
+    def test_leadership_transition_marks_gap(self):
+        class Election:
+            def __init__(self):
+                self.is_leader = threading.Event()
+
+        reg = Registry()
+        wall = FakeWall(2000.0)
+        store = make_store(wall=wall)
+        el = Election()
+        samp = RegistrySampler(
+            store, registry=reg, interval_seconds=5.0,
+            clock=FakeWall(0.0), wall=wall, election=el,
+        )
+        samp.tick()
+        el.is_leader.set()
+        samp.tick()
+        assert any(g["reason"] == "leadership_change" for g in store.gaps())
+
+
+class TestRestartSurvival:
+    def test_history_survives_restart_with_gap_marker(self, tmp_path):
+        wall = FakeWall(5000.0)
+        s1 = make_store(tmp_path, wall=wall, flush_seconds=0.0)
+        for i in range(10):
+            s1.record("kubeai_engine_mfu", 0.3 + i * 0.01, t=4000.0 + i * 5)
+        s1.save(force=True)
+        # New process, same dir: pre-restart series present, dead
+        # stretch marked.
+        wall2 = FakeWall(6000.0)
+        s2 = HistoryStore(history_dir=str(tmp_path), wall=wall2)
+        assert "kubeai_engine_mfu" in s2.series_names()
+        q = s2.query(["kubeai_engine_mfu"], since=3990.0)
+        assert sum(p[1] for p in q["series"]["kubeai_engine_mfu"]["points"]) == 10
+        restarts = [g for g in s2.gaps() if g["reason"] == "restart"]
+        assert restarts and restarts[-1]["since"] == pytest.approx(4045.0)
+        assert restarts[-1]["until"] == pytest.approx(6000.0)
+
+    def test_corrupt_newest_snapshot_falls_back_to_older(self, tmp_path):
+        wall = FakeWall(5000.0)
+        s1 = make_store(tmp_path, wall=wall, flush_seconds=0.0)
+        s1.record("m", 1.0, t=4999.0)
+        s1.save(force=True)
+        corrupt = tmp_path / "history-9999999999999.json"
+        corrupt.write_text("{not json")
+        s2 = HistoryStore(history_dir=str(tmp_path), wall=FakeWall(6000.0))
+        assert "m" in s2.series_names()
+
+    def test_io_failure_degrades_to_memory_only(self):
+        s = HistoryStore(
+            history_dir="/dev/null/not-a-dir", wall=FakeWall(), flush_seconds=0.0
+        )
+        s.record("m", 1.0)
+        s.save(force=True)  # must not raise
+        assert s.series_names() == ["m"]
+
+
+class TestBounds:
+    def test_memory_bound_per_series(self):
+        wall = FakeWall(0.0)
+        s = HistoryStore(
+            history_dir="", tiers=((5.0, 10), (60.0, 5)), wall=wall
+        )
+        for i in range(10_000):
+            s.record("m", 1.0, t=float(i * 5))
+        with s._lock:
+            assert len(s._series["m"].tiers[0]) == 10
+            assert len(s._series["m"].tiers[1]) == 5
+
+    def test_series_cardinality_bound(self):
+        s = make_store(max_series=8)
+        for i in range(50):
+            s.record(f"m{i}", 1.0, t=100.0)
+        assert len(s.series_names()) == 8
+        assert s.dropped_series == 42
+        assert s.report()["dropped_series"] == 42
+
+    def test_disk_ring_pruned(self, tmp_path):
+        wall = FakeWall(1000.0)
+        s = make_store(tmp_path, wall=wall, flush_seconds=0.0, max_files=3)
+        for _ in range(10):
+            wall.advance(100)
+            s.record("m", 1.0)
+            s.save(force=True)
+        files = [n for n in os.listdir(tmp_path) if n.endswith(".json")]
+        assert len(files) == 3
+        assert not any(n.endswith(".tmp") for n in os.listdir(tmp_path))
+
+    def test_gap_markers_bounded(self):
+        s = make_store()
+        for i in range(500):
+            s.mark_gap("restart", since=float(i), t=float(i + 1))
+        assert len(s.gaps()) <= 64
+
+
+class TestConcurrency:
+    def test_sample_vs_query_race_free(self):
+        wall = FakeWall(0.0)
+        s = make_store(wall=wall)
+        stop = threading.Event()
+        errors = []
+
+        def writer():
+            i = 0
+            while not stop.is_set():
+                s.record(f"m{i % 5}", float(i), t=float(i))
+                i += 1
+
+        def reader():
+            while not stop.is_set():
+                try:
+                    wall.t += 1.0
+                    q = s.query([f"m{i}" for i in range(5)], since=0.0, step=60.0)
+                    for rows in q["series"].values():
+                        for p in rows["points"]:
+                            assert p[3] <= p[4]  # min <= max always
+                    s.series_names()
+                    s.report()
+                except Exception as e:  # pragma: no cover
+                    errors.append(e)
+                    stop.set()
+
+        threads = [threading.Thread(target=writer)] + [
+            threading.Thread(target=reader) for _ in range(3)
+        ]
+        for t in threads:
+            t.start()
+        import time as _time
+
+        _time.sleep(0.5)
+        stop.set()
+        for t in threads:
+            t.join(timeout=5)
+        assert not errors
+
+
+class TestHttpHandler:
+    def test_other_paths_pass_through(self):
+        assert handle_history_request("/debug/fleet") is None
+
+    def test_404_without_store(self):
+        assert installed_history() is None
+        code, ctype, body = handle_history_request("/debug/history")
+        assert code == 404 and b"no history store" in body
+
+    def test_index_and_range_query(self):
+        wall = FakeWall(1_000_000.0)
+        s = make_store(wall=wall)
+        for i in range(10):
+            s.record("kubeai_g", float(i), t=1_000_000.0 - 50 + i * 5)
+        install_history(s)
+        try:
+            code, _, body = handle_history_request("/debug/history")
+            assert code == 200
+            doc = json.loads(body)
+            assert "kubeai_g" in doc["series"]
+            assert doc["tiers"][0]["step_seconds"] == 5.0
+            # since as seconds-ago + prefix wildcard
+            code, _, body = handle_history_request(
+                "/debug/history", "series=kubeai_*&since=600"
+            )
+            doc = json.loads(body)
+            assert sum(p[1] for p in doc["series"]["kubeai_g"]["points"]) == 10
+        finally:
+            uninstall_history(s)
+
+    def test_install_identity_checked(self):
+        a, b = make_store(), make_store()
+        install_history(a)
+        install_history(b)
+        uninstall_history(a)  # stale owner: must not clobber b
+        assert installed_history() is b
+        uninstall_history(b)
+        assert installed_history() is None
+
+
+class TestFleetFeed:
+    def test_record_fleet_series(self):
+        s = make_store(wall=FakeWall(100.0))
+        views = {
+            "m1": {
+                "endpoints": [
+                    {
+                        "address": "1.2.3.4:8000", "ok": True,
+                        "queue_depth": 2.0, "active_slots": 3.0,
+                        "tokens_per_second": 120.0, "pages_used": 40.0,
+                        "prefix_hit_ratio": 0.5, "breaker_state": "open",
+                    },
+                    {"address": "dead:8000", "ok": False},
+                ],
+                "aggregate": {
+                    "queue_depth": 2.0, "active_slots": 3.0,
+                    "tokens_per_second": 120.0, "free_pages": 60.0,
+                    "headroom_requests": 5.0, "prefix_hit_ratio": 0.5,
+                },
+                "pools": {
+                    "decode": {"queue_depth": 1.0, "active_slots": 2.0},
+                },
+            }
+        }
+        s.record_fleet(views)
+        names = s.series_names()
+        assert "fleet.m1.tokens_per_second" in names
+        assert "fleet.m1.1.2.3.4:8000.queue_depth" in names
+        assert "fleet.m1.1.2.3.4:8000.breaker_state" in names
+        assert "fleet.m1.pool.decode.queue_depth" in names
+        # Dead endpoint contributes nothing.
+        assert not any("dead:8000" in n for n in names)
+        q = s.query(["fleet.m1.1.2.3.4:8000.breaker_state"], since=90.0)
+        assert q["series"]["fleet.m1.1.2.3.4:8000.breaker_state"]["points"][0][5] == 2.0
+
+    def test_context_block_curates_and_bounds(self):
+        wall = FakeWall(10_000.0)
+        s = make_store(wall=wall)
+        s.record("kubeai_engine_mfu", 0.4, t=9_800.0)
+        s.record("fleet.m1.tokens_per_second", 50.0, t=9_800.0)
+        s.record("kubeai_uncurated_gauge", 1.0, t=9_800.0)
+        blk = s.context_block(seconds=600.0)
+        assert set(blk["series"]) == {
+            "kubeai_engine_mfu", "fleet.m1.tokens_per_second"
+        }
+        assert blk["window_seconds"] == 600.0
+        # Every embedded sample predates the capture instant.
+        for rows in blk["series"].values():
+            assert all(p[0] <= blk["captured_at"] for p in rows["points"])
+
+
+def test_sparkline_shapes():
+    assert sparkline([]) == ""
+    assert sparkline([None, None]) == "··"
+    line = sparkline([0.0, 5.0, 10.0, None, 10.0])
+    assert len(line) == 5 and line[3] == "·"
+    assert line[0] == "▁" and line[2] == "█"
+    assert sparkline([3.0, 3.0]) == "▄▄"  # flat renders mid-height
+    assert len(sparkline([float(i) for i in range(500)])) == 60
+
+
+def test_build_info_gauge():
+    from kubeai_tpu import __version__
+    from kubeai_tpu.metrics.buildinfo import M_BUILD_INFO, set_build_info
+
+    set_build_info("operator")
+    snap = M_BUILD_INFO.snapshot()
+    keys = [dict(k) for k in snap]
+    ours = [k for k in keys if k.get("server") == "operator"]
+    assert ours and ours[0]["version"] == __version__
+    assert ours[0]["python"] and ours[0]["jax"]
+    assert all(v == 1.0 for v in snap.values())

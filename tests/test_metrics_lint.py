@@ -133,6 +133,33 @@ def test_tenant_label_written_only_by_accountant():
     assert not violations, "\n".join(violations)
 
 
+def test_debug_index_matches_doc_endpoint_table():
+    """Endpoint-table drift lint: every DEBUG_INDEX entry must have a
+    matching `/debug/...` row in docs/observability.md's endpoint table
+    and vice versa — the doc can no longer silently miss surfaces the
+    way the old hardcoded fleet-snapshot target did."""
+    from kubeai_tpu.obs.recorder import DEBUG_INDEX
+
+    code_paths = {p for p, _, _ in DEBUG_INDEX}
+    assert code_paths, "DEBUG_INDEX empty — lint scan broken?"
+    # Doc rows look like `| \`/debug/requests?limit=N&id=X\` | ...`;
+    # normalize by truncating at the query/optional-part markers.
+    doc_paths = set()
+    for raw in re.findall(r"^\|\s*`(/debug[^`]*)`", DOC.read_text(), re.M):
+        doc_paths.add(re.split(r"[?\[]", raw)[0])
+    doc_paths.discard("/debug")  # the index route itself documents the rest
+    missing_in_doc = sorted(code_paths - doc_paths)
+    assert not missing_in_doc, (
+        "DEBUG_INDEX routes with no row in docs/observability.md's "
+        "endpoint table: " + ", ".join(missing_in_doc)
+    )
+    missing_in_code = sorted(doc_paths - code_paths)
+    assert not missing_in_code, (
+        "docs/observability.md documents debug endpoints DEBUG_INDEX "
+        "does not list: " + ", ".join(missing_in_code)
+    )
+
+
 def test_doc_metric_names_exist_in_code():
     code_names = {
         name for _, _, name, _ in _registration_calls() if name is not None
