@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -46,6 +46,14 @@ loadgen: ## tenant-mix load demo: real proxy+engine, weighted tenant population 
 	@# breakdown. Summary under build/tenant-drill/. The fast variant
 	@# runs in tier-1 (tests/test_tenants.py).
 	JAX_PLATFORMS=cpu $(PY) benchmarks/tenant_drill.py
+
+qos-drill: ## QoS isolation proof: batch flood vs interactive p99 TTFT, preemption with byte-correct resume
+	@# Exits nonzero unless interactive p99 TTFT under a batch flood
+	@# stays within tolerance of baseline, >=1 batch stream is preempted
+	@# AND resumed byte-identically, and /debug/qos + the kubeai_qos_*
+	@# counters report it. Summary under build/qos-drill/. The fast
+	@# variant runs in tier-1 (tests/test_qos.py). See docs/qos.md.
+	JAX_PLATFORMS=cpu $(PY) benchmarks/qos_drill.py
 
 incident-drill: ## e2e incident-black-box smoke: real proxy+engine, injected mid-stream kill, canary detection, persisted incident + rendered report
 	@# Exits nonzero unless an incident lands with >=3 correlated

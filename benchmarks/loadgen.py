@@ -40,11 +40,13 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from kubeai_tpu.obs.slo import attainment_block, error_rate_block
+from kubeai_tpu.qos.classes import CLASSES as QOS_CLASSES
 
 
 class ThreadStats:
-    def __init__(self, tenant: str = ""):
+    def __init__(self, tenant: str = "", priority: str = ""):
         self.tenant = tenant  # tenant NAME from --tenant-mix ("" = untagged)
+        self.priority = priority  # QoS class from --priority-mix ("" = untagged)
         self.ttfts: list[float] = []
         self.itls: list[float] = []
         self.turn_latencies: list[float] = []
@@ -84,6 +86,38 @@ def parse_tenant_mix(spec: str) -> list[tuple[str, float]]:
 
 def tenant_api_key(name: str) -> str:
     return f"loadgen-{name}-key"
+
+
+def parse_priority_mix(spec: str) -> list[tuple[str, float]]:
+    """``"interactive:2,batch:8"`` -> [("interactive", 2.0),
+    ("batch", 8.0)] — the weighted QoS-class population --priority-mix
+    sends traffic as. Unlike tenant names, class names are a closed
+    vocabulary (the operator's priority lattice), so typos fail here
+    instead of silently resolving to standard server-side. Composes
+    with --tenant-mix: each conversation draws a tenant AND a class
+    independently."""
+    out: list[tuple[str, float]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        name, _, w = part.partition(":")
+        name = name.strip().lower()
+        if name not in QOS_CLASSES:
+            raise ValueError(
+                f"bad priority-mix class {part!r}: expected one of "
+                + ", ".join(QOS_CLASSES)
+            )
+        try:
+            weight = float(w) if w else 1.0
+        except ValueError:
+            raise ValueError(f"bad priority-mix weight in {part!r}")
+        if weight <= 0:
+            raise ValueError(f"priority-mix weight must be positive: {part!r}")
+        out.append((name, weight))
+    if not out:
+        raise ValueError(f"empty priority mix {spec!r}")
+    return out
 
 
 def load_sharegpt(path: str, max_turn_chars: int = 2000) -> list[list[str]]:
@@ -229,6 +263,23 @@ def scrape_retry_counters(base: str) -> dict[str, float] | None:
     return {labels.get("reason", ""): value for labels, value in series}
 
 
+def scrape_qos_counters(base: str) -> dict[str, float] | None:
+    """kubeai_qos_proxy_requests_total by class from the operator's
+    /metrics — the server-side twin of the client's per-class counts,
+    or None against non-operator targets."""
+    from kubeai_tpu.metrics.registry import parse_prometheus_text
+
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return None
+    series = parse_prometheus_text(text).get(
+        "kubeai_qos_proxy_requests_total", []
+    )
+    return {labels.get("class", ""): value for labels, value in series}
+
+
 def schedule_replica_kill(base: str, after_s: float) -> None:
     """--kill-replica-at: *after_s* seconds into the run, pick one
     serving endpoint from the operator's /debug/endpoints and arm
@@ -284,6 +335,7 @@ def run_benchmark(
     flood_tenant: str | None = None,
     flood_at: float | None = None,
     flood_conversations: int = 0,
+    priority_mix: list[tuple[str, float]] | None = None,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy). With
@@ -300,17 +352,30 @@ def run_benchmark(
     heavy-hitter scenario: *flood_conversations* extra conversations,
     ALL for one tenant, arrive *flood_at* seconds into the run — the
     ``tenant_flood`` trigger should fire and the summary reports the
-    resulting incident."""
+    resulting incident.
+
+    *priority_mix* (see parse_priority_mix) assigns each conversation a
+    QoS class by weight; every request carries ``X-Priority``, so the
+    operator's class-aware scheduler lanes the traffic, and the summary
+    gains a per-class block with the operator's own counters alongside
+    the client's. Composes with *tenant_mix* — class and tenant are
+    drawn independently."""
     base = operator_base(base_url)
     retries_before = scrape_retry_counters(base)
+    qos_before = scrape_qos_counters(base) if priority_mix else None
     if kill_replica_at is not None:
         schedule_replica_kill(base, kill_replica_at)
     rng = random.Random(seed)
     names = [n for n, _ in (tenant_mix or [])]
     weights = [w for _, w in (tenant_mix or [])]
+    p_names = [n for n, _ in (priority_mix or [])]
+    p_weights = [w for _, w in (priority_mix or [])]
 
     def pick_tenant() -> str:
         return rng.choices(names, weights=weights)[0] if names else ""
+
+    def pick_priority() -> str:
+        return rng.choices(p_names, weights=p_weights)[0] if p_names else ""
 
     convo_turns: list[list[str]] = []
     for i in range(conversations):
@@ -321,13 +386,18 @@ def run_benchmark(
                 synthetic_turns(f"conversation-{i}", turns, pad_chars=prefix_pad_chars)
             )
 
-    stats = [ThreadStats(tenant=pick_tenant()) for _ in range(conversations)]
+    stats = [
+        ThreadStats(tenant=pick_tenant(), priority=pick_priority())
+        for _ in range(conversations)
+    ]
     sem = threading.Semaphore(max_concurrency) if max_concurrency > 0 else None
 
     def run_one(st: ThreadStats, turns_i: list[str]):
-        headers = (
-            {"X-API-Key": tenant_api_key(st.tenant)} if st.tenant else None
-        )
+        headers = {}
+        if st.tenant:
+            headers["X-API-Key"] = tenant_api_key(st.tenant)
+        if st.priority:
+            headers["X-Priority"] = st.priority
         if sem:
             sem.acquire()
         try:
@@ -482,11 +552,45 @@ def run_benchmark(
                 flood_info["incident_error"] = str(e)[:200]
             tenants_block["flood"] = flood_info
 
+    # Per-class client-side summary + the operator's own per-class
+    # counter deltas (kubeai_qos_proxy_requests_total) so the two views
+    # can be checked against each other: every request the client sent
+    # at a class must have entered the proxy at that class.
+    priorities_block = None
+    if priority_mix:
+        per_cls: dict[str, dict] = {}
+        for st in stats:
+            if not st.priority:
+                continue
+            b = per_cls.setdefault(st.priority, {
+                "requests": 0, "failures": 0, "output_tokens": 0,
+                "ttfts": [],
+            })
+            b["requests"] += len(st.turn_latencies)
+            b["failures"] += st.failures
+            b["output_tokens"] += st.output_tokens
+            b["ttfts"].extend(st.ttfts)
+        for cls, b in per_cls.items():
+            ttfts_c = b.pop("ttfts")
+            b["ttft_p95_ms"] = (
+                round(pct(ttfts_c, 95) * 1000, 1) if ttfts_c else None
+            )
+        priorities_block = {"mix": dict(priority_mix), "client": per_cls}
+        if qos_before is not None:
+            qos_after = scrape_qos_counters(base)
+            if qos_after is not None:
+                priorities_block["operator_requests"] = {
+                    cls: max(0, round(qos_after.get(cls, 0.0) - qos_before.get(cls, 0.0)))
+                    for cls in QOS_CLASSES
+                    if qos_after.get(cls) or qos_before.get(cls)
+                }
+
     return {
         "requests": n_requests,
         "failures": failures,
         "recovery": recovery,
         "tenants": tenants_block,
+        "priorities": priorities_block,
         "elapsed_s": round(elapsed, 2),
         "req_per_s": round(n_requests / elapsed, 2) if elapsed else 0,
         "output_tok_per_s": round(total_tokens / elapsed, 2) if elapsed else 0,
@@ -561,6 +665,14 @@ def main():
              "summary gains per-tenant client + operator blocks",
     )
     parser.add_argument(
+        "--priority-mix", default=None, metavar="CLASS:W,CLASS:W",
+        help="weighted QoS-class population, e.g. 'interactive:2,batch:8' "
+             "— each conversation is assigned a class by weight and sends "
+             "X-Priority, so the operator's class-aware scheduler lanes "
+             "the traffic; the summary gains per-class client + operator "
+             "blocks; composes with --tenant-mix",
+    )
+    parser.add_argument(
         "--flood-tenant", default=None, metavar="NAME",
         help="heavy-hitter scenario: this tenant floods mid-run "
              "(requires --tenant-mix and --flood-at); the summary "
@@ -616,6 +728,9 @@ def main():
         flood_tenant=args.flood_tenant,
         flood_at=args.flood_at,
         flood_conversations=args.flood_conversations,
+        priority_mix=(
+            parse_priority_mix(args.priority_mix) if args.priority_mix else None
+        ),
     )
     print(json.dumps(summary, indent=1))
 

@@ -1,0 +1,416 @@
+"""QoS drill: the acceptance proof for multi-tenant QoS (docs/qos.md)
+against a REAL serving stack — store → reconciler → balancer →
+proxy/OpenAI server → a real (CPU) engine — driven by
+benchmarks/loadgen.py's ``--priority-mix`` machinery.
+
+The drill:
+
+1. measures a baseline: interactive-only conversations through the
+   full proxy→engine path (p99 TTFT with the engine otherwise idle);
+2. floods the engine with long preemptible batch streams (every decode
+   slot seized by bulk work), then re-runs the SAME interactive load
+   while the flood is in flight;
+3. verifies the acceptance bar:
+   - **isolation** — interactive p99 TTFT under the batch flood stays
+     within 10% of baseline plus a small absolute grace (the
+     scheduler-tick noise floor of a tiny CPU engine; see ABS_GRACE_S);
+   - **preemption with byte-correct resume** — at least one batch
+     stream was preempted (kubeai_qos_preemptions_total moved) and
+     resumed (kubeai_qos_resumes_total moved), and EVERY flood stream's
+     event sequence is identical to an uninterrupted reference run of
+     the same deterministic request: zero duplicated, zero dropped;
+   - **surfaces** — /debug/qos reports the per-class breakdown and the
+     preemption/resume counters, the per-class client summary matches
+     the operator's kubeai_qos_proxy_requests_total deltas, and (with
+     the storm threshold lowered so it fires deterministically) the
+     ``qos_preemption_storm`` incident landed.
+
+Run: ``make qos-drill`` (summary under build/qos-drill/). ``--fast`` is
+the tier-1 variant (tests/test_qos.py runs it). Exit 0 = every check
+passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.loadgen import parse_priority_mix, run_benchmark  # noqa: E402
+
+from kubeai_tpu.api import model_types as mt  # noqa: E402
+from kubeai_tpu.api.core_types import KIND_POD  # noqa: E402
+from kubeai_tpu.api.model_types import Model, ModelSpec  # noqa: E402
+from kubeai_tpu.config.system import System  # noqa: E402
+from kubeai_tpu.controller.controller import ModelReconciler  # noqa: E402
+from kubeai_tpu.engine.core import EngineConfig, build_test_engine  # noqa: E402
+from kubeai_tpu.engine.sampling import SamplingParams  # noqa: E402
+from kubeai_tpu.engine.server import EngineServer  # noqa: E402
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer  # noqa: E402
+from kubeai_tpu.metrics import default_registry  # noqa: E402
+from kubeai_tpu.obs.incidents import (  # noqa: E402
+    IncidentRecorder,
+    install_recorder,
+    standard_sources,
+    uninstall_recorder,
+)
+from kubeai_tpu.proxy.handler import ModelProxy  # noqa: E402
+from kubeai_tpu.proxy.modelclient import ModelClient  # noqa: E402
+from kubeai_tpu.proxy.server import OpenAIServer  # noqa: E402
+from kubeai_tpu.runtime.store import ObjectMeta, Store  # noqa: E402
+
+MODEL = "qos-drill-model"
+
+# The 10%-of-baseline bar alone is meaningless at CPU-test-engine
+# scale: a 40 ms baseline p99 would demand 4 ms of headroom, below the
+# scheduler-loop tick itself. The absolute grace is the noise floor a
+# preemption costs by construction (one in-flight decode dispatch), NOT
+# a license for queueing behind batch work — a flood that makes
+# interactive requests actually wait behind bulk decode blows through
+# it immediately.
+ABS_GRACE_S = 0.35
+
+
+class _AlwaysLeader:
+    def __init__(self):
+        self.is_leader = threading.Event()
+        self.is_leader.set()
+
+
+def _await(cond, timeout=10.0, msg="condition"):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if cond():
+            return
+        time.sleep(0.02)
+    raise AssertionError(f"timed out awaiting {msg}")
+
+
+def _counter(name: str) -> float:
+    return default_registry.get(name).value()
+
+
+def sse_shape(port: int, body: dict, headers: dict | None = None,
+              timeout: float = 120) -> list:
+    """POST a streaming request; returns the client-visible event
+    sequence as (text, finish_reason) tuples (ids/created legitimately
+    change at a resume boundary, same as a crash replay). The stream
+    must complete — truncation raises."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/openai/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    out = []
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    for block in raw.replace(b"\r\n", b"\n").split(b"\n\n"):
+        if not block.startswith(b"data: "):
+            continue
+        payload = block[6:].decode()
+        if payload == "[DONE]":
+            out.append("[DONE]")
+            continue
+        c = json.loads(payload)["choices"][0]
+        out.append((c.get("text"), c.get("finish_reason")))
+    return out
+
+
+def run(fast: bool = False, verbose: bool = True) -> dict:
+    """Execute the drill; returns the summary dict. Raises
+    AssertionError on a failed acceptance check."""
+    t_start = time.monotonic()
+    # Deterministic storm: one preemption trips the trigger, proving
+    # the wiring end to end (the production threshold stays env-tuned).
+    saved_env = {
+        k: os.environ.get(k)
+        for k in ("KUBEAI_QOS_STORM_COUNT", "KUBEAI_QOS_STORM_WINDOW")
+    }
+    os.environ["KUBEAI_QOS_STORM_COUNT"] = "1"
+    os.environ["KUBEAI_QOS_STORM_WINDOW"] = "120"
+
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=30)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    recorder = IncidentRecorder(
+        sources=standard_sources(lb, mc),
+        incident_dir=os.path.join("build", "qos-drill", "incidents"),
+        debounce_seconds=2.0,
+        election=_AlwaysLeader(),
+    )
+    install_recorder(recorder)
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(
+            max_slots=2, max_seq_len=512, prefill_buckets=(32, 64, 128),
+            max_queue=64, decode_chunk=2,
+        )
+    )
+    # Pre-compile every serving shape (decode chunk, each prefill
+    # bucket at both batch widths) BEFORE measuring anything: a single
+    # mid-window JIT compile costs ~1s on CPU and would dwarf the
+    # latencies under test.
+    eng.warmup()
+    srv = EngineServer(eng, MODEL, host="127.0.0.1", port=0)
+    srv.start()
+    summary: dict = {"fast": fast}
+    try:
+        # Warm the compile cache outside the measured runs.
+        eng.generate(
+            eng.tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=180,
+        )
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name=MODEL),
+                spec=ModelSpec(
+                    url="hf://drill/model", resource_profile="cpu:1",
+                    replicas=1, min_replicas=1,
+                ),
+            ),
+        )
+        _await(
+            lambda: len(store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})) == 1,
+            msg="model pod",
+        )
+        [pod] = store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})
+
+        def forge(p):
+            p.status.ready = True
+            p.status.pod_ip = "127.0.0.1"
+            p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+            p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(srv.port)
+
+        store.mutate(KIND_POD, pod.meta.name, forge)
+        _await(lambda: lb.get_all_addresses(MODEL), msg="endpoint")
+
+        convs = 3 if fast else 6
+        floods = 5 if fast else 10
+        # The tiny CPU engine decodes ~1k tok/s: bulk streams must be
+        # hundreds of tokens long or the "flood" evaporates before the
+        # interactive load arrives.
+        batch_tokens = 400
+        batch_body = {
+            "model": MODEL, "prompt": "the quarterly report shows", "stream": True,
+            "temperature": 0, "max_tokens": batch_tokens,
+        }
+        batch_headers = {"X-Priority": "batch"}
+
+        # -- reference: the deterministic batch request served whole,
+        # uncontended — the byte-shape every flood stream must match.
+        reference = sse_shape(api.port, batch_body, batch_headers)
+        assert reference[-1] == "[DONE]" and len(reference) > 4, (
+            f"reference stream suspiciously short: {len(reference)} events"
+        )
+
+        def interactive_bench():
+            return run_benchmark(
+                f"http://127.0.0.1:{api.port}/openai",
+                MODEL,
+                conversations=convs,
+                turns=2,
+                max_tokens=6,
+                temperature=0.0,
+                priority_mix=parse_priority_mix("interactive:1"),
+            )
+
+        # Belt over eng.warmup()'s suspenders: run the bench itself
+        # until the JIT recompile counter stops moving, so any shape
+        # warmup missed (or a future engine change adds) is compiled
+        # outside the measured window. Normally breaks on iteration 2.
+        prev_compiles = -1.0
+        for _ in range(4):
+            interactive_bench()
+            n = _counter("kubeai_engine_jit_recompiles_total")
+            if n == prev_compiles:
+                break
+            prev_compiles = n
+
+        # -- phase 1: interactive baseline, engine otherwise idle ----------
+        base = interactive_bench()
+        assert base["failures"] == 0, f"baseline had failures: {base['failures']}"
+        p99_base = base["ttft_ms"]["p99"] / 1000.0
+        summary["baseline"] = {
+            "requests": base["requests"], "ttft_p99_ms": base["ttft_ms"]["p99"],
+        }
+
+        # -- phase 2: batch flood seizes the engine, interactive re-runs ---
+        pre_before = _counter("kubeai_qos_preemptions_total")
+        res_before = _counter("kubeai_qos_resumes_total")
+        flood_shapes: list[list] = []
+        flood_errors: list[str] = []
+        flood_lock = threading.Lock()
+        flood_stop = threading.Event()
+
+        def flood_one(i: int):
+            # Each flood client re-submits the same deterministic bulk
+            # stream back to back, keeping every decode slot under
+            # batch pressure for the whole measured window.
+            while not flood_stop.is_set():
+                try:
+                    shape = sse_shape(api.port, batch_body, batch_headers)
+                    with flood_lock:
+                        flood_shapes.append(shape)
+                except Exception as e:  # collected, asserted below
+                    flood_errors.append(f"flood {i}: {e}")
+                    return
+
+        flood_threads = [
+            threading.Thread(target=flood_one, args=(i,), daemon=True)
+            for i in range(floods)
+        ]
+        for t in flood_threads:
+            t.start()
+        # Let the flood actually occupy the engine before the
+        # interactive load arrives — that is the contention under test.
+        _await(
+            lambda: _counter("kubeai_engine_active_slots") >= 2,
+            timeout=30, msg="batch flood occupying both slots",
+        )
+        flooded = interactive_bench()
+        flood_stop.set()
+        for t in flood_threads:
+            t.join(timeout=180)
+        assert not any(t.is_alive() for t in flood_threads), "flood streams hung"
+        assert not flood_errors, f"flood streams errored: {flood_errors}"
+        assert flooded["failures"] == 0, (
+            f"interactive load failed under flood: {flooded['failures']}"
+        )
+        p99_flood = flooded["ttft_ms"]["p99"] / 1000.0
+        assert len(flood_shapes) >= floods, (
+            f"only {len(flood_shapes)} flood streams completed"
+        )
+        summary["flood"] = {
+            "clients": floods, "completed_streams": len(flood_shapes),
+            "batch_tokens": batch_tokens,
+            "interactive_requests": flooded["requests"],
+            "ttft_p99_ms": flooded["ttft_ms"]["p99"],
+        }
+
+        # -- check 1: interactive isolation --------------------------------
+        bound = p99_base * 1.10 + ABS_GRACE_S
+        assert p99_flood <= bound, (
+            f"interactive p99 TTFT degraded under batch flood: "
+            f"{p99_flood * 1000:.1f}ms vs baseline {p99_base * 1000:.1f}ms "
+            f"(bound {bound * 1000:.1f}ms)"
+        )
+
+        # -- check 2: >=1 preemption, every stream byte-correct ------------
+        preemptions = _counter("kubeai_qos_preemptions_total") - pre_before
+        resumes = _counter("kubeai_qos_resumes_total") - res_before
+        assert preemptions >= 1, (
+            "batch flood + interactive load produced no preemption — the "
+            "interactive requests waited behind bulk decode instead"
+        )
+        assert resumes >= 1, "preempted streams were never resumed"
+        bad = [
+            i for i, s in enumerate(flood_shapes) if s != reference
+        ]
+        assert not bad, (
+            f"flood streams {bad} diverged from the uninterrupted "
+            f"reference (duplicated or dropped events at resume)"
+        )
+        summary["preemption"] = {
+            "preemptions": int(preemptions), "resumes": int(resumes),
+            "streams_byte_identical": len(flood_shapes),
+        }
+
+        # -- check 3: surfaces ----------------------------------------------
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/debug/qos", timeout=10
+        ) as r:
+            qos_view = json.load(r)
+        assert qos_view["preemptions"] >= 1, "/debug/qos reports no preemption"
+        assert qos_view["resumes"] >= 1, "/debug/qos reports no resume"
+        per_class = qos_view.get("queue", {}).get("per_class", {})
+        assert set(per_class) == {"interactive", "standard", "batch"}, (
+            f"/debug/qos lacks the per-class breakdown: {sorted(per_class)}"
+        )
+        assert qos_view["proxy_requests"].get("interactive", 0) >= convs * 2, (
+            "per-class proxy counters missing interactive traffic"
+        )
+        assert qos_view["proxy_requests"].get("batch", 0) >= floods, (
+            "per-class proxy counters missing batch traffic"
+        )
+        # Client per-class summary vs the operator's counters: every
+        # interactive request the client completed entered the proxy at
+        # interactive class (batch classes overlap with the flood's own
+        # window, so the equality check rides the clean class).
+        op = flooded["priorities"]["operator_requests"]
+        cl = flooded["priorities"]["client"]
+        assert op.get("interactive") == cl["interactive"]["requests"], (
+            f"operator counted {op.get('interactive')} interactive requests, "
+            f"client sent {cl['interactive']['requests']}"
+        )
+        recorder.wait_idle(timeout=15)
+        storms = [
+            i for i in recorder.snapshot()
+            if i["trigger"] == "qos_preemption_storm"
+        ]
+        assert storms, "no qos_preemption_storm incident captured"
+        summary["surfaces"] = {
+            "debug_qos_classes": sorted(per_class),
+            "proxy_requests": qos_view["proxy_requests"],
+            "storm_incident_id": storms[0]["id"],
+        }
+        summary["ok"] = True
+        summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
+        if verbose:
+            print(
+                f"qos drill: p99 TTFT {p99_base * 1000:.0f}ms -> "
+                f"{p99_flood * 1000:.0f}ms under {floods}-stream batch flood, "
+                f"{int(preemptions)} preemptions / {int(resumes)} resumes, "
+                f"{len(flood_shapes)} streams byte-identical, "
+                f"storm incident {storms[0]['id']}"
+            )
+        return summary
+    finally:
+        uninstall_recorder(recorder)
+        recorder.stop()
+        srv.stop()
+        api.stop()
+        lb.stop()
+        rec.stop()
+        for k, v in saved_env.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("qos-drill")
+    parser.add_argument("--fast", action="store_true", help="tier-1 variant: smaller flood")
+    parser.add_argument("--json", default=os.path.join("build", "qos-drill", "summary.json"))
+    args = parser.parse_args(argv)
+    try:
+        summary = run(fast=args.fast)
+    except AssertionError as e:
+        print(f"QOS DRILL FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

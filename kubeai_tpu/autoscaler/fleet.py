@@ -36,6 +36,9 @@ _HBM_LIMIT = "kubeai_engine_hbm_limit_bytes"
 _PREFIX_CACHED = "kubeai_engine_prefix_cached_tokens_total"
 _PREFIX_LOOKUP = "kubeai_engine_prefix_lookup_tokens_total"
 _CACHED_EVICTIONS = "kubeai_engine_kv_cached_evictions_total"
+# Exported by kubeai_tpu/qos/stats.py, scraped here so the autoscaler
+# can tell deferrable batch backlog apart from interactive pressure.
+_QOS_QUEUE = "kubeai_qos_queue_depth"
 
 M_FLEET_ACTIVE = default_registry.gauge(
     "kubeai_fleet_active_slots",
@@ -261,6 +264,14 @@ class FleetCollector:
                 else None
             ),
             "kv_cached_evictions": val(_CACHED_EVICTIONS),
+            # Per-class QoS backlog (kubeai_tpu/qos): which lanes the
+            # queued work sits in — a batch-only backlog is deferrable
+            # bulk, an interactive backlog is an SLO emergency.
+            "qos_backlog": {
+                labels.get("class", ""): v
+                for labels, v in parsed.get(_QOS_QUEUE, [])
+                if labels.get("class")
+            },
         }
 
     @staticmethod
@@ -277,6 +288,11 @@ class FleetCollector:
         }
         agg["endpoints"] = len(ok)
         agg["failed_endpoints"] = len(endpoints) - len(ok)
+        qos: dict[str, float] = {}
+        for e in ok:
+            for cls, v in (e.get("qos_backlog") or {}).items():
+                qos[cls] = round(qos.get(cls, 0.0) + v, 3)
+        agg["qos_backlog"] = qos
         agg["prefix_hit_ratio"] = (
             round(agg["prefix_cached_tokens"] / agg["prefix_lookup_tokens"], 4)
             if agg["prefix_lookup_tokens"] > 0

@@ -62,6 +62,9 @@ from kubeai_tpu.obs.recorder import (
     unregister_engine_debug_section,
 )
 from kubeai_tpu.obs.trace import RequestTrace, TraceContext
+from kubeai_tpu.qos import QoSQueue, record_admitted, record_preemption
+from kubeai_tpu.qos import install_queue as qos_install_queue
+from kubeai_tpu.qos import uninstall_queue as qos_uninstall_queue
 
 log = logging.getLogger("kubeai_tpu.engine")
 
@@ -221,6 +224,15 @@ class Request:
     # Empty = un-attributed (direct submits, canary probes) — no cost
     # accounting, by design.
     tenant: str = ""
+    # QoS class (kubeai_tpu/qos, X-Priority from the proxy): queue lane
+    # and shed/preemption behavior. The queue treats unknown values as
+    # standard.
+    priority: str = "standard"
+    # Proxy-stamped (X-Preemptible): this stream's slot may be seized
+    # mid-decode for a waiting interactive request — only set for
+    # replayable batch streams with no planned handoff, so the proxy's
+    # resume cursor can regenerate it with zero dup/zero drop.
+    preemptible: bool = False
 
 
 @dataclass
@@ -275,7 +287,11 @@ class Engine:
         self._mesh = mesh
         self._publisher = publisher
         self._multiproc = mesh is not None and jax.process_count() > 1
-        self._queue: "queue.Queue[Request]" = queue.Queue(maxsize=self.cfg.max_queue)
+        # Class-aware admission queue (kubeai_tpu/qos): strict priority
+        # across classes, deficit-round-robin per tenant within one,
+        # batch-first shedding. Same surface/errors as the old FIFO
+        # queue.Queue, so put/get call sites are unchanged.
+        self._queue: QoSQueue = QoSQueue(maxsize=self.cfg.max_queue)
         # Auxiliary device work (embeddings) routed through the scheduler
         # thread so ALL device dispatch is serialized on one thread —
         # jitted calls from handler threads would contend with decode
@@ -768,6 +784,7 @@ class Engine:
         for gauge, fn in self._gauge_callbacks:
             gauge.set_callback(fn)
         register_engine_debug_section("perf", self._perf_section_fn)
+        qos_install_queue(self._queue)
         self._thread = threading.Thread(target=self._loop, name="engine-loop", daemon=True)
         self._thread.start()
 
@@ -880,6 +897,7 @@ class Engine:
         for gauge, fn in self._gauge_callbacks:
             gauge.clear_callback(fn)
         unregister_engine_debug_section("perf", self._perf_section_fn)
+        qos_uninstall_queue(self._queue)
 
     def _fail_inflight(self, message: str) -> None:
         """Error out every slotted and queued request and reset counters
@@ -967,6 +985,8 @@ class Engine:
         trace_ctx: TraceContext | None = None,
         deadline: float | None = None,
         tenant: str = "",
+        priority: str = "standard",
+        preemptible: bool = False,
     ) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
         retries another replica, and the server maps it to 429 +
@@ -995,11 +1015,13 @@ class Engine:
         req = Request(
             prompt_ids=prompt_ids, params=params, adapter=adapter,
             deadline=deadline, tenant=tenant,
+            priority=priority, preemptible=preemptible,
         )
         req.trace = RequestTrace(
             ctx=trace_ctx, component="engine", t0_mono=req.arrival
         )
         req.trace.attrs["prompt_tokens"] = len(prompt_ids)
+        req.trace.attrs["priority"] = priority
         if tenant:
             # Tenant-filterable flight-recorder timelines (the proxy
             # stamps its span the same way).
@@ -1514,6 +1536,7 @@ class Engine:
                 # path below exactly like a real dispatch failure.
                 fault("engine.step")
                 self._sweep_deadlines()
+                self._sweep_qos_budgets()
                 admitted = self._admit_waiting()
                 dispatched = self._dispatch_chunk() if self._n_active > 0 else None
                 # First-token sync AFTER the dispatch: the chunk reads
@@ -1709,6 +1732,69 @@ class Engine:
                 )
                 self._free(i, "stop", deliver=False)
 
+    QOS_BUDGET_MSG = "queue-wait budget exceeded for priority class"
+
+    def _sweep_qos_budgets(self) -> None:
+        """Drop queued requests past their per-class queue-wait budget
+        (KUBEAI_QOS_BUDGET_*; the class-aware successor to the single
+        global queue-wait deadline). The queue rate-limits the scan
+        internally, so this is near-free in the hot loop."""
+        dropped = self._queue.sweep_budgets()
+        for req in dropped:
+            req.out.put(("error", self.QOS_BUDGET_MSG))
+            self._finish_request(req, "cancelled", error=self.QOS_BUDGET_MSG)
+        if dropped:
+            self.m_queue.set(self.queue_depth())
+
+    def _peek_priority(self) -> str | None:
+        """Class of the next request admission would serve: the deferred
+        head outranks the queue unless the queue holds a strictly
+        higher class (the same overtake rule _admit_waiting applies)."""
+        if self._deferred:
+            head = self._deferred[0].priority
+            return (
+                self._queue.peek_priority()
+                if self._queue.outranks(head)
+                else head
+            )
+        return self._queue.peek_priority()
+
+    def _preempt_one(self, taken: set) -> bool:
+        """Seize ONE preemptible batch slot for a waiting interactive
+        request. The victim's stream finishes with reason "preempted"
+        and NO detokenizer tail flush — flushed text would desync the
+        proxy's event-count resume cursor — while its KV pages release
+        with content registration, so the deterministic re-run's
+        prefill can prefix-reuse them. Victim choice: fewest generated
+        tokens (least regeneration wasted). Returns True when a slot
+        was freed."""
+        victim, best = -1, None
+        for i, slot in enumerate(self._slots):
+            if slot is None or i in taken:
+                continue
+            r = slot.req
+            if not r.preemptible or r.priority != "batch" or r.finished:
+                continue
+            if best is None or slot.generated < best:
+                victim, best = i, slot.generated
+        if victim < 0:
+            return False
+        slot = self._slots[victim]
+        log.info(
+            "preempting slot %d (batch, %d tokens generated) for "
+            "interactive admission", victim, slot.generated,
+        )
+        record_preemption(slot.generated)
+        self._free(victim, "preempted", flush=False, outcome="preempted")
+        return True
+
+    def qos_retry_after(self, priority: str) -> int:
+        """Retry-After seconds for a shed request of this class, scaled
+        by the backlog it would sit behind (classes at or above it)."""
+        backlog = self._queue.backlog_at_or_above(priority)
+        per_round = max(self.cfg.max_slots, 1)
+        return int(min(max(1 + backlog // per_round, 1), 30))
+
     def _admit_waiting(self) -> list:
         """Admit queued requests into free slots: plan pages, dispatch
         prefill calls (all-numpy args riding the execute RPC), and fill
@@ -1725,10 +1811,19 @@ class Engine:
         taken: set[int] = set()
         max_bucket = max(self.cfg.prefill_buckets)
         seq = 0
-        while self._n_active + len(taken) < self.cfg.max_slots:
-            # Pool-blocked requests wait at the head of the line (strict
-            # FIFO — nothing overtakes them).
-            if self._deferred:
+        while True:
+            if not (self._n_active + len(taken) < self.cfg.max_slots):
+                # Every slot is busy. An interactive request at the head
+                # of the line may seize a preemptible batch slot instead
+                # of waiting behind bulk work (docs/qos.md); otherwise
+                # this admission round is done.
+                if self._peek_priority() != "interactive" or not self._preempt_one(taken):
+                    break
+            # Pool-blocked requests wait at the head of the line, but a
+            # strictly higher class arriving behind them may overtake:
+            # the KV wait is the deferred request's problem, not the
+            # whole fleet's.
+            if self._deferred and not self._queue.outranks(self._deferred[0].priority):
                 req = self._deferred.pop(0)
             else:
                 try:
@@ -1756,6 +1851,10 @@ class Engine:
                 )
                 continue
             plan = self._plan_admission(req, taken)
+            if plan is None and req.priority == "interactive" and self._preempt_one(taken):
+                # Seizing a batch slot released its KV pages too — one
+                # replan against the grown pool before deferring.
+                plan = self._plan_admission(req, taken)
             if plan is None:
                 # KV pool can't back prompt+budget yet; wait for a free.
                 self._deferred.insert(0, req)
@@ -1763,6 +1862,9 @@ class Engine:
                 break
             slot_idx, reuse = plan
             taken.add(slot_idx)
+            record_admitted(
+                req.priority, max(time.monotonic() - req.arrival, 0.0)
+            )
             # Cold, bucket-sized requests batch into one prefill call;
             # reuse/long requests go through the chunked path.
             if reuse == 0 and len(req.prompt_ids) <= max_bucket:
@@ -2480,7 +2582,8 @@ class Engine:
         if slot.generated >= slot.budget:
             self._free(slot_idx, "length")
 
-    def _free(self, slot_idx: int, reason: str, deliver: bool = True, flush: bool = True):
+    def _free(self, slot_idx: int, reason: str, deliver: bool = True, flush: bool = True,
+              outcome: str | None = None):
         slot = self._slots[slot_idx]
         self._slots[slot_idx] = None
         self._n_active -= 1
@@ -2511,7 +2614,7 @@ class Engine:
                 ("done", FinishInfo(reason, slot.prompt_len, slot.generated))
             )
         self._finish_request(
-            slot.req, "ok" if deliver else "cancelled",
+            slot.req, outcome or ("ok" if deliver else "cancelled"),
             finish_reason=reason, completion_tokens=slot.generated,
         )
 

@@ -56,6 +56,13 @@ from kubeai_tpu.obs.history import (
 )
 from kubeai_tpu.obs.perf import handle_perf_request
 from kubeai_tpu.obs.tenants import TENANT_HEADER, sanitize_tenant
+from kubeai_tpu.qos import (
+    DEFAULT_CLASS,
+    PREEMPTIBLE_HEADER,
+    PRIORITY_HEADER,
+    handle_qos_request,
+    normalize_priority,
+)
 
 log = logging.getLogger("kubeai_tpu.engine.server")
 
@@ -331,14 +338,17 @@ def _make_handler(srv: EngineServer):
         def _error(self, code: int, msg: str, etype: str = "invalid_request_error", headers: dict | None = None):
             self._json(code, {"error": {"message": msg, "type": etype}}, headers=headers)
 
-        def _saturated(self, msg: str = "engine saturated"):
+        def _saturated(self, msg: str = "engine saturated", retry_after: int | None = None):
             """Backpressure response: 429 + Retry-After + OpenAI-shaped
             body. A bare 503 invited synchronized retry storms — 429
             tells SDKs (which all implement jittered backoff for it)
-            this is load, not failure."""
+            this is load, not failure. *retry_after* overrides the flat
+            hint with the class-backlog-scaled one (Engine.qos_retry_after)
+            so a shed batch client backs off longer than a shed
+            interactive one."""
             return self._error(
                 429, msg + "; retry after backoff", "rate_limit_error",
-                headers={"Retry-After": RETRY_AFTER_HINT},
+                headers={"Retry-After": str(retry_after) if retry_after else RETRY_AFTER_HINT},
             )
 
         def _read_body(self):
@@ -398,6 +408,9 @@ def _make_handler(srv: EngineServer):
                     # An engine process's accountant carries its own
                     # cost accumulations (slot/page-seconds by tenant).
                     or handle_tenant_request(path, query)
+                    # QoS queue breakdown: the live engine's class/lane
+                    # depths, deficits, preemption + resume counters.
+                    or handle_qos_request(path, query)
                     or handle_history_request(path, query)
                     or handle_debug_request(path, query)
                 )
@@ -468,6 +481,20 @@ def _make_handler(srv: EngineServer):
             # scheduler prices the request's slot/page-seconds to it.
             # Absent (direct clients, canary probes) = un-attributed.
             tenant = sanitize_tenant(self.headers.get(TENANT_HEADER, ""))
+            # QoS class: the proxy validates, strips, and restamps
+            # X-Priority (like the tenant header), so whatever arrives
+            # here is trusted. Lenient parse — this port is
+            # cluster-internal, and header drift (old proxy, a test
+            # harness) should degrade to standard, not 400.
+            priority = normalize_priority(self.headers.get(PRIORITY_HEADER, "")) or DEFAULT_CLASS
+            # Preemptible stamp: only the proxy sets it (replayable
+            # batch streams), and never together with a planned handoff
+            # — a request is handed off OR preempted in a flight, not
+            # both; the engine enforces the exclusion again here.
+            preemptible = (
+                self.headers.get(PREEMPTIBLE_HEADER) == "1"
+                and self.headers.get("X-Handoff-Planned") != "1"
+            )
             resume_tokens = 0
             rt_hdr = self.headers.get("X-Resume-Tokens", "")
             if rt_hdr:
@@ -509,11 +536,13 @@ def _make_handler(srv: EngineServer):
                     self._completions(
                         body, chat=False, trace_ctx=trace_ctx, deadline=deadline,
                         resume_tokens=resume_tokens, tenant=tenant,
+                        priority=priority, preemptible=preemptible,
                     )
                 elif path == "/v1/chat/completions":
                     self._completions(
                         body, chat=True, trace_ctx=trace_ctx, deadline=deadline,
                         resume_tokens=resume_tokens, tenant=tenant,
+                        priority=priority, preemptible=preemptible,
                     )
                 elif path == "/v1/embeddings":
                     self._embeddings(body)
@@ -606,8 +635,13 @@ def _make_handler(srv: EngineServer):
                 return None, None
             return prompt, None
 
-        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None, resume_tokens=0, tenant=""):
+        def _completions(self, body: dict, chat: bool, trace_ctx=None, deadline=None, resume_tokens=0, tenant="",
+                         priority: str = DEFAULT_CLASS, preemptible: bool = False):
             tok = srv.engine.tokenizer
+            # Belt over the proxy stamp: preemption resumes via the
+            # stream-replay cursor, so a non-streaming body can never
+            # be preemptible.
+            preemptible = preemptible and bool(body.get("stream"))
             prompt_ids = None
             if chat:
                 messages = body.get("messages")
@@ -776,6 +810,7 @@ def _make_handler(srv: EngineServer):
                     r = srv.engine.submit(
                         prompt_ids, p_i, adapter=adapter, trace_ctx=trace_ctx,
                         deadline=deadline, tenant=tenant,
+                        priority=priority, preemptible=preemptible,
                     )
                     if r.trace is not None:
                         r.trace.model = srv.model_name
@@ -792,7 +827,9 @@ def _make_handler(srv: EngineServer):
                 # sibling choices MUST be cancelled or they decode for a
                 # response that will never be written.
                 _cancel_all(reqs)
-                return self._saturated()
+                return self._saturated(
+                    retry_after=srv.engine.qos_retry_after(priority)
+                )
             except BaseException:
                 # Any other early exit (engine stopping, injected fault,
                 # handler thread dying): same sibling-leak hazard.

@@ -366,6 +366,8 @@ DEBUG_INDEX: tuple[tuple[str, str, str], ...] = (
      "synthetic canary prober state per model (operator-side)"),
     ("/debug/tenants", "both",
      "per-tenant usage metering: rolling-window share, tokens, latency attainment, cost proxies, heavy-hitter ranking"),
+    ("/debug/qos", "both",
+     "QoS scheduling: per-class queue depth/wait/shed, per-tenant fair-share deficits, preemption + resume counters"),
     ("/debug/endpoints", "operator",
      "per-model circuit-breaker view: endpoint states, consecutive failures, in-flight"),
     ("/debug/routing", "operator",

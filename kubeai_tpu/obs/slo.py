@@ -74,13 +74,19 @@ class SLObjective:
     # must violate the latency objective, not satisfy it. None = all
     # series are good candidates (unlabeled histograms).
     good_label: tuple[str, str] | None = None
+    # Restrict the whole objective to series carrying this (label,
+    # value) pair — e.g. one queue-wait objective per priority class
+    # over the shared kubeai_qos_queue_wait_seconds histogram. Unlike
+    # good_label, non-matching series are excluded from the TOTAL too:
+    # they belong to a sibling objective, not to this one's traffic.
+    series_label: tuple[str, str] | None = None
 
 
 from kubeai_tpu.utils import env_float as _env_float  # noqa: E402 — shared knob parser
 
 
 def default_objectives() -> list[SLObjective]:
-    return [
+    out = [
         SLObjective(
             name="ttft", kind="latency", metric="kubeai_engine_ttft_seconds",
             threshold_s=_env_float("KUBEAI_SLO_TTFT_SECONDS", 2.0),
@@ -97,6 +103,23 @@ def default_objectives() -> list[SLObjective]:
             target=_env_float("KUBEAI_SLO_ERROR_TARGET", 0.999),
         ),
     ]
+    # Per-class queue-wait objectives (docs/qos.md): one slice of the
+    # shared class-labeled histogram each. Interactive's budget is tight
+    # (preemption exists to keep it), batch's is deliberately loose —
+    # batch WAITING is the design, batch starving forever is not.
+    for cls, thr_default, tgt_default in (
+        ("interactive", 0.5, 0.99),
+        ("standard", 2.0, 0.95),
+        ("batch", 30.0, 0.9),
+    ):
+        out.append(SLObjective(
+            name=f"qos_wait_{cls}", kind="latency",
+            metric="kubeai_qos_queue_wait_seconds",
+            threshold_s=_env_float(f"KUBEAI_SLO_QOS_{cls.upper()}_SECONDS", thr_default),
+            target=_env_float(f"KUBEAI_SLO_QOS_{cls.upper()}_TARGET", tgt_default),
+            series_label=("class", cls),
+        ))
+    return out
 
 
 def bucket_quantile(bounds, counts, q: float) -> float | None:
@@ -167,7 +190,12 @@ def _page_cumulative(page: dict, obj: SLObjective) -> tuple[float, float, float 
     finite one when the threshold exceeds them all (same rule as the
     local registry path)."""
     if obj.kind == "latency":
-        total = sum(v for _, v in page.get(obj.metric + "_count", []))
+        total = sum(
+            v
+            for labels, v in page.get(obj.metric + "_count", [])
+            if obj.series_label is None
+            or labels.get(obj.series_label[0]) == obj.series_label[1]
+        )
         groups: dict[tuple, list[tuple[float, float]]] = {}
         for labels, v in page.get(obj.metric + "_bucket", []):
             try:
@@ -181,6 +209,8 @@ def _page_cumulative(page: dict, obj: SLObjective) -> tuple[float, float, float 
         good = 0.0
         eff: float | None = None
         for key, items in groups.items():
+            if obj.series_label is not None and obj.series_label not in key:
+                continue  # another objective's slice of this histogram
             if obj.good_label is not None and obj.good_label not in key:
                 continue  # non-good series still counted in total above
             finite = sorted(p for p in items if p[0] != float("inf"))
@@ -194,6 +224,10 @@ def _page_cumulative(page: dict, obj: SLObjective) -> tuple[float, float, float 
         return good, total, eff
     bad = total = 0.0
     for labels, v in page.get(obj.metric, []):
+        if obj.series_label is not None and labels.get(
+            obj.series_label[0]
+        ) != obj.series_label[1]:
+            continue
         total += v
         if labels.get(obj.error_label) == obj.error_value:
             bad += v
@@ -300,6 +334,8 @@ class SLOMonitor:
             effective = m.buckets[k]
             good = total = 0.0
             for key, (counts, _, n) in m.snapshot().items():
+                if obj.series_label is not None and obj.series_label not in key:
+                    continue
                 total += n
                 if obj.good_label is None or obj.good_label in key:
                     good += sum(counts[: k + 1])
@@ -308,6 +344,8 @@ class SLOMonitor:
             return 0.0, 0.0, None
         bad = total = 0.0
         for key, v in m.snapshot().items():
+            if obj.series_label is not None and obj.series_label not in key:
+                continue
             total += v
             if (obj.error_label, obj.error_value) in key:
                 bad += v

@@ -57,6 +57,13 @@ class Request:
     tenant: str = ""
     canary: bool = False
     meter: object = None
+    # QoS class (kubeai_tpu/qos): resolved once by the proxy handler
+    # (X-Priority header > body "priority" field > tenant default) and
+    # stamped engine-ward as X-Priority after the inbound copy is
+    # stripped. priority_hint carries the body field's raw value —
+    # proxy-consumed like "timeout", stripped before forwarding.
+    priority: str = ""
+    priority_hint: str = ""
 
     @property
     def load_balancing(self) -> mt.LoadBalancing:
@@ -211,6 +218,12 @@ def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, s
         field_timeout = parse_request_timeout(data.pop("timeout"), "timeout")
         if timeout is None:
             timeout = field_timeout
+    # "priority" is proxy-consumed the same way: the resolved class
+    # travels engine-ward as the restamped X-Priority header, never as a
+    # body field the engine would reject as unknown.
+    priority_hint = ""
+    if isinstance(data, dict) and "priority" in data:
+        priority_hint = str(data.pop("priority") or "")
     try:
         body = body_for_path(path, data)
     except LookupError as e:
@@ -235,6 +248,7 @@ def parse_request(model_client, raw_body: bytes, path: str, headers: dict[str, s
         body=body,
         model_obj=model,
         timeout=timeout,
+        priority_hint=priority_hint,
     )
     if model.spec.load_balancing.strategy == mt.PREFIX_HASH_STRATEGY:
         req.prefix = body.prefix(model.spec.load_balancing.prefix_hash.prefix_char_length)

@@ -33,6 +33,16 @@ from kubeai_tpu.obs.tenants import (
     extract_tenant,
 )
 from kubeai_tpu.proxy.apiutils import APIError, Request, parse_request
+from kubeai_tpu.qos import (
+    DEFAULT_CLASS,
+    PREEMPTIBLE_HEADER,
+    PRIORITY_HEADER,
+    acquire_resume_upstream,
+    record_resolved,
+    record_resume,
+    resolve_priority,
+)
+from kubeai_tpu.qos import is_preempt_event as _is_preempt_event
 from kubeai_tpu.proxy.recovery import (
     M_BUDGET_REMAINING,
     HedgeTracker,
@@ -135,6 +145,21 @@ class ModelProxy:
             req.tenant = tenant
             req.canary = is_canary
             req.meter = meter
+            # QoS class (docs/qos.md): validated header > body field >
+            # per-tenant default. Resolved ONCE here; an invalid
+            # explicit value is a client error, and the inbound header
+            # is stripped + restamped downstream exactly like the
+            # tenant header so lanes can't be forged past the proxy.
+            hdr_priority = next(
+                (v for k, v in headers.items() if k.lower() == PRIORITY_HEADER.lower()),
+                "",
+            )
+            try:
+                req.priority = resolve_priority(hdr_priority, req.priority_hint, tenant)
+            except ValueError as e:
+                raise APIError(400, str(e))
+            record_resolved(req.priority)
+            tb.attrs["priority"] = req.priority
             # Honor an inbound correlation id; otherwise use the parsed id.
             from kubeai_tpu.proxy.apiutils import sanitize_request_id
 
@@ -276,9 +301,20 @@ class ModelProxy:
             if k.lower() not in (
                 "x-request-id", "traceparent", "x-request-deadline",
                 "x-handoff-planned", "x-kubeai-tenant",
+                "x-priority", "x-preemptible",
             )
         }
         headers["X-Request-ID"] = req.id
+        # QoS hop: the VALIDATED class (inbound copies stripped above).
+        headers[PRIORITY_HEADER] = req.priority or DEFAULT_CLASS
+        # Preemptible stamp: batch streams the replay machinery can
+        # resume — and never a flight with a planned handoff (one
+        # resume dial per flight; handoff wins, it was planned first).
+        preemptible = (
+            req.priority == "batch" and replayable and not handoff_planned
+        )
+        if preemptible:
+            headers[PREEMPTIBLE_HEADER] = "1"
         # Internal tenant hop: inbound copies were stripped above (an
         # external client must not choose its attribution bucket); the
         # engine's cost accounting keys on this header. Canary probes
@@ -449,6 +485,7 @@ class ModelProxy:
                     req, path, dict(headers), body, release, cancelled, tb,
                     resp, conn, done, addr, t_conn, failed_addrs, remaining,
                     handoff=dspec if handoff_planned else None, meter=meter,
+                    preemptible=preemptible,
                 )
             else:
                 # Non-replayable SSE is still re-framed event-at-a-time
@@ -619,7 +656,7 @@ class ModelProxy:
         _, a, d, resp, conn, t_start = winner
         return resp, conn, a, d, t_start
 
-    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining, handoff=None, meter=None):
+    def _stream_with_replay(self, req, path, base_headers, body, release, cancelled, tb, resp, conn, done, addr, t_conn, failed_addrs, remaining, handoff=None, meter=None, preemptible=False):
         """Stream an SSE body with mid-stream replay: events are
         forwarded whole (a half-event from a dying upstream never
         reaches the client); when the upstream dies after N delivered
@@ -641,7 +678,16 @@ class ModelProxy:
         replica carrying the same resume cursor a crash replay would,
         and a decode replica dying AFTER the cutover falls back to the
         ordinary replay path (req.role keeps routing to the decode
-        pool)."""
+        pool).
+
+        *preemptible* arms the third variant: the engine may seize this
+        batch stream's slot mid-decode for a waiting interactive
+        request, ending it with a ``finish_reason: "preempted"`` marker
+        (docs/qos.md). Same mechanics as the handoff — marker withheld,
+        re-dispatch with the resume cursor — except no endpoint is
+        blacklisted (the preempting replica is healthy and is the
+        natural resume target) and a flight can be preempted more than
+        once."""
         forwarded = 0  # data events delivered to the client (excl. [DONE])
         suppress = 0  # data events to drop from the current (replayed) stream
         replays = 0
@@ -651,6 +697,7 @@ class ModelProxy:
             while True:
                 died: Exception | None = None
                 cutover = False
+                preempted = False
                 try:
                     for ev in sse_events(_chunk_reader(resp)):
                         if handoff is not None and _is_handoff_event(ev):
@@ -658,6 +705,12 @@ class ModelProxy:
                             # never forwarded — the decode stream owns
                             # the real finish.
                             cutover = True
+                            break
+                        if preemptible and _is_preempt_event(ev):
+                            # The engine parked this batch stream to
+                            # admit interactive work: never forwarded —
+                            # the resumed stream owns the real finish.
+                            preempted = True
                             break
                         if meter is not None and meter.observe_event(ev):
                             # Proxy-injected usage chunk: metered, then
@@ -694,6 +747,40 @@ class ModelProxy:
                     )
                     handoff = None  # one planned cutover per request
                     suppress = forwarded
+                    continue
+                if preempted:
+                    # The replica shed this batch stream ON PURPOSE —
+                    # clean success for the breaker — and stays
+                    # routable: once its interactive burst drains it is
+                    # the natural resume target (warm prefix cache).
+                    self.lb.report_result(
+                        req.model_name, addr, ok=True, started_at=t_conn
+                    )
+                    try:
+                        conn.close()
+                    finally:
+                        done()
+                    conn = None
+                    done = None
+                    if tb is not None:
+                        tb.add_span(
+                            "preempted", t_conn,
+                            endpoint=addr, delivered_events=forwarded,
+                        )
+                    log.info(
+                        "request id=%s preempted by %s after %d events; resuming",
+                        req.id, addr, forwarded,
+                    )
+                    resp, conn, done, addr, t_conn = acquire_resume_upstream(
+                        self, req, path, base_headers, body, cancelled,
+                        remaining, forwarded,
+                    )
+                    record_resume()
+                    suppress = forwarded
+                    log.info(
+                        "request id=%s resumed on %s (resume at event %d)",
+                        req.id, addr, forwarded,
+                    )
                     continue
                 if died is None:
                     expected = getattr(resp, "length", None)

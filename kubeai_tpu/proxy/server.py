@@ -34,6 +34,7 @@ from kubeai_tpu.proxy.apiutils import (
     parse_label_selector,
     sanitize_request_id,
 )
+from kubeai_tpu.qos import handle_qos_request
 
 log = logging.getLogger("kubeai_tpu.openaiserver")
 
@@ -276,6 +277,9 @@ def _make_handler(srv: OpenAIServer):
                     or handle_incident_request(path, query)
                     or handle_canary_request(path, query)
                     or handle_tenant_request(path, query)
+                    # QoS: operator-side class counters (an in-process
+                    # stack also carries the engine queue breakdown).
+                    or handle_qos_request(path, query)
                     or handle_history_request(path, query)
                     or handle_debug_request(path, query)
                 )

@@ -1,0 +1,69 @@
+"""Multi-tenant QoS: priority lanes, weighted-fair scheduling, and a
+preemptible batch tier (docs/qos.md).
+
+Layout mirrors the obs/ package style — small focused modules, the
+package namespace re-exporting the seams the proxy and engine thread
+through:
+
+- classes.py  — the class lattice, header names, and the proxy-side
+  resolution/validation rules (header > body field > tenant default).
+- queue.py    — QoSQueue: class-aware admission queue with per-tenant
+  deficit-round-robin lanes, shed thresholds, and per-class queue-wait
+  budgets. Drop-in for the engine's old queue.Queue (same
+  put_nowait/get_nowait/qsize surface, same queue.Full/Empty errors).
+- preempt.py  — the preemption finish marker, its SSE detector, and
+  the proxy-side resume dial (modeled on disagg/handoff.py).
+- stats.py    — every kubeai_qos_* metric registration (the metrics
+  lint pins them to this package), the preemption-storm incident
+  tracker, and the GET /debug/qos handler.
+"""
+
+from kubeai_tpu.qos.classes import (
+    CLASSES,
+    DEFAULT_CLASS,
+    PREEMPTIBLE_HEADER,
+    PRIORITY_HEADER,
+    normalize_priority,
+    rank,
+    resolve_priority,
+    tenant_default_class,
+)
+from kubeai_tpu.qos.preempt import (
+    PREEMPT_FINISH_REASON,
+    PreemptResumeError,
+    acquire_resume_upstream,
+    is_preempt_event,
+)
+from kubeai_tpu.qos.queue import QoSQueue
+from kubeai_tpu.qos.stats import (
+    handle_qos_request,
+    install_queue,
+    record_admitted,
+    record_preemption,
+    record_resolved,
+    record_resume,
+    uninstall_queue,
+)
+
+__all__ = [
+    "CLASSES",
+    "DEFAULT_CLASS",
+    "PREEMPTIBLE_HEADER",
+    "PREEMPT_FINISH_REASON",
+    "PRIORITY_HEADER",
+    "PreemptResumeError",
+    "QoSQueue",
+    "acquire_resume_upstream",
+    "handle_qos_request",
+    "install_queue",
+    "is_preempt_event",
+    "normalize_priority",
+    "rank",
+    "record_admitted",
+    "record_preemption",
+    "record_resolved",
+    "record_resume",
+    "resolve_priority",
+    "tenant_default_class",
+    "uninstall_queue",
+]

@@ -1,0 +1,78 @@
+"""Priority-class lattice and resolution rules.
+
+Three classes, strictly ordered: interactive > standard > batch.
+A request's class is resolved ONCE, at the proxy, from (in precedence
+order) the X-Priority header, the body's `priority` field, and the
+per-tenant default map — then stamped engine-ward as X-Priority after
+the inbound copy is stripped, exactly the hygiene the tenant header
+gets (proxy/handler.py): clients cannot forge another lane by talking
+to an engine pod directly through the proxy.
+
+The engine side uses the lenient `normalize_priority` instead of
+`resolve_priority`: its port is cluster-internal, and header drift
+(an old proxy, a test harness) should degrade to `standard`, not 400.
+"""
+
+from __future__ import annotations
+
+import os
+
+CLASSES: tuple[str, ...] = ("interactive", "standard", "batch")
+DEFAULT_CLASS = "standard"
+
+# Stamped by the proxy after validation; stripped from inbound requests
+# first so the client-supplied copy never reaches an engine.
+PRIORITY_HEADER = "X-Priority"
+# Stamped by the proxy ONLY for replayable batch streams that are not
+# already planned for a disagg handoff — the engine treats it as "this
+# request's slot may be seized mid-decode".
+PREEMPTIBLE_HEADER = "X-Preemptible"
+
+_RANK = {c: i for i, c in enumerate(CLASSES)}
+
+
+def rank(priority: str) -> int:
+    """Dequeue order: 0 (interactive) serves before 2 (batch).
+    Unknown strings rank with standard."""
+    return _RANK.get(priority, _RANK[DEFAULT_CLASS])
+
+
+def normalize_priority(value: str | None) -> str:
+    """Lenient form: the class if `value` names one (any case,
+    surrounding whitespace ignored), else ""."""
+    if not value:
+        return ""
+    v = value.strip().lower()
+    return v if v in _RANK else ""
+
+
+def tenant_default_class(tenant: str) -> str:
+    """Per-tenant default class from KUBEAI_QOS_TENANT_CLASS, a comma
+    list of <hashed-tenant-id>=<class> pairs (the same hashed ids
+    /debug/tenants reports). Read per-call like the other env knobs so
+    tests and operators can flip it without a restart."""
+    spec = os.environ.get("KUBEAI_QOS_TENANT_CLASS", "")
+    if not spec or not tenant:
+        return ""
+    for part in spec.split(","):
+        key, _, cls = part.strip().partition("=")
+        if key == tenant and cls.strip().lower() in _RANK:
+            return cls.strip().lower()
+    return ""
+
+
+def resolve_priority(header_value: str, body_value: str, tenant: str) -> str:
+    """Proxy-side resolution: header > body `priority` field > tenant
+    default > standard. An EXPLICIT value that names no class raises
+    ValueError (the proxy maps it to a 400) — silently downgrading a
+    typo like "interctive" to standard would hide the client bug."""
+    for value, origin in ((header_value, PRIORITY_HEADER), (body_value, "priority")):
+        if value and value.strip():
+            got = normalize_priority(value)
+            if not got:
+                raise ValueError(
+                    f"invalid {origin} value {value.strip()!r}: "
+                    f"expected one of {', '.join(CLASSES)}"
+                )
+            return got
+    return tenant_default_class(tenant) or DEFAULT_CLASS

@@ -1,0 +1,185 @@
+"""QoS metric surface, preemption-storm tracking, and GET /debug/qos.
+
+Every kubeai_qos_* registration lives in this module and every write
+carrying a `class`/`priority` label lives in this package — both are
+pinned by tests/test_metrics_lint.py, the same way the tenant label is
+pinned to the bounded accountant. Class cardinality is fixed (three
+classes) and the one tenant-labeled series (fair deficit) rides the
+queue's bounded lane set, which folds overflow into __other__.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.obs.incidents import publish_trigger
+from kubeai_tpu.qos.classes import CLASSES
+from kubeai_tpu.utils import env_float
+
+M_DEPTH = default_registry.gauge(
+    "kubeai_qos_queue_depth",
+    "requests waiting in the engine admission queue, by priority class",
+)
+M_WAIT = default_registry.histogram(
+    "kubeai_qos_queue_wait_seconds",
+    "arrival-to-slot-admission wait by priority class (per-class SLO input)",
+    buckets=(0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.0, 5.0, 10.0, 30.0),
+)
+M_REQS = default_registry.counter(
+    "kubeai_qos_requests_total",
+    "requests accepted into the engine admission queue, by priority class",
+)
+M_SHED = default_registry.counter(
+    "kubeai_qos_shed_total",
+    "requests refused (429) by class-aware admission control under "
+    "saturation, by priority class — batch sheds first, interactive last",
+)
+M_BUDGET_DROPS = default_registry.counter(
+    "kubeai_qos_budget_drops_total",
+    "queued requests dropped because their per-class queue-wait budget "
+    "expired before a slot opened, by priority class",
+)
+M_DEFICIT = default_registry.gauge(
+    "kubeai_qos_fair_deficit",
+    "deficit-round-robin token balance per tenant lane within a priority "
+    "class (bounded lanes; overflow tenants fold into __other__)",
+)
+M_PREEMPTIONS = default_registry.counter(
+    "kubeai_qos_preemptions_total",
+    "batch decode slots seized mid-stream to admit a waiting interactive "
+    "request",
+)
+M_PREEMPTED_TOKENS = default_registry.counter(
+    "kubeai_qos_preempted_tokens_total",
+    "generated tokens discarded at preemption (the deterministic re-run "
+    "regenerates them; the proxy's resume cursor dedups the stream)",
+)
+M_RESUMES = default_registry.counter(
+    "kubeai_qos_resumes_total",
+    "preempted batch streams the proxy re-dispatched with their replay "
+    "cursor (X-Resume-Tokens)",
+)
+M_PROXY_REQS = default_registry.counter(
+    "kubeai_qos_proxy_requests_total",
+    "requests entering the proxy by resolved priority class (client-facing "
+    "twin of kubeai_qos_requests_total; differs by sheds/retries)",
+)
+
+_lock = threading.Lock()
+# Plain-int mirrors of the counters so /debug/qos can serve a JSON
+# snapshot without reaching into registry internals.
+_counts = {
+    "preemptions": 0,
+    "preempted_tokens": 0,
+    "resumes": 0,
+}
+_resolved: dict[str, int] = {c: 0 for c in CLASSES}
+_preempt_times: deque[float] = deque()
+_queue = None  # the live engine QoSQueue, installed by Engine.start()
+
+
+def record_resolved(priority: str) -> None:
+    """One request entered the proxy at this class."""
+    M_PROXY_REQS.inc(labels={"class": priority})
+    with _lock:
+        _resolved[priority] = _resolved.get(priority, 0) + 1
+
+
+def record_admitted(priority: str, wait_s: float) -> None:
+    """A queued request won a decode slot after wait_s in line."""
+    M_WAIT.observe(max(wait_s, 0.0), labels={"class": priority})
+
+
+def record_resume() -> None:
+    M_RESUMES.inc()
+    with _lock:
+        _counts["resumes"] += 1
+
+
+def record_preemption(generated_tokens: int, now: float | None = None) -> None:
+    """A batch slot was seized. Feeds the counters and the
+    qos_preemption_storm trigger: more than KUBEAI_QOS_STORM_COUNT
+    preemptions inside KUBEAI_QOS_STORM_WINDOW seconds means interactive
+    arrivals are persistently outrunning non-batch capacity — churning
+    batch work instead of finishing it — which is an autoscaling signal,
+    not a scheduling one. The incident bus debounces repeats."""
+    now = time.monotonic() if now is None else now
+    window = env_float("KUBEAI_QOS_STORM_WINDOW", 30.0)
+    limit = int(env_float("KUBEAI_QOS_STORM_COUNT", 10))
+    M_PREEMPTIONS.inc()
+    M_PREEMPTED_TOKENS.inc(max(int(generated_tokens), 0))
+    storm = 0
+    with _lock:
+        _counts["preemptions"] += 1
+        _counts["preempted_tokens"] += max(int(generated_tokens), 0)
+        _preempt_times.append(now)
+        while _preempt_times and _preempt_times[0] < now - window:
+            _preempt_times.popleft()
+        if limit > 0 and len(_preempt_times) >= limit:
+            storm = len(_preempt_times)
+    if storm:
+        publish_trigger(
+            "qos_preemption_storm",
+            detail={
+                "preemptions_in_window": storm,
+                "window_seconds": window,
+            },
+            key="qos",
+        )
+
+
+def install_queue(q) -> None:
+    """Point /debug/qos at the live engine queue (Engine.start())."""
+    global _queue
+    _queue = q
+
+
+def uninstall_queue(q) -> None:
+    """Identity-checked, like unregister_engine_debug_section: a stopped
+    engine must not unhook a newer one's queue."""
+    global _queue
+    if _queue is q:
+        _queue = None
+
+
+def qos_snapshot() -> dict:
+    with _lock:
+        doc = {
+            "classes": list(CLASSES),
+            "preemptions": _counts["preemptions"],
+            "preempted_tokens": _counts["preempted_tokens"],
+            "resumes": _counts["resumes"],
+            "proxy_requests": dict(_resolved),
+            "storm_window_preemptions": len(_preempt_times),
+        }
+    q = _queue
+    if q is not None:
+        doc["queue"] = q.snapshot()
+    return doc
+
+
+def handle_qos_request(path: str, query) -> tuple[int, str, bytes] | None:
+    """GET /debug/qos — per-class depth/wait/shed, per-tenant fair-share
+    deficits, preemption + resume counters. Served by both the operator
+    (proxy-side counters) and the engine (full queue breakdown)."""
+    if path != "/debug/qos":
+        return None
+    body = json.dumps(qos_snapshot(), indent=2, sort_keys=True).encode()
+    return 200, "application/json", body
+
+
+def reset_for_tests() -> None:
+    """Zero the module mirrors (counters in the registry are global and
+    monotonic; tests diff those instead)."""
+    global _queue
+    with _lock:
+        for k in _counts:
+            _counts[k] = 0
+        _resolved.clear()
+        _resolved.update({c: 0 for c in CLASSES})
+        _preempt_times.clear()
+    _queue = None

@@ -477,7 +477,10 @@ class TestOperatorDebugEndpoints:
         srv, *_ = api
         doc = self.get(srv, "/debug/slo")
         names = [o["name"] for o in doc["objectives"]]
-        assert names == ["ttft", "e2e", "error_rate"]
+        assert names == [
+            "ttft", "e2e", "error_rate",
+            "qos_wait_interactive", "qos_wait_standard", "qos_wait_batch",
+        ]
 
     def test_unwired_routes_404(self):
         import types

@@ -71,6 +71,7 @@ def test_registered_metrics_have_help_and_prefix():
 
 
 ACCOUNTANT = pathlib.Path("kubeai_tpu") / "obs" / "tenants.py"
+QOS_PKG = ("kubeai_tpu", "qos")
 
 
 def test_tenant_metrics_registered_only_through_accountant():
@@ -91,21 +92,13 @@ def test_tenant_metrics_registered_only_through_accountant():
     ), "tenant metrics vanished from the accountant — lint scan broken?"
 
 
-def test_tenant_label_written_only_by_accountant():
-    """Cardinality rule: any metric write whose labels dict carries a
-    `tenant` key must be inside kubeai_tpu/obs/tenants.py, where the
-    top-K accountant bounds the label population. A tenant label
-    written anywhere else is unbounded cardinality (one series per API
-    key) and fails this lint."""
-    _WRITERS = {"inc", "set", "observe", "add", "remove"}
+_WRITERS = {"inc", "set", "observe", "add", "remove"}
 
-    def labels_dicts(call: ast.Call):
-        for node in list(call.args) + [kw.value for kw in call.keywords]:
-            if isinstance(node, ast.Dict):
-                yield node
 
-    violations = []
-    hits = 0
+def _labeled_writes(label_key):
+    """(rel_path, lineno) for every metric-writer call whose labels dict
+    carries `label_key` as a literal key, across the whole package."""
+    out = []
     for path in sorted(PKG.rglob("*.py")):
         rel = path.relative_to(REPO)
         tree = ast.parse(path.read_text(), filename=str(path))
@@ -116,20 +109,75 @@ def test_tenant_label_written_only_by_accountant():
                 and node.func.attr in _WRITERS
             ):
                 continue
-            for d in labels_dicts(node):
-                has_tenant = any(
-                    isinstance(k, ast.Constant) and k.value == "tenant"
-                    for k in d.keys
-                )
-                if not has_tenant:
+            for d in list(node.args) + [kw.value for kw in node.keywords]:
+                if not isinstance(d, ast.Dict):
                     continue
-                hits += 1
-                if rel != ACCOUNTANT:
-                    violations.append(
-                        f"{rel}:{node.lineno}: metric written with a "
-                        "`tenant` label outside the bounded accountant"
-                    )
-    assert hits > 0, "no tenant-labeled writes found at all — lint scan broken?"
+                if any(
+                    isinstance(k, ast.Constant) and k.value == label_key
+                    for k in d.keys
+                ):
+                    out.append((rel, node.lineno))
+    return out
+
+
+def test_tenant_label_written_only_by_accountant():
+    """Cardinality rule: any metric write whose labels dict carries a
+    `tenant` key must be inside kubeai_tpu/obs/tenants.py, where the
+    top-K accountant bounds the label population — or inside
+    kubeai_tpu/qos/, whose fair-share lanes fold past-top-K tenants into
+    `__other__` with the same bounded discipline. A tenant label written
+    anywhere else is unbounded cardinality (one series per API key) and
+    fails this lint."""
+    writes = _labeled_writes("tenant")
+    violations = [
+        f"{rel}:{lineno}: metric written with a `tenant` label outside "
+        "the bounded accountant / QoS lanes"
+        for rel, lineno in writes
+        if rel != ACCOUNTANT and rel.parts[:2] != QOS_PKG
+    ]
+    assert writes, "no tenant-labeled writes found at all — lint scan broken?"
+    assert not violations, "\n".join(violations)
+
+
+def test_qos_metrics_registered_only_in_qos():
+    """Registration rule mirroring the tenant accountant's: every
+    kubeai_qos_* metric lives under kubeai_tpu/qos/, where class names
+    are a fixed enum and tenant lanes are bounded. Registering one
+    elsewhere would let priority-class series sprout outside the
+    scheduler's control."""
+    calls = _registration_calls()
+    violations = [
+        f"{path}:{lineno}: {name} registered outside kubeai_tpu/qos/"
+        for path, lineno, name, _ in calls
+        if name is not None
+        and name.startswith("kubeai_qos_")
+        and path.parts[:2] != QOS_PKG
+    ]
+    assert not violations, "\n".join(violations)
+    assert any(
+        name is not None
+        and name.startswith("kubeai_qos_")
+        and path.parts[:2] == QOS_PKG
+        for path, _, name, _ in calls
+    ), "qos metrics vanished from kubeai_tpu/qos/ — lint scan broken?"
+
+
+def test_class_label_written_only_in_qos():
+    """Any metric write labeled by priority class (`class` or
+    `priority` label key) must live under kubeai_tpu/qos/ — the class
+    enum is the scheduler's vocabulary, and scattering per-class series
+    across the codebase would fork that vocabulary per call site."""
+    violations = []
+    hits = 0
+    for key in ("class", "priority"):
+        for rel, lineno in _labeled_writes(key):
+            hits += 1
+            if rel.parts[:2] != QOS_PKG:
+                violations.append(
+                    f"{rel}:{lineno}: metric written with a `{key}` "
+                    "label outside kubeai_tpu/qos/"
+                )
+    assert hits > 0, "no class-labeled writes found at all — lint scan broken?"
     assert not violations, "\n".join(violations)
 
 
