@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -54,6 +54,16 @@ qos-drill: ## QoS isolation proof: batch flood vs interactive p99 TTFT, preempti
 	@# counters report it. Summary under build/qos-drill/. The fast
 	@# variant runs in tier-1 (tests/test_qos.py). See docs/qos.md.
 	JAX_PLATFORMS=cpu $(PY) benchmarks/qos_drill.py
+
+gray-drill: ## gray-failure proof: 1-of-3 real replicas turns straggler, scorer soft-ejects it, p99 contained, batch tier still served
+	@# Exits nonzero unless the per-token-slowed replica is soft-ejected
+	@# by the latency scorer, fleet p99 TTFT stays within 1.25x the
+	@# healthy baseline (+CPU noise grace), ZERO requests hard-fail, the
+	@# straggler serves >=1 batch-class request, and the
+	@# endpoint_degraded incident lands. Summary under build/gray-drill/.
+	@# The fast variant runs in tier-1 (tests/test_gray_failure.py).
+	@# See docs/robustness.md#gray-failures.
+	JAX_PLATFORMS=cpu $(PY) benchmarks/gray_drill.py
 
 incident-drill: ## e2e incident-black-box smoke: real proxy+engine, injected mid-stream kill, canary detection, persisted incident + rendered report
 	@# Exits nonzero unless an incident lands with >=3 correlated

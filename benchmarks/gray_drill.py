@@ -1,0 +1,473 @@
+"""Gray-failure drill: the acceptance proof for latency-aware outlier
+ejection (docs/robustness.md#gray-failures) against a REAL serving
+stack — store → reconciler → balancer → proxy/OpenAI server → THREE
+real (CPU) engine replicas, one of which is made a straggler that no
+hard-failure defense can see: alive, ready, streaming every event,
+just dragging every token.
+
+The drill:
+
+1. measures a healthy baseline: interactive conversations through the
+   full proxy→fleet path with all three replicas fast;
+2. arms a per-token ``slow`` fault on ONE replica over the /debug/faults
+   gate (the scoped ``engine.stream@<port>`` site — the fault registry
+   is process-global, and only the straggler may drag) and drives
+   steady load while the latency scorer walks it down the weight
+   ladder into soft-ejection;
+3. verifies the acceptance bar:
+   - **containment** — fleet p99 TTFT after the scorer has acted stays
+     within 1.25x the healthy baseline plus a small absolute grace (the
+     scheduler-tick noise floor of a tiny CPU engine; see ABS_GRACE_S),
+     even though a third of the fleet is degraded;
+   - **zero hard failures** — every client request in every phase
+     completes: gray defense must never convert slowness into errors;
+   - **degraded tier still serves** — the soft-ejected straggler
+     carries at least one batch-class request (capacity is only
+     DEPRIORITIZED, never wasted);
+   - **surfaces** — the ``endpoint_degraded`` incident landed naming
+     the straggler, kubeai_endpoint_soft_ejections_total moved, and
+     /debug/health reports the ejection and the fleet median.
+
+Run: ``make gray-drill`` (summary under build/gray-drill/). ``--fast``
+is the tier-1 variant (tests/test_gray_failure.py runs it). Exit 0 =
+every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import threading
+import time
+import urllib.request
+from urllib.parse import quote
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.loadgen import parse_priority_mix, run_benchmark  # noqa: E402
+from benchmarks.qos_drill import _AlwaysLeader, _await, sse_shape  # noqa: E402
+
+from kubeai_tpu.api import model_types as mt  # noqa: E402
+from kubeai_tpu.api.core_types import KIND_POD  # noqa: E402
+from kubeai_tpu.api.model_types import Model, ModelSpec  # noqa: E402
+from kubeai_tpu.config.system import System  # noqa: E402
+from kubeai_tpu.controller.controller import ModelReconciler  # noqa: E402
+from kubeai_tpu.engine.core import EngineConfig, build_test_engine  # noqa: E402
+from kubeai_tpu.engine.sampling import SamplingParams  # noqa: E402
+from kubeai_tpu.engine.server import EngineServer  # noqa: E402
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer  # noqa: E402
+from kubeai_tpu.metrics import default_registry  # noqa: E402
+from kubeai_tpu.obs.incidents import (  # noqa: E402
+    IncidentRecorder,
+    install_recorder,
+    standard_sources,
+    uninstall_recorder,
+)
+from kubeai_tpu.proxy.handler import ModelProxy  # noqa: E402
+from kubeai_tpu.proxy.modelclient import ModelClient  # noqa: E402
+from kubeai_tpu.proxy.server import OpenAIServer  # noqa: E402
+from kubeai_tpu.runtime.store import ObjectMeta, Store  # noqa: E402
+
+MODEL = "gray-drill-model"
+REPLICAS = 3
+
+# Same reasoning as qos_drill.ABS_GRACE_S: a 1.25x-of-baseline bar alone
+# is meaningless at CPU-test-engine scale (a 40 ms baseline would demand
+# 10 ms of headroom, below the scheduler-loop tick). The grace is the
+# noise floor of the tiny engine, NOT a license to route interactive
+# traffic at a straggler — a scorer that fails to eject leaves p99
+# carrying the full per-token drag and blows through it immediately.
+ABS_GRACE_S = 0.35
+
+
+def _counter_sum(name: str) -> float:
+    """Sum a labeled counter across all label sets (0.0 if unused)."""
+    try:
+        snap = default_registry.get(name).snapshot()
+    except KeyError:
+        return 0.0
+    return float(sum(snap.values()))
+
+
+def run(fast: bool = False, verbose: bool = True) -> dict:
+    """Execute the drill; returns the summary dict. Raises
+    AssertionError on a failed acceptance check."""
+    t_start = time.monotonic()
+    # The drill arms its fault over the HTTP gate (the same surface an
+    # operator would use against a live fleet), which requires the
+    # explicit chaos opt-in.
+    saved_faults_env = os.environ.get("KUBEAI_DEBUG_FAULTS")
+    os.environ["KUBEAI_DEBUG_FAULTS"] = "1"
+
+    window_s = 1.25 if fast else 2.0
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(
+        store,
+        allow_pod_address_override=True,
+        # No half-open probes during the measured window: the drill
+        # proves ejection + degraded serving; readmission has its own
+        # unit tests (tests/test_gray_failure.py).
+        breaker_cooldown=300.0,
+        health_kwargs={
+            # Tight windows so three decay rungs fit in drill time; the
+            # entry floor drops to match the tiny drive load (the
+            # production default stays 8). Decayed endpoints are judged
+            # on any fresh sample regardless — see the ladder-freeze
+            # note in group._score.
+            "scoring_window": window_s,
+            "outlier_k": 3.0,
+            "outlier_min_requests": 2,
+            # Warmup ramp off: the drill measures the scorer, and three
+            # replicas appearing together would ramp identically anyway.
+            "slow_start_window": 0.0,
+        },
+    )
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=30)
+    # Latency hedges would race the scorer to the same conclusion (slow
+    # first byte -> try another replica) and blur attribution of WHICH
+    # defense contained p99; the drill measures the scorer alone.
+    proxy.hedge_enabled = False
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+    recorder = IncidentRecorder(
+        sources=standard_sources(lb, mc),
+        incident_dir=os.path.join("build", "gray-drill", "incidents"),
+        debounce_seconds=2.0,
+        election=_AlwaysLeader(),
+    )
+    install_recorder(recorder)
+
+    engines = []
+    servers = []
+    for _ in range(REPLICAS):
+        # Identical configs: the compile cache is shared in-process, so
+        # replicas 2 and 3 warm up nearly for free.
+        eng = build_test_engine(
+            engine_config=EngineConfig(
+                max_slots=2, max_seq_len=512, prefill_buckets=(32, 64, 128),
+                max_queue=64, decode_chunk=2,
+            )
+        )
+        eng.warmup()
+        srv = EngineServer(eng, MODEL, host="127.0.0.1", port=0)
+        srv.start()
+        engines.append(eng)
+        servers.append(srv)
+    summary: dict = {"fast": fast, "replicas": REPLICAS}
+    try:
+        engines[0].generate(
+            engines[0].tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=180,
+        )
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name=MODEL),
+                spec=ModelSpec(
+                    url="hf://drill/model", resource_profile="cpu:1",
+                    replicas=REPLICAS, min_replicas=REPLICAS,
+                ),
+            ),
+        )
+        _await(
+            lambda: len(store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})) == REPLICAS,
+            msg="model pods",
+        )
+        pods = sorted(
+            store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL}),
+            key=lambda p: p.meta.name,
+        )
+        for pod, srv in zip(pods, servers):
+            def forge(p, port=srv.port):
+                p.status.ready = True
+                p.status.pod_ip = "127.0.0.1"
+                p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+                p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(port)
+            store.mutate(KIND_POD, pod.meta.name, forge)
+        _await(
+            lambda: len(lb.get_all_addresses(MODEL)) == REPLICAS,
+            msg="all endpoints",
+        )
+        straggler = servers[-1]
+        straggler_addr = f"127.0.0.1:{straggler.port}"
+
+        convs = 3 if fast else 6
+
+        def interactive_bench():
+            return run_benchmark(
+                f"http://127.0.0.1:{api.port}/openai",
+                MODEL,
+                conversations=convs,
+                turns=2,
+                max_tokens=6,
+                temperature=0.0,
+                priority_mix=parse_priority_mix("interactive:1"),
+            )
+
+        # Compile outside the measured windows (same belt-and-suspenders
+        # as qos_drill): rerun until the JIT recompile counter is still.
+        def settle_compiles():
+            prev = -1.0
+            for _ in range(4):
+                interactive_bench()
+                n = default_registry.get(
+                    "kubeai_engine_jit_recompiles_total"
+                ).value()
+                if n == prev:
+                    return
+                prev = n
+
+        settle_compiles()
+
+        # -- phase 1: healthy baseline --------------------------------------
+        base = interactive_bench()
+        assert base["failures"] == 0, f"baseline had failures: {base['failures']}"
+        p99_base = base["ttft_ms"]["p99"] / 1000.0
+        itl_ms = base["itl_ms"]["mean"] or 1.0
+        ttft_p50_ms = base["ttft_ms"]["p50"] or 10.0
+        summary["baseline"] = {
+            "requests": base["requests"], "ttft_p99_ms": base["ttft_ms"]["p99"],
+            "itl_mean_ms": itl_ms,
+        }
+
+        # -- phase 2: one replica turns gray --------------------------------
+        # The ISSUE's 10x per-token drag, floored so the straggler's
+        # TTFT clears k x the fleet median even on a noisy CPU box.
+        slow_ms = max(10.0 * itl_ms, 6.0 * ttft_p50_ms, 75.0)
+        spec = quote(f"engine.stream@{straggler.port}=slow:{slow_ms:g}")
+        with urllib.request.urlopen(
+            f"http://{straggler_addr}/debug/faults?set={spec}", timeout=5
+        ) as r:
+            armed = json.load(r)
+        assert any(
+            f["name"] == f"engine.stream@{straggler.port}"
+            for f in armed["faults"]
+        ), f"slow fault did not arm: {armed}"
+        t_armed = time.monotonic()
+
+        # Steady drive load feeds the scorer per-attempt TTFT evidence
+        # while it walks the straggler down the ladder.
+        drive_stop = threading.Event()
+        drive_errors: list[str] = []
+
+        def drive(i: int):
+            # Short streams: the straggler's TTFT evidence is per
+            # request, and a long dragged stream would pin one driver
+            # on it for seconds between samples.
+            body = {
+                "model": MODEL, "prompt": f"drive {i}", "stream": True,
+                "temperature": 0, "max_tokens": 2,
+            }
+            while not drive_stop.is_set():
+                try:
+                    sse_shape(api.port, body, {"X-Priority": "interactive"})
+                except Exception as e:
+                    drive_errors.append(f"drive {i}: {e}")
+                    return
+
+        drivers = [
+            threading.Thread(target=drive, args=(i,), daemon=True)
+            for i in range(6 if fast else 8)
+        ]
+        for t in drivers:
+            t.start()
+
+        def straggler_entry():
+            eps = lb.health_snapshot().get(MODEL, {}).get("endpoints", [])
+            return next(
+                (e for e in eps if e["address"] == straggler_addr), None
+            )
+
+        # 1.0 -> 0.5 -> 0.25 -> soft-eject = three scoring windows of
+        # sustained evidence; generous slack for CPU scheduling.
+        deadline = time.monotonic() + 20 * window_s
+        while time.monotonic() < deadline:
+            e = straggler_entry()
+            if e and e["state"] == "soft_ejected":
+                break
+            assert not drive_errors, f"drive load errored: {drive_errors}"
+            time.sleep(0.1)
+        drive_stop.set()
+        for t in drivers:
+            t.join(timeout=60)
+        assert not drive_errors, f"drive load errored: {drive_errors}"
+        e = straggler_entry()
+        assert e and e["state"] == "soft_ejected", (
+            f"straggler was never soft-ejected: {e}"
+        )
+        eject_s = time.monotonic() - t_armed
+        summary["degrade"] = {
+            "endpoint": straggler_addr, "slow_ms": round(slow_ms, 1),
+            "scoring_window_s": window_s,
+            "ejected_after_s": round(eject_s, 1),
+        }
+
+        # -- check 1: p99 containment after the scorer acted ----------------
+        # The surviving pair now absorbs conversations the warmup had
+        # routed to the straggler; any batch-shape neither compiled yet
+        # shows up as a one-off ~700ms JIT stall that has nothing to do
+        # with gray-failure defense. Settle compiles in the 2-replica
+        # topology before measuring, exactly as before the baseline.
+        settle_compiles()
+        # Attribution snapshot: if containment fails, WHERE the slow
+        # request went matters — straggler observed_total moving means a
+        # routing leak; a JIT recompile tick means the surviving pair
+        # compiled a shape outside the settled set.
+        straggler_seen_before = straggler_entry()["observed_total"]
+        compiles_before = default_registry.get(
+            "kubeai_engine_jit_recompiles_total"
+        ).value()
+        degraded = interactive_bench()
+        assert degraded["failures"] == 0, (
+            f"interactive load failed with straggler ejected: "
+            f"{degraded['failures']}"
+        )
+        straggler_leak = (
+            straggler_entry()["observed_total"] - straggler_seen_before
+        )
+        compiles_during = (
+            default_registry.get("kubeai_engine_jit_recompiles_total").value()
+            - compiles_before
+        )
+        p99_deg = degraded["ttft_ms"]["p99"] / 1000.0
+        bound = p99_base * 1.25 + ABS_GRACE_S
+        assert p99_deg <= bound, (
+            f"fleet p99 TTFT not contained: {p99_deg * 1000:.1f}ms vs "
+            f"healthy baseline {p99_base * 1000:.1f}ms "
+            f"(bound {bound * 1000:.1f}ms) — straggler interactive leak="
+            f"{straggler_leak}, jit recompiles during bench="
+            f"{compiles_during}"
+        )
+        summary["degraded"] = {
+            "requests": degraded["requests"],
+            "ttft_p99_ms": degraded["ttft_ms"]["p99"],
+            "bound_ms": round(bound * 1000, 1),
+        }
+
+        # -- check 2: the straggler still serves the batch tier --------------
+        served_before = straggler_entry()["observed_total"]
+        batch_body = {
+            "model": MODEL, "prompt": "bulk backfill", "stream": True,
+            "temperature": 0, "max_tokens": 4,
+        }
+        batch_errors: list[str] = []
+
+        def batch_one(i: int):
+            try:
+                for _ in range(2):
+                    sse_shape(api.port, batch_body, {"X-Priority": "batch"})
+            except Exception as ex:
+                batch_errors.append(f"batch {i}: {ex}")
+
+        # Enough concurrency that LeastLoad's weighted keys push past the
+        # two healthy replicas (straggler holds weight 0.25: healthy
+        # endpoints must stack ~4 in flight each before it is chosen).
+        n_batch = 10 if fast else 14
+        batch_threads = [
+            threading.Thread(target=batch_one, args=(i,), daemon=True)
+            for i in range(n_batch)
+        ]
+        for t in batch_threads:
+            t.start()
+        for t in batch_threads:
+            t.join(timeout=120)
+        assert not any(t.is_alive() for t in batch_threads), "batch requests hung"
+        assert not batch_errors, f"batch requests errored: {batch_errors}"
+        e = straggler_entry()
+        straggler_served = e["observed_total"] - served_before
+        assert straggler_served >= 1, (
+            "soft-ejected straggler served no batch-class requests — "
+            "degraded capacity is being wasted, not deprioritized"
+        )
+        summary["batch"] = {
+            "requests": n_batch * 2,
+            "straggler_served": int(straggler_served),
+        }
+
+        # -- check 3: surfaces ----------------------------------------------
+        ejections = _counter_sum("kubeai_endpoint_soft_ejections_total")
+        assert ejections >= 1, "soft-ejection counter never moved"
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{api.port}/debug/health", timeout=10
+        ) as r:
+            health_view = json.load(r)["models"][MODEL]
+        assert health_view["scoring"]["soft_ejections"] >= 1
+        ejected_eps = [
+            ep for ep in health_view["endpoints"]
+            if ep["state"] == "soft_ejected"
+        ]
+        assert [ep["address"] for ep in ejected_eps] == [straggler_addr], (
+            f"/debug/health disagrees about the straggler: {ejected_eps}"
+        )
+        recorder.wait_idle(timeout=15)
+        incidents = [
+            i for i in recorder.snapshot()
+            if i["trigger"] == "endpoint_degraded"
+        ]
+        assert incidents, "no endpoint_degraded incident captured"
+        assert any(
+            (i.get("detail") or {}).get("endpoint") == straggler_addr
+            for i in incidents
+        ), f"incident does not name the straggler: {incidents}"
+        summary["surfaces"] = {
+            "soft_ejections_total": int(ejections),
+            "fleet_median_p95_s": health_view["scoring"]["fleet_median_p95_s"],
+            "incident_id": incidents[0]["id"],
+        }
+        summary["ok"] = True
+        summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
+        if verbose:
+            print(
+                f"gray drill: straggler {straggler_addr} "
+                f"({slow_ms:.0f}ms/token) soft-ejected in {eject_s:.1f}s; "
+                f"p99 TTFT {p99_base * 1000:.0f}ms -> "
+                f"{p99_deg * 1000:.0f}ms (bound {bound * 1000:.0f}ms), "
+                f"0 hard failures, {int(straggler_served)} batch requests "
+                f"on the straggler, incident {incidents[0]['id']}"
+            )
+        return summary
+    finally:
+        uninstall_recorder(recorder)
+        recorder.stop()
+        from kubeai_tpu import faults
+        faults.clear_all()
+        for srv in servers:
+            srv.stop()
+        api.stop()
+        lb.stop()
+        rec.stop()
+        if saved_faults_env is None:
+            os.environ.pop("KUBEAI_DEBUG_FAULTS", None)
+        else:
+            os.environ["KUBEAI_DEBUG_FAULTS"] = saved_faults_env
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("gray-drill")
+    parser.add_argument("--fast", action="store_true", help="tier-1 variant: smaller load")
+    parser.add_argument("--json", default=os.path.join("build", "gray-drill", "summary.json"))
+    args = parser.parse_args(argv)
+    try:
+        summary = run(fast=args.fast)
+    except AssertionError as e:
+        print(f"GRAY DRILL FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    print(json.dumps(summary))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

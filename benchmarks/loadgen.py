@@ -280,6 +280,75 @@ def scrape_qos_counters(base: str) -> dict[str, float] | None:
     return {labels.get("class", ""): value for labels, value in series}
 
 
+def scrape_gray_counters(base: str) -> dict | None:
+    """Gray-failure evidence from the operator's /metrics: cumulative
+    kubeai_endpoint_soft_ejections_total and the current
+    kubeai_endpoint_health_score gauge, both by endpoint. None against
+    non-operator targets (plain engines have no routing health layer)."""
+    from kubeai_tpu.metrics.registry import parse_prometheus_text
+
+    try:
+        with urllib.request.urlopen(base + "/metrics", timeout=5) as resp:
+            text = resp.read().decode()
+    except Exception:
+        return None
+    series = parse_prometheus_text(text)
+    return {
+        "soft_ejections": {
+            labels.get("endpoint", ""): value
+            for labels, value in series.get(
+                "kubeai_endpoint_soft_ejections_total", []
+            )
+        },
+        "health_scores": {
+            labels.get("endpoint", ""): value
+            for labels, value in series.get("kubeai_endpoint_health_score", [])
+        },
+    }
+
+
+def schedule_replica_degrade(base: str, after_s: float, slow_ms: float,
+                             target: dict | None = None) -> None:
+    """--degrade-replica-at: *after_s* seconds into the run, pick one
+    serving endpoint from the operator's /debug/endpoints and arm
+    ``engine.stream=slow:<ms>`` on it over HTTP — every SSE event it
+    writes from then on drags by *slow_ms*, making it a gray-failure
+    straggler (alive, ready, just slow) rather than a corpse. The
+    operator's latency scorer should decay and soft-eject it; the
+    summary's ``gray`` block reports the counter deltas. The target
+    engine must run with KUBEAI_DEBUG_FAULTS=1 (same opt-in as
+    --kill-replica-at)."""
+    from urllib.parse import quote
+
+    def run():
+        time.sleep(after_s)
+        try:
+            with urllib.request.urlopen(base + "/debug/endpoints", timeout=5) as resp:
+                models = json.load(resp)["models"]
+            addr = next(
+                ep["address"] for eps in models.values() for ep in eps
+            )
+            spec = quote(f"engine.stream=slow:{slow_ms:g}")
+            urllib.request.urlopen(
+                f"http://{addr}/debug/faults?set={spec}", timeout=5
+            ).read()
+            if target is not None:
+                target["endpoint"] = addr
+            print(
+                json.dumps({"degrade_replica": {
+                    "endpoint": addr, "at_s": after_s, "slow_ms": slow_ms,
+                }}),
+                file=sys.stderr,
+            )
+        except Exception as e:
+            print(
+                json.dumps({"degrade_replica_failed": str(e)[:200]}),
+                file=sys.stderr,
+            )
+
+    threading.Thread(target=run, daemon=True, name="loadgen-degrade").start()
+
+
 def schedule_replica_kill(base: str, after_s: float) -> None:
     """--kill-replica-at: *after_s* seconds into the run, pick one
     serving endpoint from the operator's /debug/endpoints and arm
@@ -331,6 +400,8 @@ def run_benchmark(
     slo_target: float = 0.95,
     slo_e2e_target: float = 0.99,
     kill_replica_at: float | None = None,
+    degrade_replica_at: float | None = None,
+    degrade_slow_ms: float = 200.0,
     tenant_mix: list[tuple[str, float]] | None = None,
     flood_tenant: str | None = None,
     flood_at: float | None = None,
@@ -342,7 +413,12 @@ def run_benchmark(
     *kill_replica_at*, one replica's streams are killed that many
     seconds into the run and the summary gains a ``recovery`` block
     (replayed/hedged/error-retried counts from the operator's proxy
-    counters over the run).
+    counters over the run). With *degrade_replica_at*, one replica is
+    made a gray-failure straggler (``engine.stream=slow:<ms>`` — alive
+    but dragging every token by *degrade_slow_ms*) that many seconds in,
+    and the summary gains a ``gray`` block (soft-ejection counter deltas
+    and the end-of-run per-endpoint health scores from the operator's
+    latency scorer).
 
     *tenant_mix* (see parse_tenant_mix) assigns each conversation a
     tenant by weight; every request carries that tenant's API key, so
@@ -363,8 +439,16 @@ def run_benchmark(
     base = operator_base(base_url)
     retries_before = scrape_retry_counters(base)
     qos_before = scrape_qos_counters(base) if priority_mix else None
+    gray_before = (
+        scrape_gray_counters(base) if degrade_replica_at is not None else None
+    )
+    degrade_target: dict = {}
     if kill_replica_at is not None:
         schedule_replica_kill(base, kill_replica_at)
+    if degrade_replica_at is not None:
+        schedule_replica_degrade(
+            base, degrade_replica_at, degrade_slow_ms, target=degrade_target
+        )
     rng = random.Random(seed)
     names = [n for n, _ in (tenant_mix or [])]
     weights = [w for _, w in (tenant_mix or [])]
@@ -493,6 +577,31 @@ def run_benchmark(
         # End scrape failed: emit recovery: null rather than fabricating
         # numbers from a missing sample.
 
+    # Gray-failure visibility: did the operator's latency scorer notice
+    # the straggler --degrade-replica-at created? Soft-ejection counter
+    # deltas plus the end-of-run health-score floor, from the operator's
+    # own metrics (same counters /debug/health summarizes).
+    gray = None
+    if gray_before is not None:
+        gray = {
+            "degrade_replica_at_s": degrade_replica_at,
+            "slow_ms": degrade_slow_ms,
+            "endpoint": degrade_target.get("endpoint"),
+        }
+        gray_after = scrape_gray_counters(base)
+        if gray_after is not None:
+            ej_b, ej_a = gray_before["soft_ejections"], gray_after["soft_ejections"]
+            gray["soft_ejections"] = {
+                ep: int(max(0, round(ej_a.get(ep, 0.0) - ej_b.get(ep, 0.0))))
+                for ep in ej_a
+                if ej_a.get(ep, 0.0) - ej_b.get(ep, 0.0) > 0
+            }
+            scores = gray_after["health_scores"]
+            gray["health_scores"] = {ep: round(v, 3) for ep, v in scores.items()}
+            gray["health_score_min"] = (
+                round(min(scores.values()), 3) if scores else None
+            )
+
     # Per-tenant client-side summary + the operator's attributed view
     # (/debug/tenants) and any tenant_flood incident the heavy-hitter
     # scenario produced. Hashed ids are recomputed client-side so the
@@ -589,6 +698,7 @@ def run_benchmark(
         "requests": n_requests,
         "failures": failures,
         "recovery": recovery,
+        "gray": gray,
         "tenants": tenants_block,
         "priorities": priorities_block,
         "elapsed_s": round(elapsed, 2),
@@ -655,6 +765,19 @@ def main():
              "engine must run KUBEAI_DEBUG_FAULTS=1) to exercise "
              "mid-stream replay under load; the summary's recovery "
              "block reports replayed/hedged counts",
+    )
+    parser.add_argument(
+        "--degrade-replica-at", type=float, default=None, metavar="T",
+        help="T seconds into the run, make one replica a gray-failure "
+             "straggler (arms engine.stream=slow:<--degrade-slow-ms> on "
+             "it via /debug/faults — the engine must run "
+             "KUBEAI_DEBUG_FAULTS=1): alive and ready but dragging every "
+             "token; the summary's gray block reports soft-ejection "
+             "deltas and end-of-run health scores",
+    )
+    parser.add_argument(
+        "--degrade-slow-ms", type=float, default=200.0,
+        help="per-token drag (ms) for --degrade-replica-at",
     )
     parser.add_argument(
         "--tenant-mix", default=None, metavar="NAME:W,NAME:W",
@@ -724,6 +847,8 @@ def main():
         slo_target=args.slo_target,
         slo_e2e_target=args.slo_e2e_target,
         kill_replica_at=args.kill_replica_at,
+        degrade_replica_at=args.degrade_replica_at,
+        degrade_slow_ms=args.degrade_slow_ms,
         tenant_mix=parse_tenant_mix(args.tenant_mix) if args.tenant_mix else None,
         flood_tenant=args.flood_tenant,
         flood_at=args.flood_at,

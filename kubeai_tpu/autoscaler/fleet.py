@@ -36,6 +36,11 @@ _HBM_LIMIT = "kubeai_engine_hbm_limit_bytes"
 _PREFIX_CACHED = "kubeai_engine_prefix_cached_tokens_total"
 _PREFIX_LOOKUP = "kubeai_engine_prefix_lookup_tokens_total"
 _CACHED_EVICTIONS = "kubeai_engine_kv_cached_evictions_total"
+# Engine-side TTFT histogram (sum/count): scrape deltas feed the load
+# balancer's gray-failure latency scorer with engine-observed evidence,
+# complementing the proxy's own per-attempt observations.
+_TTFT_SUM = "kubeai_engine_ttft_seconds_sum"
+_TTFT_COUNT = "kubeai_engine_ttft_seconds_count"
 # Exported by kubeai_tpu/qos/stats.py, scraped here so the autoscaler
 # can tell deferrable batch backlog apart from interactive pressure.
 _QOS_QUEUE = "kubeai_qos_queue_depth"
@@ -186,6 +191,11 @@ class FleetCollector:
         # two derivations agree by construction — counter resets
         # (engine restart) re-anchor instead of going negative.
         self._prev_tokens: dict[str, TokenRateWindow] = {}
+        # addr -> (ttft_sum, ttft_count) from the last collect: the
+        # between-collects delta mean is this scrape's latency evidence
+        # for the gray-failure scorer. Counter resets (engine restart)
+        # re-anchor instead of feeding a negative delta.
+        self._prev_ttft: dict[str, tuple[float, float]] = {}
         # addr -> full parsed /metrics page from the last collect — the
         # SLO monitor's remote source (engine histograms live in engine
         # processes; the operator only sees them through these scrapes).
@@ -264,6 +274,8 @@ class FleetCollector:
                 else None
             ),
             "kv_cached_evictions": val(_CACHED_EVICTIONS),
+            "ttft_sum": val(_TTFT_SUM),
+            "ttft_count": val(_TTFT_COUNT),
             # Per-class QoS backlog (kubeai_tpu/qos): which lanes the
             # queued work sits in — a batch-only backlog is deferrable
             # bulk, an interactive backlog is an SLO emergency.
@@ -335,6 +347,42 @@ class FleetCollector:
         if callable(snap_fn):
             for model, eps in snap_fn().items():
                 breaker[model] = {e["address"]: e["state"] for e in eps}
+        # Engine-observed TTFT deltas feed the balancer's latency
+        # scorer BEFORE the health snapshot below is taken, so each
+        # collect's evidence shows up in the same collect's view.
+        observe_fn = getattr(self.lb, "observe_latency", None)
+        for model, rec in scraped:
+            if not rec.get("ok"):
+                continue
+            addr = rec["address"]
+            cur = (rec.get("ttft_sum", 0.0), rec.get("ttft_count", 0.0))
+            prev = self._prev_ttft.get(addr)
+            self._prev_ttft[addr] = cur
+            if prev is None or not callable(observe_fn):
+                continue
+            d_sum, d_count = cur[0] - prev[0], cur[1] - prev[1]
+            if d_count > 0 and d_sum >= 0:
+                try:
+                    observe_fn(model, addr, d_sum / d_count, count=int(d_count))
+                except Exception:
+                    pass  # scoring must never break the scrape path
+        # Latency-health view (gray-failure scoring) merged per
+        # endpoint like the breaker state. Guarded: fake balancers.
+        health: dict[str, dict[str, float]] = {}
+        health_fn = getattr(self.lb, "health_snapshot", None)
+        if callable(health_fn):
+            try:
+                for model, snap in health_fn().items():
+                    health[model] = {
+                        e["address"]: (
+                            0.0
+                            if e["state"] in ("open", "soft_ejected")
+                            else e["effective_weight"]
+                        )
+                        for e in snap.get("endpoints", [])
+                    }
+            except Exception:
+                health = {}
         # Phase roles (disaggregated pools) per endpoint — "" on
         # unified pods. Guarded: tests wire fake balancers.
         roles_fn = getattr(self.lb, "get_endpoint_roles", None)
@@ -345,6 +393,7 @@ class FleetCollector:
             for e in eps:
                 e["breaker_state"] = breaker.get(model, {}).get(e["address"])
                 e["role"] = roles.get(e["address"], "")
+                e["health_score"] = health.get(model, {}).get(e["address"])
             agg = self._aggregate(eps)
             views[model] = {"endpoints": eps, "aggregate": agg}
             # Role-dimensioned sub-aggregates: the per-pool autoscaling
@@ -386,6 +435,7 @@ class FleetCollector:
             for addr in [a for a, t in self._addr_seen.items() if t < cutoff]:
                 self._addr_seen.pop(addr, None)
                 self._prev_tokens.pop(addr, None)
+                self._prev_ttft.pop(addr, None)
                 self._last_pages.pop(addr, None)
         if self.history is not None:
             try:

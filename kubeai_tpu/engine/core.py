@@ -846,24 +846,29 @@ class Engine:
                     self._cache,
                 )
                 shapes += 1
-        max_bucket = max(self.cfg.prefill_buckets)
-        *_, self._cache, self._adm_toks = self._prefill_chunk_jit(
-            self.params,
-            np.zeros((1, max_bucket), np.int32),
-            np.int32(0),
-            np.int32(max_bucket - 1),
-            np.zeros((1, self._max_pages), np.int32),
-            np.int32(0),
-            np.uint32(0),
-            np.float32(1.0),
-            np.float32(1.0),
-            np.int32(0),
-            np.zeros((Kb,), np.int32),
-            np.zeros((Kb,), np.float32),
-            self._adm_toks,
-            self._cache,
-        )
-        shapes += 1
+        # Chunked prefill pads its FINAL chunk to the smallest fitting
+        # bucket (non-final chunks are always max_bucket wide), so the
+        # serving path hits one chunk shape per bucket — warming only
+        # max_bucket leaves a mid-serving compile on the first
+        # prefix-reuse prompt whose tail lands in a smaller bucket.
+        for bucket in self.cfg.prefill_buckets:
+            *_, self._cache, self._adm_toks = self._prefill_chunk_jit(
+                self.params,
+                np.zeros((1, bucket), np.int32),
+                np.int32(0),
+                np.int32(bucket - 1),
+                np.zeros((1, self._max_pages), np.int32),
+                np.int32(0),
+                np.uint32(0),
+                np.float32(1.0),
+                np.float32(1.0),
+                np.int32(0),
+                np.zeros((Kb,), np.int32),
+                np.zeros((Kb,), np.float32),
+                self._adm_toks,
+                self._cache,
+            )
+            shapes += 1
         jax.block_until_ready(self._adm_toks)
         dur = time.monotonic() - t0
         self._update_recompile_counter()

@@ -985,6 +985,11 @@ def _make_handler(srv: EngineServer):
                 # the Nth SSE event left this replica — the chaos seam
                 # for mid-stream replica death (proxy replay under test).
                 fault("engine.stream")
+                # Scoped twin: the fault registry is process-global, so
+                # a drill running SEVERAL replicas in one process needs
+                # a per-replica site to make just one of them misbehave
+                # (engine.stream@<port>=slow:... = one gray straggler).
+                fault(f"engine.stream@{srv.port}")
                 data = f"data: {payload}\n\n".encode()
                 self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                 self.wfile.flush()

@@ -16,6 +16,12 @@ Modes (``spec`` grammar: ``mode[:arg][:key=val...]``):
   every trigger). ``skip=K`` passes the first K triggers through first —
   "fail the third call" is ``error:1:skip=2``.
 - ``delay:SECONDS`` sleep before proceeding.
+- ``slow:MS[:JITTER_MS]`` sleep MS milliseconds on EVERY trigger (plus a
+  deterministic 0..JITTER_MS spread) — per-token drag. Armed on
+  ``engine.stream`` this makes a replica a gray-failure straggler:
+  alive, passing ``/readyz``, streaming every event, just slow (the
+  one-shot ``delay`` kills no stream either, but fires once per arm
+  budget rather than dragging every event).
 - ``hang``          block until the fault is cleared (or ``max=SECONDS``
   elapses). ``clear_fault``/``clear_all`` release hung threads — chaos
   tests hang a component, assert containment, then release it.
@@ -35,6 +41,10 @@ Known sites (grep ``fault(`` for ground truth):
     engine.stream        before each SSE event the engine server writes
                          (error:1:skip=N = kill-after-N-tokens: the
                          response socket is severed like a dead replica)
+    engine.stream@PORT   scoped twin of engine.stream, fired per event by
+                         the replica listening on PORT only — lets a
+                         drill running several replicas in ONE process
+                         (shared registry) degrade a single straggler
     gang.publish         before each gang dispatch broadcast
     gang.follower        each follower recv (follower-drop: dead-peer
                          error exercising reconnect-with-backoff)
@@ -66,12 +76,13 @@ class FaultError(ConnectionError, RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("name", "mode", "arg", "times", "skip", "max_s", "hits", "fired", "release")
+    __slots__ = ("name", "mode", "arg", "arg2", "times", "skip", "max_s", "hits", "fired", "release")
 
-    def __init__(self, name: str, mode: str, arg: float | None, times: int | None, skip: int, max_s: float | None):
+    def __init__(self, name: str, mode: str, arg: float | None, times: int | None, skip: int, max_s: float | None, arg2: float | None = None):
         self.name = name
         self.mode = mode
         self.arg = arg
+        self.arg2 = arg2  # second positional (slow: jitter ms)
         self.times = times  # None = unlimited
         self.skip = skip
         self.max_s = max_s
@@ -84,6 +95,7 @@ class _Fault:
             "name": self.name,
             "mode": self.mode,
             "arg": self.arg,
+            "arg2": self.arg2,
             "times": self.times,
             "skip": self.skip,
             "hits": self.hits,
@@ -100,6 +112,7 @@ def parse_spec(name: str, spec: str) -> _Fault:
         raise ValueError(f"empty fault spec for {name!r}")
     mode, rest = parts[0], parts[1:]
     arg: float | None = None
+    arg2: float | None = None
     times: int | None = None
     skip = 0
     max_s: float | None = None
@@ -114,33 +127,38 @@ def parse_spec(name: str, spec: str) -> _Fault:
                 times = int(v)
             else:
                 raise ValueError(f"unknown fault option {k!r} in {spec!r}")
-        else:
+        elif arg is None:
             arg = float(p)
+        else:
+            arg2 = float(p)
     if mode == "error":
         if arg is not None:
             times = int(arg)
     elif mode == "delay":
         if arg is None:
             raise ValueError(f"delay fault needs seconds: {spec!r}")
+    elif mode == "slow":
+        if arg is None:
+            raise ValueError(f"slow fault needs per-trigger milliseconds: {spec!r}")
     elif mode == "hang":
         pass
     elif mode == "corrupt":
         if arg is not None:
             times = int(arg)
     else:
-        raise ValueError(f"unknown fault mode {mode!r} (error|delay|hang|corrupt)")
-    return _Fault(name, mode, arg, times, skip, max_s)
+        raise ValueError(f"unknown fault mode {mode!r} (error|delay|slow|hang|corrupt)")
+    return _Fault(name, mode, arg, times, skip, max_s, arg2=arg2)
 
 
 def set_fault(name: str, mode: str, *, times: int | None = None, skip: int = 0,
               delay: float | None = None, max_s: float | None = None) -> None:
     """Arm *mode* on failpoint *name* (replacing any armed fault there)."""
     f = _Fault(name, mode, delay, times, skip, max_s)
-    if mode == "delay" and delay is None:
-        raise ValueError("delay fault needs delay=seconds")
-    if mode not in ("error", "delay", "hang", "corrupt"):
+    if mode in ("delay", "slow") and delay is None:
+        raise ValueError(f"{mode} fault needs delay= (seconds for delay, ms for slow)")
+    if mode not in ("error", "delay", "slow", "hang", "corrupt"):
         raise ValueError(f"unknown fault mode {mode!r}")
-    if mode == "delay":
+    if mode in ("delay", "slow"):
         f.arg = delay
     with _lock:
         old = _active.get(name)
@@ -225,12 +243,21 @@ def fault(name: str, payload=None):
         if f.times is not None and f.fired >= f.times:
             return payload
         f.fired += 1
-        mode, arg, max_s, release = f.mode, f.arg, f.max_s, f.release
+        mode, arg, arg2, max_s, release = f.mode, f.arg, f.arg2, f.max_s, f.release
+        fired = f.fired
     # Act OUTSIDE the lock: a hang/delay must not block other failpoints.
     if mode == "error":
         raise FaultError(name)
     if mode == "delay":
         time.sleep(float(arg or 0.0))
+        return payload
+    if mode == "slow":
+        # Per-trigger drag in MILLISECONDS (a per-token straggler, not a
+        # one-shot stall). The optional jitter is deterministic — the
+        # golden-ratio sequence over the fired count — so a chaos run
+        # replays identically while still spreading inter-token gaps.
+        j = float(arg2 or 0.0) * ((fired * 0.6180339887) % 1.0)
+        time.sleep((float(arg or 0.0) + j) / 1000.0)
         return payload
     if mode == "hang":
         release.wait(timeout=max_s)

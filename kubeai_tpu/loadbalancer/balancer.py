@@ -56,11 +56,17 @@ class LoadBalancer:
         allow_pod_address_override: bool = False,
         breaker_threshold: int = 3,
         breaker_cooldown: float = 10.0,
+        health_kwargs: dict | None = None,
     ):
+        """*health_kwargs* are forwarded verbatim into every
+        EndpointGroup — the gray-failure scoring knobs (outlier_k,
+        scoring_window, ...) for drills/tests that need windows tighter
+        than the env defaults."""
         self.store = store
         self.allow_override = allow_pod_address_override
         self.breaker_threshold = breaker_threshold
         self.breaker_cooldown = breaker_cooldown
+        self.health_kwargs = dict(health_kwargs or {})
         self._groups: dict[str, EndpointGroup] = {}
         self._groups_lock = threading.Lock()
         self._self_ips: list[str] = []
@@ -143,6 +149,7 @@ class LoadBalancer:
                     breaker_threshold=self.breaker_threshold,
                     breaker_cooldown=self.breaker_cooldown,
                     name=model_name,
+                    **self.health_kwargs,
                 )
                 self._groups[model_name] = g
             return g
@@ -154,6 +161,18 @@ class LoadBalancer:
         connect time, time.monotonic()) lets the breaker discard stale
         successes from attempts predating an ejection."""
         self.group(model_name).report_result(addr, ok, started_at=started_at)
+
+    def observe_latency(self, model_name: str, addr: str, seconds: float, count: int = 1) -> None:
+        """Latency-evidence feed for the gray-failure scorer: the proxy
+        reports per-attempt TTFT/latency and the FleetCollector reports
+        scrape-delta means (*count* = requests the aggregate covers)."""
+        self.group(model_name).observe_latency(addr, seconds, count=count)
+
+    def health_snapshot(self) -> dict[str, dict]:
+        """model -> latency-scoring view (/debug/health)."""
+        with self._groups_lock:
+            groups = dict(self._groups)
+        return {name: g.health_snapshot() for name, g in sorted(groups.items())}
 
     def breaker_snapshot(self) -> dict[str, list[dict]]:
         """model -> per-endpoint breaker states (/debug/endpoints)."""
@@ -189,6 +208,9 @@ class LoadBalancer:
             # proxy; "" = no preference). A missing pool fails open to
             # the surviving one inside get_best_addr.
             role=getattr(req, "role", ""),
+            # QoS class: batch may route to soft-ejected endpoints
+            # (degraded-mode bulk tier).
+            priority=getattr(req, "priority", ""),
         )
         # Endpoint-pick span (duck-typed obs.SpanBuilder): this wait IS
         # the scale-from-zero cold start when no endpoint exists yet.

@@ -17,6 +17,16 @@ from collections import deque
 from dataclasses import dataclass, field
 
 from kubeai_tpu.loadbalancer.chwbl import HashRing, chwbl_choose
+from kubeai_tpu.loadbalancer.health import (
+    MIN_EFFECTIVE_WEIGHT,
+    RAMP_FLOOR,
+    WEIGHT_DECAY,
+    WEIGHT_FLOOR,
+    LatencyStats,
+    endpoint_jitter,
+    fleet_median,
+    resolve_knob,
+)
 
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs.incidents import publish_trigger
@@ -51,19 +61,36 @@ _M_LOOKUP_ITER = default_registry.histogram(
 )
 
 # Passive endpoint health (circuit breaking): per-endpoint state gauge
-# (0=closed, 1=half_open, 2=open) and an ejection counter — the
-# observable evidence of the eject -> half-open -> close lifecycle.
+# (0=closed, 1=half_open, 2=open, 3=soft_ejected) and an ejection
+# counter — the observable evidence of the eject -> half-open -> close
+# lifecycle. soft_ejected is the gray-failure rung: the endpoint is
+# alive but a latency outlier; it shares the open state's half-open
+# readmission machinery but still serves batch-class traffic.
 BREAKER_CLOSED = "closed"
 BREAKER_OPEN = "open"
 BREAKER_HALF_OPEN = "half_open"
-_STATE_VALUE = {BREAKER_CLOSED: 0, BREAKER_HALF_OPEN: 1, BREAKER_OPEN: 2}
+BREAKER_SOFT_EJECTED = "soft_ejected"
+_STATE_VALUE = {
+    BREAKER_CLOSED: 0,
+    BREAKER_HALF_OPEN: 1,
+    BREAKER_OPEN: 2,
+    BREAKER_SOFT_EJECTED: 3,
+}
 _M_ENDPOINT_STATE = default_registry.gauge(
     "kubeai_endpoint_state",
-    "circuit-breaker state per endpoint (0=closed, 1=half_open, 2=open)",
+    "circuit-breaker state per endpoint (0=closed, 1=half_open, 2=open, 3=soft_ejected)",
 )
 _M_EJECTIONS = default_registry.counter(
     "kubeai_endpoint_ejections_total",
     "endpoints ejected by the passive-health circuit breaker",
+)
+_M_HEALTH_SCORE = default_registry.gauge(
+    "kubeai_endpoint_health_score",
+    "latency-derived routing health per endpoint (1.0=full weight, 0.0=ejected)",
+)
+_M_SOFT_EJECTIONS = default_registry.counter(
+    "kubeai_endpoint_soft_ejections_total",
+    "endpoints soft-ejected as fleet-relative latency outliers",
 )
 
 
@@ -97,6 +124,13 @@ class Endpoint:
     consecutive_failures: int = 0
     opened_at: float = 0.0  # clock() when the breaker last opened
     probe_started: float | None = None  # half-open probe in flight since
+    # Gray-failure defense (docs/robustness.md#gray-failures): pick
+    # weight decayed by the latency scorer (1.0 = full share), the
+    # slow-start ramp anchor (None = not warming), and the rolling
+    # latency evidence the scorer judges.
+    weight: float = 1.0
+    warmup_started: float | None = None
+    stats: LatencyStats = field(default_factory=LatencyStats)
 
 
 class EndpointGroup:
@@ -107,6 +141,12 @@ class EndpointGroup:
         breaker_cooldown: float = 10.0,
         clock=time.monotonic,
         name: str = "",
+        outlier_k: float | None = None,
+        outlier_min_requests: float | None = None,
+        scoring_window: float | None = None,
+        max_eject_fraction: float | None = None,
+        slow_start_window: float | None = None,
+        probe_jitter: float | None = None,
     ):
         """*breaker_threshold* consecutive failed attempts eject an
         endpoint for *breaker_cooldown* seconds; after the cooldown it
@@ -114,7 +154,19 @@ class EndpointGroup:
         breaker, failure re-ejects. ``breaker_threshold <= 0`` disables
         breaking. *clock* is injectable so tests drive cooldowns with a
         fake clock instead of sleeps. *name* is the model this group
-        serves — incident triggers and the routing snapshot carry it."""
+        serves — incident triggers and the routing snapshot carry it.
+
+        Gray-failure knobs (None resolves from the environment, see
+        health.py): *outlier_k* — an endpoint whose windowed p95 exceeds
+        k x the fleet median is an outlier (<=0 disables scoring);
+        *outlier_min_requests* — fresh samples required per window
+        before an endpoint is judged; *scoring_window* — seconds between
+        scoring passes; *max_eject_fraction* — if a pass would leave
+        more than this share of the fleet ejected, scoring disables
+        itself entirely (the PR 3 fail-open invariant, now for latency);
+        *slow_start_window* — warmup ramp for new/readmitted endpoints;
+        *probe_jitter* — spread fraction applied to half-open cooldowns
+        so a burst-ejected fleet doesn't re-probe in lockstep."""
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._endpoints: dict[str, Endpoint] = {}
@@ -126,6 +178,22 @@ class EndpointGroup:
         self.breaker_cooldown = breaker_cooldown
         self._clock = clock
         self.name = name
+        self.outlier_k = resolve_knob(outlier_k, "KUBEAI_OUTLIER_K", 3.0)
+        self.outlier_min_requests = int(
+            resolve_knob(outlier_min_requests, "KUBEAI_OUTLIER_MIN_REQUESTS", 8)
+        )
+        self.scoring_window = resolve_knob(scoring_window, "KUBEAI_SCORING_WINDOW", 5.0)
+        self.max_eject_fraction = resolve_knob(
+            max_eject_fraction, "KUBEAI_MAX_EJECT_FRACTION", 1.0 / 3.0
+        )
+        self.slow_start_window = resolve_knob(
+            slow_start_window, "KUBEAI_SLOW_START_WINDOW", 10.0
+        )
+        self.probe_jitter = resolve_knob(probe_jitter, "KUBEAI_PROBE_JITTER", 0.25)
+        self._last_score = self._clock()
+        self._fleet_median_p95: float | None = None
+        self._scoring_disabled_reason: str | None = None
+        self._soft_ejections = 0
         # Recent endpoint picks (routing observability): (clock t, pod
         # name, strategy) ring — deque appends are atomic under the GIL
         # and the pick path already holds the group lock.
@@ -143,6 +211,7 @@ class EndpointGroup:
         cancelled: threading.Event | None = None,
         exclude: set[str] | None = None,
         role: str = "",
+        priority: str = "",
     ):
         """Block until an endpoint is available and return
         ``(address, done_fn)``; ``done_fn`` must be called when the request
@@ -153,6 +222,11 @@ class EndpointGroup:
         a request must fall back to unified serving on the surviving
         pool when its whole role pool is ejected, never 503 — and only
         a total outage reaches the breaker-ignoring fail-open rungs.
+
+        *priority* is the request's QoS class: batch-class traffic may
+        still route to soft-ejected (latency-outlier) endpoints — batch
+        is preemptible and replay-protected, so sick-but-alive capacity
+        becomes the bulk tier instead of idling.
 
         Raises TimeoutError on deadline, and RuntimeError if *cancelled* is
         set while waiting.
@@ -205,7 +279,7 @@ class EndpointGroup:
                 for r_role, r_exclude, r_ignore in rungs:
                     name = self._choose(
                         strategy, prefix, adapter, mean_load_factor, r_exclude,
-                        ignore_breaker=r_ignore, role=r_role,
+                        ignore_breaker=r_ignore, role=r_role, priority=priority,
                     )
                     if name is not None:
                         break
@@ -243,11 +317,13 @@ class EndpointGroup:
         exclude: set[str] | None = None,
         ignore_breaker: bool = False,
         role: str = "",
+        priority: str = "",
     ):
         # Single source of truth for retry exclusion + breaker ejection
         # + role filtering; None when none applies (keeps the CHWBL fast
         # path allocation-free in the healthy steady state).
         now = self._clock()
+        self._maybe_score(now)
         breaker_live = (
             not ignore_breaker
             and self.breaker_threshold > 0
@@ -265,18 +341,43 @@ class EndpointGroup:
                 if exclude and ep.address in exclude:
                     return False
                 if breaker_live and not self._breaker_allows(ep, now):
-                    return False
+                    # Degraded-mode routing: a soft-ejected endpoint is
+                    # slow, not dead — batch traffic (preemptible,
+                    # replay-protected) may still use it.
+                    if not (
+                        priority == "batch"
+                        and ep.breaker_state == BREAKER_SOFT_EJECTED
+                    ):
+                        return False
                 return True
 
         if strategy == PREFIX_HASH:
             stats: dict = {}
+            # Weighted bounded load: a decayed/warming endpoint's
+            # in-flight is inflated relative to its weight so the CHWBL
+            # bound walks past stragglers sooner. Loads are normalized
+            # by the MEAN weight so a uniformly warming fleet (every
+            # weight equal) sees exactly the unweighted bound.
+            endpoint_load = lambda n: self._endpoints[n].in_flight
+            if any(
+                ep.weight < 1.0 or ep.warmup_started is not None
+                for ep in self._endpoints.values()
+            ):
+                weights = {
+                    n: self._effective_weight(ep, now)
+                    for n, ep in self._endpoints.items()
+                }
+                mean_w = sum(weights.values()) / len(weights)
+                endpoint_load = lambda n: self._endpoints[n].in_flight * (
+                    mean_w / max(weights[n], MIN_EFFECTIVE_WEIGHT)
+                )
             name = chwbl_choose(
                 self._ring,
                 key=adapter + prefix,
                 load_factor=mean_load_factor,
                 adapter=adapter,
                 has_adapter=lambda n, a: a in self._endpoints[n].adapters,
-                endpoint_load=lambda n: self._endpoints[n].in_flight,
+                endpoint_load=endpoint_load,
                 total_load=self._total_in_flight,
                 n_endpoints=len(self._endpoints),
                 allowed=allowed,
@@ -298,6 +399,11 @@ class EndpointGroup:
             # Ties broken randomly: retries after an upstream failure must
             # be able to land on a different endpoint (the reference gets
             # this implicitly from Go's randomized map iteration).
+            # Weighted: key = (in_flight + 1) / effective_weight — a
+            # half-weight endpoint looks twice as loaded, so it wins
+            # only when genuinely idler. With uniform weights the keys
+            # are identical floats and tie sets match the unweighted
+            # behavior exactly.
             candidates: list[str] = []
             best_load = None
             for name, ep in self._endpoints.items():
@@ -305,13 +411,237 @@ class EndpointGroup:
                     continue
                 if allowed is not None and not allowed(name):
                     continue
-                if best_load is None or ep.in_flight < best_load:
-                    best_load = ep.in_flight
+                key = (ep.in_flight + 1) / self._effective_weight(ep, now)
+                if best_load is None or key < best_load:
+                    best_load = key
                     candidates = [name]
-                elif ep.in_flight == best_load:
+                elif key == best_load:
                     candidates.append(name)
             return random.choice(candidates) if candidates else None
         raise ValueError(f"unknown load balancing strategy: {strategy!r}")
+
+    # -- gray-failure latency scoring ---------------------------------------
+
+    def observe_latency(self, addr: str, seconds: float, count: int = 1) -> None:
+        """Feed one latency observation (TTFT or attempt latency,
+        seconds) for *addr*. Sources: the proxy's per-attempt outcome
+        path and the FleetCollector's engine-histogram scrape deltas
+        (*count* credits an aggregate toward the min-request floor)."""
+        if seconds < 0:
+            return
+        with self._cond:
+            ep = next(
+                (e for e in self._endpoints.values() if e.address == addr), None
+            )
+            if ep is None:
+                return
+            ep.stats.observe(seconds, count=count)
+            self._maybe_score(self._clock())
+
+    def _effective_weight(self, ep: Endpoint, now: float) -> float:
+        """Pick weight after the slow-start ramp (lock held). Warming
+        endpoints climb linearly from RAMP_FLOOR x weight to full
+        weight over the warmup window; the ramp anchor is cleared once
+        complete so the steady state pays nothing."""
+        w = ep.weight
+        if ep.warmup_started is not None:
+            if self.slow_start_window <= 0:
+                ep.warmup_started = None
+            else:
+                frac = (now - ep.warmup_started) / self.slow_start_window
+                if frac >= 1.0:
+                    ep.warmup_started = None
+                else:
+                    w *= RAMP_FLOOR + (1.0 - RAMP_FLOOR) * max(frac, 0.0)
+        return max(w, MIN_EFFECTIVE_WEIGHT)
+
+    def _start_warmup(self, ep: Endpoint, now: float) -> None:
+        if self.slow_start_window > 0:
+            ep.warmup_started = now
+
+    def _maybe_score(self, now: float) -> None:
+        """Run a scoring pass if the window has elapsed (lock held).
+        Driven from the selection and observation paths — no timer
+        thread, matching the breaker's lazy-transition idiom."""
+        if self.outlier_k <= 0:
+            return
+        if now - self._last_score < self.scoring_window:
+            return
+        self._score(now)
+
+    def _score(self, now: float) -> None:
+        """One scoring pass (lock held): judge endpoints with enough
+        fresh evidence against k x the fleet median p95, walk outliers
+        down the weight ladder (1.0 -> 0.5 -> 0.25 -> soft-eject),
+        recover non-outliers one rung per clean window, and disable
+        scoring entirely when ejections would exceed the max fraction
+        (whole-fleet-slow means the MODEL is slow, not a replica)."""
+        self._last_score = now
+        eps = list(self._endpoints.values())
+        n = len(eps)
+        judged: list[tuple[Endpoint, float]] = []
+        starved: list[Endpoint] = []
+        for ep in eps:
+            # Judge the WINDOW p95 (fresh samples only): the rolling
+            # deque is the trend surface, but letting one bad window's
+            # samples linger in the decision input would keep a
+            # recovered endpoint decayed for many windows afterwards.
+            p95 = ep.stats.window_p95()
+            # The min-request floor gates ENTERING the decay ladder: one
+            # slow request on an idle endpoint is not an outlier. An
+            # endpoint already decayed is judged on any fresh sample —
+            # its own reduced pick share has removed the traffic the
+            # floor was calibrated for, and holding it to the floor
+            # would freeze the ladder mid-descent (unconvictable and
+            # unrecoverable on a rung it can't earn off).
+            floor = self.outlier_min_requests if ep.weight >= 1.0 else 1
+            if p95 is not None and ep.stats.window_count >= floor:
+                judged.append((ep, p95))
+            elif (
+                p95 is None
+                and ep.weight < 1.0
+                and ep.breaker_state == BREAKER_CLOSED
+            ):
+                # Decayed AND no samples at all this window: its reduced
+                # share may itself be why nothing arrived. Absence of
+                # traffic is not exoneration — the last verdict stands
+                # and the ladder continues below (only when the rest of
+                # the fleet provides judging context).
+                starved.append(ep)
+            ep.stats.reset_window()
+        if n < 2 or len(judged) < 2:
+            # Insufficient evidence is NOT recovery: existing decisions
+            # stand (they age out via the half-open cooldown), we just
+            # can't make new ones this window.
+            self._fleet_median_p95 = None
+            self._publish_scores(now)
+            return
+        median = fleet_median([p for _, p in judged])
+        self._fleet_median_p95 = median
+        outlier_ids = {
+            id(ep) for ep, p95 in judged if p95 > self.outlier_k * median > 0
+        }
+        ejected = sum(1 for ep in eps if ep.breaker_state != BREAKER_CLOSED)
+        new_outliers = [
+            ep for ep, _ in judged
+            if id(ep) in outlier_ids and ep.breaker_state == BREAKER_CLOSED
+        ] + starved  # starved decayed endpoints stay on their trajectory
+        if new_outliers and (ejected + len(new_outliers)) > self.max_eject_fraction * n:
+            # Fail open: too much of the fleet looks like an "outlier"
+            # — the comparison is meaningless, so scoring stands down
+            # completely and routing behaves exactly as without it.
+            self._scoring_disabled_reason = (
+                f"would eject {ejected + len(new_outliers)}/{n} endpoints "
+                f"(max fraction {self.max_eject_fraction:.2f})"
+            )
+            for ep in eps:
+                ep.weight = 1.0
+                if ep.breaker_state == BREAKER_SOFT_EJECTED:
+                    self._set_state(ep, BREAKER_CLOSED)
+                    ep.probe_started = None
+                    ep.warmup_started = None
+            self._publish_scores(now)
+            return
+        self._scoring_disabled_reason = None
+
+        def descend(ep: Endpoint, p95_s: float, was_starved: bool) -> None:
+            if ep.weight > WEIGHT_FLOOR + 1e-9:
+                ep.weight = max(ep.weight * WEIGHT_DECAY, WEIGHT_FLOOR)
+                return
+            # Still an outlier at the weight floor: soft-eject into the
+            # breaker's half-open readmission machinery.
+            self._set_state(ep, BREAKER_SOFT_EJECTED)
+            ep.opened_at = now
+            ep.probe_started = None
+            self._soft_ejections += 1
+            _M_SOFT_EJECTIONS.inc(labels={"endpoint": ep.address})
+            publish_trigger(
+                "endpoint_degraded", model=self.name,
+                detail={
+                    "endpoint": ep.address, "role": ep.role,
+                    "p95_s": round(p95_s, 4),
+                    "fleet_median_p95_s": round(median, 4),
+                    "outlier_k": self.outlier_k,
+                    "weight": ep.weight,
+                    "starved": was_starved,
+                },
+            )
+
+        for ep, p95 in judged:
+            if ep.breaker_state != BREAKER_CLOSED:
+                continue
+            if id(ep) in outlier_ids:
+                descend(ep, p95, False)
+            elif ep.weight < 1.0:
+                # Clean window: climb back one rung.
+                ep.weight = min(ep.weight / WEIGHT_DECAY, 1.0)
+        for ep in starved:
+            # No fresh evidence this window: continue the ladder on the
+            # rolling p95 (the evidence that decayed it). A wrong
+            # continuation is self-correcting — the half-open probe
+            # readmits through slow-start once the cooldown elapses.
+            descend(ep, ep.stats.p95() or 0.0, True)
+        self._publish_scores(now)
+
+    def _publish_scores(self, now: float) -> None:
+        """Refresh the kubeai_endpoint_health_score gauge (lock held):
+        0.0 for ejected endpoints, otherwise the effective pick weight."""
+        for ep in self._endpoints.values():
+            if ep.breaker_state in (BREAKER_OPEN, BREAKER_SOFT_EJECTED):
+                score = 0.0
+            else:
+                score = round(self._effective_weight(ep, now), 4)
+            _M_HEALTH_SCORE.set(score, labels={"endpoint": ep.address})
+
+    def health_snapshot(self) -> dict:
+        """The /debug/health view of this group: scoring config + state
+        and per-endpoint latency evidence, weights, and ramp status."""
+        with self._lock:
+            now = self._clock()
+            return {
+                "scoring": {
+                    "enabled": self.outlier_k > 0,
+                    "outlier_k": self.outlier_k,
+                    "min_requests": self.outlier_min_requests,
+                    "window_s": self.scoring_window,
+                    "max_eject_fraction": round(self.max_eject_fraction, 3),
+                    "slow_start_s": self.slow_start_window,
+                    "fleet_median_p95_s": (
+                        round(self._fleet_median_p95, 4)
+                        if self._fleet_median_p95 is not None
+                        else None
+                    ),
+                    "disabled_reason": self._scoring_disabled_reason,
+                    "soft_ejections": self._soft_ejections,
+                },
+                "endpoints": [
+                    {
+                        "name": name,
+                        "address": ep.address,
+                        "role": ep.role,
+                        "state": ep.breaker_state,
+                        "weight": round(ep.weight, 3),
+                        "effective_weight": round(
+                            self._effective_weight(ep, now), 3
+                        ),
+                        "warming": ep.warmup_started is not None,
+                        "p95_s": (
+                            round(p95, 4)
+                            if (p95 := ep.stats.p95()) is not None
+                            else None
+                        ),
+                        "ewma_s": (
+                            round(ep.stats.ewma, 4)
+                            if ep.stats.ewma is not None
+                            else None
+                        ),
+                        "samples": len(ep.stats.samples),
+                        "window_samples": ep.stats.window_count,
+                        "observed_total": ep.stats.total,
+                    }
+                    for name, ep in sorted(self._endpoints.items())
+                ],
+            }
 
     # -- passive health / circuit breaking ---------------------------------
 
@@ -319,14 +649,26 @@ class EndpointGroup:
         ep.breaker_state = state
         _M_ENDPOINT_STATE.set(_STATE_VALUE[state], labels={"endpoint": ep.address})
 
+    def _probe_cooldown(self, ep: Endpoint) -> float:
+        """Cooldown before *ep* may half-open, with a deterministic
+        per-endpoint spread: endpoints ejected in the same burst would
+        otherwise all re-probe at the same instant across every model
+        (synchronized probe storms against a recovering backend). The
+        jitter is a stable hash of the address, so tests with a fake
+        clock can predict it and restarts don't reshuffle it."""
+        return self.breaker_cooldown * (
+            1.0 + self.probe_jitter * endpoint_jitter(ep.address)
+        )
+
     def _breaker_allows(self, ep: Endpoint, now: float) -> bool:
         """Whether the breaker lets a NEW request pick *ep* (lock held).
-        Lazily transitions open -> half_open when the cooldown elapses —
-        there is no timer thread; selection time is when it matters."""
+        Lazily transitions open/soft_ejected -> half_open when the
+        cooldown elapses — there is no timer thread; selection time is
+        when it matters."""
         if ep.breaker_state == BREAKER_CLOSED:
             return True
-        if ep.breaker_state == BREAKER_OPEN:
-            if now - ep.opened_at < self.breaker_cooldown:
+        if ep.breaker_state in (BREAKER_OPEN, BREAKER_SOFT_EJECTED):
+            if now - ep.opened_at < self._probe_cooldown(ep):
                 return False
             self._set_state(ep, BREAKER_HALF_OPEN)
             ep.probe_started = None
@@ -356,6 +698,13 @@ class EndpointGroup:
                 return
             now = self._clock()
             if ok:
+                if ep.breaker_state == BREAKER_SOFT_EJECTED:
+                    # Soft ejection means SLOW, not failing: batch-tier
+                    # successes prove liveness, not recovered latency.
+                    # Only the half-open probe (after the cooldown, with
+                    # a fresh scoring verdict to follow) readmits.
+                    ep.consecutive_failures = 0
+                    return
                 if (
                     ep.breaker_state != BREAKER_CLOSED
                     and started_at is not None
@@ -366,9 +715,32 @@ class EndpointGroup:
                 if ep.breaker_state != BREAKER_CLOSED:
                     self._set_state(ep, BREAKER_CLOSED)
                     ep.probe_started = None
+                    # Readmission gets a slow-start ramp, not an
+                    # instant full share — a cold/recovering replica
+                    # at full LeastLoad weight can re-trip itself.
+                    self._start_warmup(ep, now)
                 return
             ep.consecutive_failures += 1
-            if ep.breaker_state == BREAKER_HALF_OPEN:
+            if (
+                ep.breaker_state == BREAKER_SOFT_EJECTED
+                and self.breaker_threshold > 0
+                and ep.consecutive_failures >= self.breaker_threshold
+            ):
+                # A latency outlier that starts HARD-failing under its
+                # batch tier escalates to a full ejection (no traffic).
+                self._set_state(ep, BREAKER_OPEN)
+                ep.opened_at = now
+                ep.probe_started = None
+                _M_EJECTIONS.inc(labels={"endpoint": ep.address})
+                publish_trigger(
+                    "breaker_ejection", model=self.name,
+                    detail={
+                        "endpoint": ep.address, "role": ep.role,
+                        "transition": "soft_ejected->open",
+                        "consecutive_failures": ep.consecutive_failures,
+                    },
+                )
+            elif ep.breaker_state == BREAKER_HALF_OPEN:
                 # The probe failed: straight back to ejected.
                 self._set_state(ep, BREAKER_OPEN)
                 ep.opened_at = now
@@ -421,6 +793,8 @@ class EndpointGroup:
                         if ep.breaker_state != BREAKER_CLOSED
                         else None
                     ),
+                    "weight": round(ep.weight, 3),
+                    "warming": ep.warmup_started is not None,
                 }
                 for name, ep in sorted(self._endpoints.items())
             ]
@@ -484,27 +858,38 @@ class EndpointGroup:
         removed endpoints drain naturally via their done callbacks
         (ref: group.go:108-137)."""
         with self._cond:
+            # One timestamp for the whole pass: endpoints arriving in
+            # the same reconcile must ramp IDENTICALLY, so LeastLoad
+            # tie-breaking among them stays random during warmup.
+            now = self._clock()
             for name, obs in observed.items():
                 cur = self._endpoints.get(name)
                 if cur is not None:
                     cur.adapters = set(obs.adapters)
                     cur.role = obs.role
                 else:
-                    self._endpoints[name] = Endpoint(
+                    ep = Endpoint(
                         address=obs.address, adapters=set(obs.adapters),
                         role=obs.role,
                     )
+                    # Every arrival — fresh pod, parked attach, scale-up
+                    # — gets the slow-start ramp: a just-attached replica
+                    # with cold caches must not receive full LeastLoad
+                    # share instantly.
+                    self._start_warmup(ep, now)
+                    self._endpoints[name] = ep
                     self._ring.add(name)
             for name in list(self._endpoints):
                 if name not in observed:
                     self._ring.remove(name)
                     ep = self._endpoints.pop(name)
                     # A departed endpoint must not show "open" on the
-                    # state gauge forever.
+                    # state gauge (or a stale health score) forever.
                     _M_ENDPOINT_STATE.set(
                         _STATE_VALUE[BREAKER_CLOSED],
                         labels={"endpoint": ep.address},
                     )
+                    _M_HEALTH_SCORE.set(1.0, labels={"endpoint": ep.address})
             if observed:
                 self._generation += 1
                 self._cond.notify_all()

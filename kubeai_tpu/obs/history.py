@@ -98,6 +98,7 @@ CONTEXT_SERIES_PREFIXES: tuple[str, ...] = (
     "kubeai_slo_burn_rate",                 # SLO burn
     "kubeai_tenant_share",                  # tenant top-share
     "kubeai_endpoint_state",                # breaker state
+    "kubeai_endpoint_health_score",         # latency-derived routing weight
 )
 
 
@@ -217,7 +218,9 @@ class HistoryStore:
             "queue_depth", "active_slots", "tokens_per_second",
             "pages_used", "prefix_hit_ratio",
         )
-        _BREAKER = {"closed": 0.0, "half_open": 1.0, "open": 2.0}
+        _BREAKER = {
+            "closed": 0.0, "half_open": 1.0, "open": 2.0, "soft_ejected": 3.0,
+        }
         for model, view in (views or {}).items():
             agg = view.get("aggregate") or {}
             for k in agg_keys:
@@ -235,6 +238,11 @@ class HistoryStore:
                 bs = _BREAKER.get(ep.get("breaker_state") or "")
                 if bs is not None:
                     self.record(f"fleet.{model}.{addr}.breaker_state", bs, t=t)
+                hs = ep.get("health_score")
+                if isinstance(hs, (int, float)):
+                    # The straggler's trajectory: weight decays show up
+                    # in incident snapshots BEFORE the soft-ejection.
+                    self.record(f"fleet.{model}.{addr}.health_score", hs, t=t)
             for role, pagg in (view.get("pools") or {}).items():
                 for k in agg_keys:
                     v = pagg.get(k)

@@ -34,7 +34,9 @@ Discipline mirrors the rest of the repo:
 
 Trigger sources wired in-tree (grep ``publish_trigger(`` for ground
 truth): ``slo_burn`` (obs/slo.py burn-rate crossing), ``breaker_ejection``
-(loadbalancer/group.py), ``autoscaler_clamp`` / ``autoscaler_hold``
+(loadbalancer/group.py), ``endpoint_degraded`` (loadbalancer/group.py
+latency-outlier soft-ejection — gray-failure scoring, not hard
+failures), ``autoscaler_clamp`` / ``autoscaler_hold``
 (autoscaler decision outcomes), ``canary_error`` / ``canary_corrupt``
 (obs/canary.py), ``tenant_flood`` (obs/tenants.py heavy-hitter
 detection — one tenant's rolling-window request share crossed

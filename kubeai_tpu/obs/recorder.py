@@ -372,6 +372,8 @@ DEBUG_INDEX: tuple[tuple[str, str, str], ...] = (
      "per-model circuit-breaker view: endpoint states, consecutive failures, in-flight"),
     ("/debug/routing", "operator",
      "CHWBL ring snapshot + recent pick distribution per model"),
+    ("/debug/health", "operator",
+     "latency health scoring: per-endpoint TTFT p95/EWMA, pick weights, slow-start ramp, soft-ejection state"),
     ("/debug/autoscaler", "operator",
      "scaling decision audit: one record per tick per model/pool (?limit=&model=)"),
     ("/debug/fleet", "operator",

@@ -459,8 +459,12 @@ class ModelProxy:
                     # Non-streaming SUCCESS headers latency feeds the
                     # hedge delay's p95 window (4xx excluded: fast 429s
                     # under saturation would shrink the delay and spawn
-                    # more hedges exactly when the fleet is overloaded).
+                    # more hedges exactly when the fleet is overloaded)
+                    # and the gray-failure latency scorer.
                     self.hedge.record(time.monotonic() - t_conn)
+                    self._observe_latency(
+                        req.model_name, addr, time.monotonic() - t_conn
+                    )
                 # Success is reported at body EXHAUSTION: an endpoint that
                 # returns 200 headers then dies mid-stream is failing, and
                 # a half-open probe must not close the breaker until the
@@ -498,11 +502,19 @@ class ModelProxy:
                 # tee-ing every large non-JSON body (audio, base64
                 # embedding matrices) would pin up to the parse cap per
                 # in-flight request for nothing.
+                # First-byte latency feed for the SSE passthrough path
+                # (non-streaming responses were already observed at the
+                # headers site above — don't double-count).
+                observe = None
+                if is_sse:
+                    def observe(_m=req.model_name, _a=addr, _t=t_conn):
+                        self._observe_latency(_m, _a, time.monotonic() - _t)
                 body_iter = self._body_iter(
                     resp, conn, done, release, tb=tb, t_conn=t_conn,
                     cancelled=cancelled, report=report, meter=meter,
                     sse=is_sse,
                     parse_json=ctype.startswith("application/json"),
+                    observe=observe,
                 )
             return ProxyResult(resp.status, resp_headers, body_iter)
         log.info(
@@ -510,6 +522,19 @@ class ModelProxy:
             req.id, req.model_name, attempts, last_err,
         )
         raise APIError(502, f"upstream unavailable after {attempts} attempts: {last_err}")
+
+    def _observe_latency(self, model_name: str, addr: str, seconds: float) -> None:
+        """Gray-failure evidence feed: per-attempt TTFT/headers latency
+        into the balancer's latency scorer. getattr-guarded — tests run
+        the proxy against minimal fake balancers — and failures are
+        swallowed: scoring must never break serving."""
+        fn = getattr(self.lb, "observe_latency", None)
+        if fn is None:
+            return
+        try:
+            fn(model_name, addr, seconds)
+        except Exception:
+            log.debug("latency observation failed", exc_info=True)
 
     def _has_role_endpoints(self, model_name: str) -> bool:
         """Whether the model's deployment is actually role-planned: at
@@ -692,6 +717,7 @@ class ModelProxy:
         suppress = 0  # data events to drop from the current (replayed) stream
         replays = 0
         completed = False
+        awaiting_first = True  # per-upstream TTFT not yet observed
 
         try:
             while True:
@@ -700,6 +726,16 @@ class ModelProxy:
                 preempted = False
                 try:
                     for ev in sse_events(_chunk_reader(resp)):
+                        if awaiting_first:
+                            # Per-UPSTREAM TTFT (reset on every replay/
+                            # handoff/resume re-acquire): the latency
+                            # scorer judges endpoints, so each upstream's
+                            # first byte is its own evidence.
+                            awaiting_first = False
+                            self._observe_latency(
+                                req.model_name, addr,
+                                time.monotonic() - t_conn,
+                            )
                         if handoff is not None and _is_handoff_event(ev):
                             # The prefill engine's budget-cap marker:
                             # never forwarded — the decode stream owns
@@ -747,6 +783,7 @@ class ModelProxy:
                     )
                     handoff = None  # one planned cutover per request
                     suppress = forwarded
+                    awaiting_first = True
                     continue
                 if preempted:
                     # The replica shed this batch stream ON PURPOSE —
@@ -777,6 +814,7 @@ class ModelProxy:
                     )
                     record_resume()
                     suppress = forwarded
+                    awaiting_first = True
                     log.info(
                         "request id=%s resumed on %s (resume at event %d)",
                         req.id, addr, forwarded,
@@ -825,6 +863,7 @@ class ModelProxy:
                     )
                 )
                 suppress = forwarded
+                awaiting_first = True
                 log.info(
                     "request id=%s replaying on %s (resume at event %d)",
                     req.id, addr, forwarded,
@@ -991,7 +1030,7 @@ class ModelProxy:
         return resp, conn, t_conn, None
 
     @staticmethod
-    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None, meter=None, sse=False, parse_json=False):
+    def _body_iter(resp, conn, done, release, tb=None, t_conn=None, cancelled=None, report=None, meter=None, sse=False, parse_json=False, observe=None):
         """Stream the upstream body; exactly-once cleanup on exhaustion or
         generator close (client disconnect). The proxy timeline closes
         HERE — the upstream span covers connect through last byte, so
@@ -1017,6 +1056,11 @@ class ModelProxy:
                     # final event lacks the terminating blank line
                     # still delivers every byte on clean EOF.
                     for ev in sse_events(_chunk_reader(resp), flush_tail=True):
+                        if observe is not None:
+                            # First event = this attempt's TTFT for the
+                            # gray-failure latency scorer (fires once).
+                            observe()
+                            observe = None
                         if meter is not None:
                             if meter.observe_event(ev):
                                 continue  # injected usage chunk: strip

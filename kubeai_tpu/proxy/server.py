@@ -214,6 +214,12 @@ def _make_handler(srv: OpenAIServer):
                 # factors) + recent pick distribution per model, so
                 # PrefixHash-vs-LeastLoad behavior is inspectable live.
                 self._json(200, {"models": srv.proxy.lb.routing_snapshot()})
+            elif path == "/debug/health":
+                # Gray-failure visibility: per-endpoint latency evidence
+                # (p95/EWMA), pick weights, slow-start ramp state, and
+                # the scoring config — including whether the max-eject
+                # fraction disabled scoring (docs/robustness.md).
+                self._json(200, {"models": srv.proxy.lb.health_snapshot()})
             elif path == "/debug/autoscaler":
                 # Scaling decision audit: why the autoscaler did what it
                 # did, one record per tick per model.

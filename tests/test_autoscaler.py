@@ -192,9 +192,23 @@ class TestEngineQueueScrape:
     def test_manager_wires_queue_signal(self):
         from kubeai_tpu.config.system import System
         from kubeai_tpu.manager import Manager
+        from kubeai_tpu.obs import (
+            uninstall_canary,
+            uninstall_history,
+            uninstall_recorder,
+        )
 
         mgr = Manager(System().default_and_validate(), store=Store(), port=0)
-        assert mgr.autoscaler.engine_queue_scrape is not None
+        try:
+            assert mgr.autoscaler.engine_queue_scrape is not None
+        finally:
+            # Manager.__init__ installs the global observability
+            # singletons; this never-started Manager can't run stop(),
+            # so uninstall directly — a leaked canary/history makes
+            # later not-installed assertions order-dependent.
+            uninstall_canary(mgr.canary)
+            uninstall_recorder(mgr.incidents)
+            uninstall_history(mgr.history)
 
 
 class TestParse:

@@ -156,6 +156,10 @@ def mk_group(threshold=3, cooldown=10.0):
     g = EndpointGroup(
         breaker_threshold=threshold, breaker_cooldown=cooldown,
         clock=lambda: clk[0],
+        # These tests advance the clock to EXACTLY the cooldown and
+        # expect half_open — pin the probe jitter off (it has its own
+        # regression coverage in test_gray_failure.py).
+        probe_jitter=0.0,
     )
     g.reconcile_endpoints({
         "pa": Endpoint(address="10.0.0.1:8000"),

@@ -1,0 +1,493 @@
+"""Gray-failure defense suite (docs/robustness.md#gray-failures).
+
+Deterministic — fake clocks drive the scoring windows and probe
+cooldowns, no sleeps beyond the slow-failpoint's own millisecond
+drags. Covers the outlier ladder (weight decay -> soft-ejection ->
+half-open readmission), the max-ejection-fraction fail-open (whole
+fleet "slow" => scoring disables itself, routing exactly as today),
+degraded-mode batch routing to soft-ejected endpoints, the slow-start
+pick-share ramp, deterministic half-open probe jitter, and the
+per-token ``slow`` failpoint mode.
+"""
+
+import time
+
+import pytest
+
+from kubeai_tpu import faults
+from kubeai_tpu.loadbalancer.group import (
+    BREAKER_CLOSED,
+    BREAKER_HALF_OPEN,
+    BREAKER_OPEN,
+    BREAKER_SOFT_EJECTED,
+    LEAST_LOAD,
+    Endpoint,
+    EndpointGroup,
+)
+from kubeai_tpu.loadbalancer.health import (
+    LatencyStats,
+    endpoint_jitter,
+    fleet_median,
+)
+from kubeai_tpu.metrics import default_registry
+from kubeai_tpu.obs.incidents import install_recorder, uninstall_recorder
+
+A, B, C = "10.0.0.1:8000", "10.0.0.2:8000", "10.0.0.3:8000"
+
+
+def mk_group(n=3, **kw):
+    """Fake-clock group with scoring knobs tightened for tests: judge
+    after 4 fresh samples, 5 s windows, no slow-start (tested on its
+    own), no probe jitter (ditto)."""
+    clk = [0.0]
+    defaults = dict(
+        breaker_threshold=3, breaker_cooldown=10.0,
+        outlier_k=3.0, outlier_min_requests=4, scoring_window=5.0,
+        max_eject_fraction=1.0 / 3.0, slow_start_window=0.0,
+        probe_jitter=0.0, name="m",
+    )
+    defaults.update(kw)
+    g = EndpointGroup(clock=lambda: clk[0], **defaults)
+    g.reconcile_endpoints({
+        f"p{i}": Endpoint(address=addr)
+        for i, addr in enumerate([A, B, C][:n])
+    })
+    return g, clk
+
+
+def feed_window(g, clk, latencies, advance=5.0):
+    """Feed one scoring window: *latencies* maps addr -> (seconds,
+    samples), then advance the clock past the window so the NEXT
+    observation triggers a scoring pass."""
+    for addr, (secs, count) in latencies.items():
+        for _ in range(count):
+            g.observe_latency(addr, secs)
+    clk[0] += advance
+    # The pass runs lazily on the next observe/choose; poke it with a
+    # zero-cost observation on a healthy endpoint.
+    g.observe_latency(A, 0.001)
+
+
+def states(g):
+    return {e["address"]: e["state"] for e in g.breaker_snapshot()}
+
+
+def weights(g):
+    return {e["address"]: e["weight"] for e in g.breaker_snapshot()}
+
+
+class _CaptureRecorder:
+    """Duck-typed stand-in for IncidentRecorder: records publishes."""
+
+    def __init__(self):
+        self.published = []
+
+    def publish(self, trigger, model="", detail=None, key=""):
+        self.published.append((trigger, model, detail or {}))
+        return "inc-test"
+
+
+class TestLatencyStats:
+    def test_p95_and_ewma(self):
+        s = LatencyStats()
+        assert s.p95() is None and s.ewma is None
+        # Nearest-rank p95 of 20 samples is the 19th smallest — with two
+        # slow samples the 19th lands on the slow value.
+        for v in [0.1] * 18 + [2.0] * 2:
+            s.observe(v)
+        assert s.p95() == pytest.approx(2.0)
+        assert s.window_p95() == pytest.approx(2.0)
+        assert 0.1 < s.ewma < 2.0
+        assert s.window_count == 20 and s.total == 20
+
+    def test_scrape_aggregate_counts_toward_floor(self):
+        s = LatencyStats()
+        s.observe(0.5, count=10)
+        assert s.window_count == 10
+        assert len(s.samples) == 1
+
+    def test_fleet_median(self):
+        assert fleet_median([3.0, 1.0, 2.0]) == 2.0
+        assert fleet_median([1.0, 3.0]) == 2.0
+
+
+class TestOutlierEjection:
+    def test_decay_ladder_then_soft_eject(self):
+        g, clk = mk_group()
+        rec = _CaptureRecorder()
+        install_recorder(rec)
+        try:
+            slow = {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)}
+            feed_window(g, clk, slow)
+            assert weights(g)[C] == pytest.approx(0.5)
+            assert states(g)[C] == BREAKER_CLOSED
+            feed_window(g, clk, slow)
+            assert weights(g)[C] == pytest.approx(0.25)
+            feed_window(g, clk, slow)
+            # Third consecutive outlier window at the floor: soft-eject.
+            assert states(g)[C] == BREAKER_SOFT_EJECTED
+            assert weights(g)[A] == pytest.approx(1.0)
+            assert g.health_snapshot()["scoring"]["soft_ejections"] == 1
+            assert any(t == "endpoint_degraded" for t, _, _ in rec.published)
+            detail = next(d for t, _, d in rec.published if t == "endpoint_degraded")
+            assert detail["endpoint"] == C
+            assert detail["fleet_median_p95_s"] > 0
+        finally:
+            uninstall_recorder(rec)
+
+    def test_health_score_gauge_and_counter(self):
+        g, clk = mk_group()
+        slow = {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)}
+        for _ in range(3):
+            feed_window(g, clk, slow)
+        scores = default_registry.gauge("kubeai_endpoint_health_score").snapshot()
+        assert scores[(("endpoint", C),)] == 0.0
+        assert scores[(("endpoint", A),)] == pytest.approx(1.0)
+        ctr = default_registry.counter("kubeai_endpoint_soft_ejections_total")
+        assert ctr.snapshot()[(("endpoint", C),)] >= 1
+
+    def test_recovery_climbs_ladder(self):
+        g, clk = mk_group()
+        slow = {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)}
+        feed_window(g, clk, slow)
+        feed_window(g, clk, slow)
+        assert weights(g)[C] == pytest.approx(0.25)
+        healthy = {A: (0.05, 5), B: (0.05, 5), C: (0.05, 5)}
+        feed_window(g, clk, healthy)
+        assert weights(g)[C] == pytest.approx(0.5)
+        feed_window(g, clk, healthy)
+        assert weights(g)[C] == pytest.approx(1.0)
+
+    def test_whole_fleet_slow_is_not_an_outlier(self):
+        g, clk = mk_group()
+        slow_everywhere = {A: (1.0, 5), B: (1.0, 5), C: (1.0, 5)}
+        for _ in range(3):
+            feed_window(g, clk, slow_everywhere)
+        assert set(weights(g).values()) == {1.0}
+        assert set(states(g).values()) == {BREAKER_CLOSED}
+
+    def test_min_request_floor_defers_judgement(self):
+        g, clk = mk_group()
+        # C has ONE slow sample — below the floor; no verdict.
+        feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (5.0, 1)})
+        assert weights(g)[C] == pytest.approx(1.0)
+
+    def test_decayed_endpoint_judged_below_floor(self):
+        # The floor gates ENTERING the ladder. Once decayed, the
+        # endpoint's own reduced pick share starves it of samples — it
+        # must still be judgeable on whatever arrives, or it freezes
+        # mid-descent (and mid-recovery) forever.
+        g, clk = mk_group()
+        feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)})
+        assert weights(g)[C] == pytest.approx(0.5)
+        starved = {A: (0.05, 5), B: (0.05, 5), C: (1.0, 1)}
+        feed_window(g, clk, starved)
+        assert weights(g)[C] == pytest.approx(0.25)
+        feed_window(g, clk, starved)
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+        # Symmetric: a single healthy sample climbs a decayed survivor.
+        g2, clk2 = mk_group()
+        feed_window(g2, clk2, {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)})
+        assert weights(g2)[C] == pytest.approx(0.5)
+        feed_window(g2, clk2, {A: (0.05, 5), B: (0.05, 5), C: (0.05, 1)})
+        assert weights(g2)[C] == pytest.approx(1.0)
+
+    def test_starved_decayed_endpoint_continues_ladder(self):
+        # A decayed endpoint receiving ZERO traffic (its own decay may
+        # be why) keeps descending while the rest of the fleet provides
+        # judging context — absence of traffic is not exoneration.
+        # Readmission is the half-open probe's job, not inertia's.
+        g, clk = mk_group()
+        feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)})
+        assert weights(g)[C] == pytest.approx(0.5)
+        no_c = {A: (0.05, 5), B: (0.05, 5)}
+        feed_window(g, clk, no_c)
+        assert weights(g)[C] == pytest.approx(0.25)
+        feed_window(g, clk, no_c)
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+        # An endpoint at FULL weight that goes quiet is untouched.
+        assert weights(g)[A] == pytest.approx(1.0)
+
+    def test_outlier_disabled_with_k_zero(self):
+        g, clk = mk_group(outlier_k=0.0)
+        for _ in range(3):
+            feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (5.0, 5)})
+        assert set(weights(g).values()) == {1.0}
+        assert g.health_snapshot()["scoring"]["enabled"] is False
+
+
+class TestMaxEjectFraction:
+    def test_scoring_disables_itself_and_routing_is_baseline(self):
+        # max_eject_fraction=0: ANY ejection would exceed the bound, so
+        # scoring must stand down entirely — weights reset, no state
+        # changes, and routing behaves exactly as without scoring.
+        g, clk = mk_group(max_eject_fraction=0.0)
+        for _ in range(4):
+            feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (5.0, 5)})
+        assert set(weights(g).values()) == {1.0}
+        assert set(states(g).values()) == {BREAKER_CLOSED}
+        snap = g.health_snapshot()["scoring"]
+        assert snap["disabled_reason"] is not None
+        # Baseline routing: all three endpoints still picked.
+        picks = set()
+        for _ in range(60):
+            addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            picks.add(addr)
+            done()
+        assert picks == {A, B, C}
+
+    def test_disable_readmits_prior_soft_ejections(self):
+        # One straggler gets ejected under a permissive fraction; then
+        # ANOTHER endpoint reads as an outlier and ejecting it too would
+        # cross the bound — scoring stands down and the earlier ejection
+        # must be rolled back with it.
+        g, clk = mk_group(max_eject_fraction=1.0 / 3.0)
+        slow_c = {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)}
+        for _ in range(3):
+            feed_window(g, clk, slow_c)
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+        feed_window(g, clk, {A: (0.05, 5), B: (1.0, 5), C: (0.05, 5)})
+        assert states(g)[C] == BREAKER_CLOSED
+        assert set(weights(g).values()) == {1.0}
+        assert g.health_snapshot()["scoring"]["disabled_reason"] is not None
+
+
+class TestDegradedModeRouting:
+    def mk_ejected(self):
+        """3-endpoint group with C soft-ejected. (With only TWO
+        endpoints a relative-median outlier is impossible by
+        construction: the median IS the mean of the pair, and
+        x > k*(x+y)/2 has no solution for k >= 2 — itself a fail-open
+        property worth preserving.)"""
+        g, clk = mk_group(n=3)
+        slow = {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)}
+        for _ in range(3):
+            feed_window(g, clk, slow)
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+        return g, clk
+
+    def test_interactive_avoids_soft_ejected(self):
+        g, clk = self.mk_ejected()
+        for _ in range(20):
+            addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            assert addr in (A, B)
+            done()
+
+    def test_batch_may_use_soft_ejected(self):
+        g, clk = self.mk_ejected()
+        # Hold batch picks so load accumulates on the healthy pair:
+        # once their weighted keys exceed the straggler's, LeastLoad
+        # must hand the straggler batch work.
+        holds = []
+        for _ in range(10):
+            addr, done = g.get_best_addr(
+                strategy=LEAST_LOAD, timeout=1, priority="batch"
+            )
+            holds.append((addr, done))
+        picked = {a for a, _ in holds}
+        assert C in picked  # the straggler still serves batch
+        for _, done in holds:
+            done()
+
+    def test_batch_success_does_not_close_breaker(self):
+        g, clk = self.mk_ejected()
+        g.report_result(C, ok=True, started_at=clk[0])
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+
+    def test_hard_failures_escalate_to_open(self):
+        g, clk = self.mk_ejected()
+        for _ in range(3):
+            g.report_result(C, ok=False)
+        assert states(g)[C] == BREAKER_OPEN
+
+    def test_readmission_via_half_open_probe(self):
+        g, clk = self.mk_ejected()
+        clk[0] += 10.0  # past the (unjittered) cooldown
+        # Selection lazily half-opens the straggler.
+        seen_half_open = False
+        for _ in range(20):
+            addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            done()
+            if states(g)[C] == BREAKER_HALF_OPEN:
+                seen_half_open = True
+                break
+        assert seen_half_open
+        g.report_result(C, ok=True, started_at=clk[0])
+        assert states(g)[C] == BREAKER_CLOSED
+
+
+class TestSlowStartRamp:
+    def share_of_b(self, g, n=60):
+        """Pick share of endpoint B while HOLDING in-flight slots, so
+        LeastLoad's weighted keys converge to the weight ratio instead
+        of ping-ponging on empty load."""
+        holds = []
+        picked_b = 0
+        for _ in range(n):
+            addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            holds.append(done)
+            if addr == B:
+                picked_b += 1
+        for done in holds:
+            done()
+        return picked_b / n
+
+    def test_parked_attach_share_ramps_not_steps(self):
+        clk = [0.0]
+        g = EndpointGroup(
+            clock=lambda: clk[0], outlier_k=0.0, slow_start_window=100.0,
+            probe_jitter=0.0, name="m",
+        )
+        g.reconcile_endpoints({"pa": Endpoint(address=A)})
+        clk[0] = 200.0  # A's own warmup long finished
+        # Parked-attach: B joins the group mid-life.
+        g.reconcile_endpoints({
+            "pa": Endpoint(address=A), "pb": Endpoint(address=B),
+        })
+        share_early = self.share_of_b(g)
+        clk[0] = 250.0  # halfway through B's ramp
+        share_mid = self.share_of_b(g)
+        clk[0] = 320.0  # ramp complete
+        share_late = self.share_of_b(g)
+        assert share_early < share_mid < share_late
+        assert share_early < 0.2   # near the RAMP_FLOOR share, not 50%
+        assert share_late > 0.4    # full LeastLoad share once warm
+        # Ramp state is visible and clears.
+        snap = {e["address"]: e for e in g.breaker_snapshot()}
+        assert snap[B]["warming"] is False
+
+    def test_breaker_readmission_starts_warmup(self):
+        clk = [0.0]
+        g = EndpointGroup(
+            breaker_threshold=3, breaker_cooldown=10.0,
+            clock=lambda: clk[0], outlier_k=0.0, slow_start_window=50.0,
+            probe_jitter=0.0,
+        )
+        g.reconcile_endpoints({
+            "pa": Endpoint(address=A), "pb": Endpoint(address=B),
+        })
+        clk[0] = 100.0  # initial warmups finished
+        for _ in range(3):
+            g.report_result(B, ok=False)
+        assert states(g)[B] == BREAKER_OPEN
+        clk[0] = 115.0
+        addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+        done()
+        g.report_result(B, ok=True, started_at=clk[0])
+        snap = {e["address"]: e for e in g.breaker_snapshot()}
+        assert snap[B]["state"] == BREAKER_CLOSED
+        assert snap[B]["warming"] is True
+
+
+class TestProbeJitter:
+    def test_jitter_is_deterministic_and_distinct(self):
+        ja, jb = endpoint_jitter(A), endpoint_jitter(B)
+        assert ja == endpoint_jitter(A)
+        assert 0.0 <= ja < 1.0 and 0.0 <= jb < 1.0
+        assert ja != jb
+
+    def test_half_open_waits_for_jittered_cooldown(self):
+        clk = [0.0]
+        g = EndpointGroup(
+            breaker_threshold=3, breaker_cooldown=10.0,
+            clock=lambda: clk[0], outlier_k=0.0, slow_start_window=0.0,
+            probe_jitter=0.25,
+        )
+        g.reconcile_endpoints({
+            "pa": Endpoint(address=A), "pb": Endpoint(address=B),
+        })
+        for _ in range(3):
+            g.report_result(A, ok=False)
+        assert states(g)[A] == BREAKER_OPEN
+        jittered = 10.0 * (1.0 + 0.25 * endpoint_jitter(A))
+        assert jittered > 10.0
+        # At the PLAIN cooldown the endpoint must still be closed off.
+        clk[0] = 10.0
+        for _ in range(10):
+            addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            assert addr == B
+            done()
+        assert states(g)[A] == BREAKER_OPEN
+        # Just past the jittered cooldown: selection half-opens it.
+        clk[0] = jittered + 0.001
+        for _ in range(20):
+            addr, done = g.get_best_addr(strategy=LEAST_LOAD, timeout=1)
+            done()
+            if states(g)[A] == BREAKER_HALF_OPEN:
+                break
+        assert states(g)[A] == BREAKER_HALF_OPEN
+
+
+class TestSlowFaultMode:
+    def test_parse_spec_grammar(self):
+        f = faults.parse_spec("engine.stream", "slow:20")
+        assert f.mode == "slow" and f.arg == 20.0 and f.arg2 is None
+        f = faults.parse_spec("engine.stream", "slow:20:5")
+        assert f.arg == 20.0 and f.arg2 == 5.0
+        with pytest.raises(ValueError):
+            faults.parse_spec("engine.stream", "slow")
+        with pytest.raises(ValueError):
+            faults.set_fault("engine.stream", "slow")
+
+    def test_per_trigger_drag(self):
+        faults.arm_spec("test.gray.slow", "slow:5")
+        try:
+            t0 = time.monotonic()
+            for _ in range(4):
+                assert faults.fault("test.gray.slow", payload=b"x") == b"x"
+            assert time.monotonic() - t0 >= 0.02  # 4 x 5 ms
+        finally:
+            faults.clear_fault("test.gray.slow")
+
+    def test_jitter_is_deterministic(self):
+        # Same arm, same trigger sequence => identical description
+        # (fired counts drive the golden-ratio jitter sequence).
+        faults.arm_spec("test.gray.slowj", "slow:0:1")
+        try:
+            for _ in range(3):
+                faults.fault("test.gray.slowj")
+            assert faults.list_faults()[0]["arg2"] == 1.0
+        finally:
+            faults.clear_fault("test.gray.slowj")
+
+
+class TestHealthSnapshot:
+    def test_shape_and_evidence(self):
+        g, clk = mk_group()
+        feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (1.0, 5)})
+        snap = g.health_snapshot()
+        assert snap["scoring"]["enabled"] is True
+        assert snap["scoring"]["fleet_median_p95_s"] is not None
+        eps = {e["address"]: e for e in snap["endpoints"]}
+        assert eps[C]["weight"] == pytest.approx(0.5)
+        assert eps[C]["p95_s"] == pytest.approx(1.0, rel=0.1)
+        assert eps[A]["ewma_s"] is not None
+        assert eps[A]["observed_total"] > 0
+
+    def test_balancer_passthrough(self):
+        from kubeai_tpu.loadbalancer.balancer import LoadBalancer
+        from kubeai_tpu.runtime.store import Store
+
+        lb = LoadBalancer(
+            Store(), health_kwargs={"outlier_k": 2.5, "scoring_window": 1.0}
+        )
+        g = lb.group("m")
+        assert g.outlier_k == 2.5 and g.scoring_window == 1.0
+        lb.observe_latency("m", A, 0.1)  # no endpoints yet: no-op
+        assert lb.health_snapshot()["m"]["scoring"]["enabled"] is True
+
+
+# ---------------------------------------------------------------------------
+# The full e2e: one real replica of three turns gray, the scorer ejects
+# it, p99 is contained, and the batch tier still uses it.
+
+
+def test_gray_drill_fast():
+    from benchmarks.gray_drill import run
+
+    summary = run(fast=True, verbose=False)
+    assert summary["ok"]
+    assert summary["degrade"]["endpoint"]
+    assert summary["batch"]["straggler_served"] >= 1
+    assert summary["surfaces"]["soft_ejections_total"] >= 1
+    assert summary["surfaces"]["incident_id"]
