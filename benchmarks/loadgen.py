@@ -383,6 +383,112 @@ def schedule_replica_kill(base: str, after_s: float) -> None:
     threading.Thread(target=run, daemon=True, name="loadgen-kill").start()
 
 
+class OtlpSmoke:
+    """``--otlp``: exercise the OTLP export bridge for the duration of a
+    run — an in-process stub collector (benchmarks/otlp_stub.py) receives
+    what a client-side exporter ships, and ``finish()`` cross-checks the
+    stub's received counts against the exporter's own
+    ``kubeai_otel_exported_total`` deltas. A probe span + log record are
+    emitted through the real producer seams (the flight-recorder hook and
+    the package logger) so the round trip is exercised even when the run
+    itself produces no client-side telemetry."""
+
+    def __init__(self, flush_interval: float = 0.2, metrics_interval: float = 3600.0):
+        from benchmarks.otlp_stub import StubCollector
+        from kubeai_tpu.obs.otel import M_EXPORTED, OtelExporter, SIGNALS
+
+        self._signals = SIGNALS
+        self.stub = StubCollector().start()
+        self._before = {
+            s: M_EXPORTED.value(labels={"signal": s}) for s in SIGNALS
+        }
+        self._dropped_before = {s: self._dropped_total(s) for s in SIGNALS}
+        self.exporter = OtelExporter(
+            self.stub.endpoint,
+            service="kubeai-loadgen",
+            flush_interval=flush_interval,
+            # Metrics are exported once, explicitly, in finish() — a
+            # periodic tick mid-run would make the received batch count
+            # depend on run duration.
+            metrics_interval=metrics_interval,
+        )
+        self.exporter.start()
+
+    @staticmethod
+    def _dropped_total(signal: str) -> float:
+        from kubeai_tpu.obs.otel import M_DROPPED
+
+        return sum(
+            M_DROPPED.value(labels={"signal": signal, "reason": r})
+            for r in ("queue_full", "send_error", "shutdown")
+        )
+
+    def _emit_probe(self) -> None:
+        import logging
+
+        from kubeai_tpu.obs.logs import get_logger
+        from kubeai_tpu.obs.recorder import default_recorder
+
+        # Without a bootstrap (loadgen never calls setup_logging) the
+        # effective level is the root default WARNING, which would filter
+        # the INFO probe before it reaches the export handler.
+        logging.getLogger("kubeai_tpu.benchmarks").setLevel(logging.INFO)
+
+        default_recorder.record_timeline({
+            "trace_id": "0" * 31 + "1",
+            "span_id": "0" * 15 + "1",
+            "request_id": "loadgen-otlp-probe",
+            "component": "loadgen",
+            "model": "probe",
+            "start_ms": time.time() * 1000.0,
+            "duration_ms": 0.0,
+            "outcome": "ok",
+            "phases": [],
+        })
+        get_logger("kubeai_tpu.benchmarks").info(
+            "otlp export smoke probe",
+            extra={"trace_id": "0" * 31 + "1", "request_id": "loadgen-otlp-probe"},
+        )
+
+    def finish(self) -> dict:
+        from kubeai_tpu.obs.otel import M_EXPORTED
+
+        self._emit_probe()
+        self.exporter.export_metrics()
+        self.exporter.stop(drain=True)
+        received = {
+            "spans": len(self.stub.spans()),
+            "log_records": len(self.stub.log_records()),
+            "metric_batches": len(self.stub.snapshot("metrics")),
+        }
+        report = self.exporter.report()
+        exported = {
+            s: round(M_EXPORTED.value(labels={"signal": s}) - self._before[s])
+            for s in self._signals
+        }
+        dropped = {
+            s: round(self._dropped_total(s) - self._dropped_before[s])
+            for s in self._signals
+        }
+        self.stub.stop()
+        # Consistency: every exported item must have landed at the stub.
+        # One exported "span" is a timeline that fans out into >= 1 OTLP
+        # spans; logs are 1:1; >= 1 metric object means >= 1 batch.
+        consistent = (
+            received["spans"] >= exported["span"] >= 1
+            and received["log_records"] == exported["log"] >= 1
+            and (received["metric_batches"] >= 1) == (exported["metric"] >= 1)
+        )
+        return {
+            "endpoint": self.stub.endpoint,
+            "received": received,
+            "exported": exported,
+            "dropped": dropped,
+            "queue_max": report["queue_max"],
+            "consistent": consistent,
+        }
+
+
 def run_benchmark(
     base_url: str,
     model: str,
@@ -407,6 +513,7 @@ def run_benchmark(
     flood_at: float | None = None,
     flood_conversations: int = 0,
     priority_mix: list[tuple[str, float]] | None = None,
+    otlp: bool = False,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy). With
@@ -436,6 +543,7 @@ def run_benchmark(
     gains a per-class block with the operator's own counters alongside
     the client's. Composes with *tenant_mix* — class and tenant are
     drawn independently."""
+    smoke = OtlpSmoke() if otlp else None
     base = operator_base(base_url)
     retries_before = scrape_retry_counters(base)
     qos_before = scrape_qos_counters(base) if priority_mix else None
@@ -697,6 +805,9 @@ def run_benchmark(
     return {
         "requests": n_requests,
         "failures": failures,
+        # OTLP export smoke (--otlp): the stub collector's received
+        # counts cross-checked against the exporter's counter deltas.
+        "export": smoke.finish() if smoke else None,
         "recovery": recovery,
         "gray": gray,
         "tenants": tenants_block,
@@ -810,6 +921,13 @@ def main():
         help="flood size (default 2x --conversations)",
     )
     parser.add_argument(
+        "--otlp", action="store_true",
+        help="export-bridge smoke: run an in-process OTLP stub collector "
+             "and a client-side exporter for the duration of the run; "
+             "the summary gains an export block whose received counts "
+             "are cross-checked against kubeai_otel_exported_total deltas",
+    )
+    parser.add_argument(
         "--slo-ttft-ms", type=float, default=2000.0,
         help="TTFT SLO objective (ms) for the emitted slo block",
     )
@@ -856,6 +974,7 @@ def main():
         priority_mix=(
             parse_priority_mix(args.priority_mix) if args.priority_mix else None
         ),
+        otlp=args.otlp,
     )
     print(json.dumps(summary, indent=1))
 

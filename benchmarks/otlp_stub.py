@@ -1,0 +1,125 @@
+"""In-process OTLP/HTTP+JSON stub collector.
+
+A tiny threaded HTTP server accepting ``POST /v1/{traces,metrics,logs}``
+and retaining the parsed JSON payloads — the receive side for
+``loadgen --otlp`` and the exporter round-trip tests, so the export
+bridge is exercised against a real HTTP hop without any external
+collector. Supports a fail mode (503 every request) to rehearse
+collector outages.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+SIGNAL_PATHS = {
+    "/v1/traces": "traces",
+    "/v1/metrics": "metrics",
+    "/v1/logs": "logs",
+}
+
+
+class StubCollector:
+    """``with StubCollector() as stub: ... stub.endpoint ...``"""
+
+    def __init__(self, fail: bool = False):
+        self.fail = fail
+        self._lock = threading.Lock()
+        self.payloads: dict[str, list[dict]] = {
+            "traces": [], "metrics": [], "logs": [],
+        }
+        self.requests = 0
+        stub = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def do_POST(self):  # noqa: N802 (http.server API)
+                n = int(self.headers.get("Content-Length") or 0)
+                raw = self.rfile.read(n)
+                signal = SIGNAL_PATHS.get(self.path)
+                with stub._lock:
+                    stub.requests += 1
+                if stub.fail or signal is None:
+                    self.send_response(503 if stub.fail else 404)
+                    self.end_headers()
+                    return
+                try:
+                    doc = json.loads(raw)
+                except ValueError:
+                    self.send_response(400)
+                    self.end_headers()
+                    return
+                with stub._lock:
+                    stub.payloads[signal].append(doc)
+                body = b"{}"
+                self.send_response(200)
+                self.send_header("Content-Type", "application/json")
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, *a):  # silence request logging
+                pass
+
+        self._server = ThreadingHTTPServer(("127.0.0.1", 0), Handler)
+        self._thread = threading.Thread(
+            target=self._server.serve_forever, name="otlp-stub", daemon=True
+        )
+
+    @property
+    def endpoint(self) -> str:
+        host, port = self._server.server_address[:2]
+        return f"http://{host}:{port}"
+
+    def start(self) -> "StubCollector":
+        self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+
+    def __enter__(self) -> "StubCollector":
+        return self.start()
+
+    def __exit__(self, *exc) -> None:
+        self.stop()
+
+    # -- accessors ----------------------------------------------------------
+
+    def snapshot(self, signal: str) -> list[dict]:
+        with self._lock:
+            return list(self.payloads[signal])
+
+    def spans(self) -> list[dict]:
+        out = []
+        for doc in self.snapshot("traces"):
+            for rs in doc.get("resourceSpans", []):
+                for ss in rs.get("scopeSpans", []):
+                    out.extend(ss.get("spans", []))
+        return out
+
+    def log_records(self) -> list[dict]:
+        out = []
+        for doc in self.snapshot("logs"):
+            for rl in doc.get("resourceLogs", []):
+                for sl in rl.get("scopeLogs", []):
+                    out.extend(sl.get("logRecords", []))
+        return out
+
+    def metric_names(self) -> set[str]:
+        out: set[str] = set()
+        for doc in self.snapshot("metrics"):
+            for rm in doc.get("resourceMetrics", []):
+                for sm in rm.get("scopeMetrics", []):
+                    for m in sm.get("metrics", []):
+                        out.add(m.get("name", ""))
+        return out
+
+    def counts(self) -> dict[str, int]:
+        return {
+            "traces": len(self.snapshot("traces")),
+            "metrics": len(self.snapshot("metrics")),
+            "logs": len(self.snapshot("logs")),
+        }

@@ -13,7 +13,6 @@ unproxied work (cold starts, saturation) also drives scaling.
 
 from __future__ import annotations
 
-import logging
 import math
 import threading
 import time
@@ -24,9 +23,10 @@ from dataclasses import dataclass, field
 from kubeai_tpu.autoscaler.movingaverage import SimpleMovingAverage
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS, default_registry, parse_prometheus_text
 from kubeai_tpu.obs.incidents import publish_trigger
+from kubeai_tpu.obs.logs import get_logger
 from kubeai_tpu.runtime.store import AlreadyExists, NotFound, ObjectMeta, Store
 
-log = logging.getLogger("kubeai_tpu.autoscaler")
+log = get_logger("kubeai_tpu.autoscaler")
 
 KIND_STATE = "AutoscalerState"
 ENGINE_QUEUE_METRIC = "kubeai_engine_queue_depth"
@@ -343,6 +343,19 @@ class Autoscaler:
                 },
             }
             self.decisions.append(record)
+            # One structured line per APPLIED scale change — steady-state
+            # no-op ticks stay out of the logs (the decision ring has them).
+            if outcome.get("applied"):
+                log.info(
+                    "scale decision applied",
+                    extra={
+                        "model": name,
+                        "desired": desired,
+                        "applied_replicas": outcome.get("replicas"),
+                        "window_avg": round(mean, 3),
+                        "reason": outcome.get("reason"),
+                    },
+                )
             # Incident trigger: desired exceeded the clamp — the model
             # WANTS more capacity than maxReplicas allows. A min-clamp
             # (desired < clamped) is idle normality, not an incident.

@@ -30,7 +30,6 @@ engine image matrix; SURVEY.md §2.9). Architecture:
 
 from __future__ import annotations
 
-import logging
 import queue
 import threading
 import time
@@ -61,12 +60,16 @@ from kubeai_tpu.obs.recorder import (
     register_engine_debug_section,
     unregister_engine_debug_section,
 )
+from kubeai_tpu.obs.logs import get_logger, trace_extra
 from kubeai_tpu.obs.trace import RequestTrace, TraceContext
 from kubeai_tpu.qos import QoSQueue, record_admitted, record_preemption
 from kubeai_tpu.qos import install_queue as qos_install_queue
 from kubeai_tpu.qos import uninstall_queue as qos_uninstall_queue
 
-log = logging.getLogger("kubeai_tpu.engine")
+# The scheduler loop is ONE thread multiplexing many requests, so the
+# contextvar-bound request identity can't apply here — per-request log
+# sites stamp explicitly with ``extra=trace_extra(req.trace)``.
+log = get_logger("kubeai_tpu.engine")
 
 
 class GangLost(ConnectionError):
@@ -971,7 +974,10 @@ class Engine:
         if t_prefill is not None:
             first_tok = tr.tokens[0] if tr.tokens else end
             self.m_prefill_s.observe(first_tok - t_prefill)
-        self.m_e2e.observe(end - tr.t0_mono, labels={"outcome": outcome})
+        self.m_e2e.observe(
+            end - tr.t0_mono, labels={"outcome": outcome},
+            exemplar=tr.ctx.trace_id,
+        )
         # Per-token TPOT is O(generated tokens) worth of histogram
         # observes — that runs on the recorder's worker thread, not here.
         default_recorder.submit(tr, observe=self._observe_tpot)
@@ -979,8 +985,9 @@ class Engine:
     def _observe_tpot(self, tr: RequestTrace) -> None:
         """Recorder-worker-thread hook: derive inter-token latencies
         from the raw token stamps (Histogram.observe is thread-safe)."""
+        tid = tr.ctx.trace_id
         for a, b in zip(tr.tokens, tr.tokens[1:]):
-            self.m_tpot.observe(b - a)
+            self.m_tpot.observe(b - a, exemplar=tid)
 
     def submit(
         self,
@@ -1788,6 +1795,7 @@ class Engine:
         log.info(
             "preempting slot %d (batch, %d tokens generated) for "
             "interactive admission", victim, slot.generated,
+            extra=trace_extra(slot.req.trace, qos_class=slot.req.priority),
         )
         record_preemption(slot.generated)
         self._free(victim, "preempted", flush=False, outcome="preempted")
@@ -2552,7 +2560,10 @@ class Engine:
             # (Observed here, not at slot admission — admission can be
             # fast while prefill + the first-token sync are not, and
             # the SLO monitor reads this histogram.)
-            self.m_ttft.observe(time.monotonic() - req.arrival)
+            self.m_ttft.observe(
+                time.monotonic() - req.arrival,
+                exemplar=req.trace.ctx.trace_id if req.trace is not None else None,
+            )
         if req.trace is not None:
             req.trace.tok()  # one monotonic read + list append
 

@@ -42,7 +42,6 @@ from __future__ import annotations
 import hmac
 import hashlib
 import json
-import logging
 import os
 import select
 import socket
@@ -52,7 +51,9 @@ import time
 
 import numpy as np
 
-log = logging.getLogger("kubeai_tpu.engine.gang")
+from kubeai_tpu.obs.logs import get_logger
+
+log = get_logger("kubeai_tpu.engine.gang")
 
 DEFAULT_GANG_PORT = 8477
 

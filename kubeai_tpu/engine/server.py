@@ -23,7 +23,6 @@ from __future__ import annotations
 import argparse
 import json
 import dataclasses
-import logging
 import os
 import queue
 import re
@@ -44,8 +43,17 @@ from kubeai_tpu.obs import (
     handle_debug_request,
     handle_history_request,
     handle_incident_request,
+    handle_logs_request,
     handle_tenant_request,
+    install_log_ring,
 )
+from kubeai_tpu.obs.logs import (
+    bind_log_context,
+    get_logger,
+    set_log_context,
+    setup_logging,
+)
+from kubeai_tpu.obs.otel import maybe_start_exporter, uninstall_exporter
 from kubeai_tpu.obs.history import (
     HistoryStore,
     RegistrySampler,
@@ -64,7 +72,7 @@ from kubeai_tpu.qos import (
     normalize_priority,
 )
 
-log = logging.getLogger("kubeai_tpu.engine.server")
+log = get_logger("kubeai_tpu.engine.server")
 
 # Retry-After hint (seconds) on 429 backpressure responses.
 RETRY_AFTER_HINT = "1"
@@ -140,9 +148,14 @@ class EngineServer:
         # tracked so stop() only tears down what start() installed.
         self._history = None
         self._history_sampler = None
+        self._otel = None
 
     def start(self):
         set_build_info("engine")
+        # WARNING+ ring from server start (not first /debug/logs GET), so
+        # early failures are already captured when someone comes looking.
+        install_log_ring()
+        self._otel = maybe_start_exporter("kubeai-engine")
         if installed_history() is None:
             self._history = HistoryStore(
                 history_dir=os.path.join(history_dir_default(), "engine"),
@@ -183,6 +196,10 @@ class EngineServer:
                 # Identity-checked: a newer owner's install survives.
                 uninstall_history(self._history)
                 self._history = None
+            if self._otel is not None:
+                self._otel.stop()
+                uninstall_exporter(self._otel)
+                self._otel = None
             self.httpd.shutdown()
             self.stopped_event.set()
 
@@ -412,6 +429,7 @@ def _make_handler(srv: EngineServer):
                     # depths, deficits, preemption + resume counters.
                     or handle_qos_request(path, query)
                     or handle_history_request(path, query)
+                    or handle_logs_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:
@@ -452,12 +470,20 @@ def _make_handler(srv: EngineServer):
             from kubeai_tpu.proxy.apiutils import sanitize_request_id
 
             rid = sanitize_request_id(self.headers.get("X-Request-ID", ""))
-            if rid and path.startswith("/v1/"):
-                log.info("request id=%s engine=%s path=%s", rid, srv.model_name, path)
             # Trace context: the proxy stamps `traceparent` (W3C) on the
             # hop; absent that, the trace id derives from X-Request-ID
             # so proxy- and engine-side timelines still join.
             trace_ctx = extract_context(self.headers, fallback_request_id=rid)
+            # Handler threads serve exactly one request: REPLACE the log
+            # context so a pooled thread never leaks the prior request's ids.
+            set_log_context(
+                trace_id=trace_ctx.trace_id,
+                span_id=trace_ctx.span_id,
+                request_id=rid,
+                model=srv.model_name,
+            )
+            if rid and path.startswith("/v1/"):
+                log.info("request id=%s engine=%s path=%s", rid, srv.model_name, path)
             # Remaining end-to-end budget stamped by the proxy (seconds);
             # converted to an absolute monotonic deadline HERE so queue
             # wait counts against it.
@@ -487,6 +513,7 @@ def _make_handler(srv: EngineServer):
             # cluster-internal, and header drift (old proxy, a test
             # harness) should degrade to standard, not 400.
             priority = normalize_priority(self.headers.get(PRIORITY_HEADER, "")) or DEFAULT_CLASS
+            bind_log_context(tenant=tenant, qos_class=priority)
             # Preemptible stamp: only the proxy sets it (replayable
             # batch streams), and never together with a planned handoff
             # — a request is handed off OR preempted in a flight, not
@@ -1494,7 +1521,7 @@ def main(argv=None):
     args = parser.parse_args(argv)
     if not args.parked and not args.model:
         parser.error("--model is required (unless --parked)")
-    logging.basicConfig(level=logging.INFO)
+    setup_logging("engine")
 
     if args.parked:
         if gang_hosts:

@@ -30,6 +30,9 @@ from kubeai_tpu.loadbalancer.health import (
 
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.obs.incidents import publish_trigger
+from kubeai_tpu.obs.logs import get_logger
+
+log = get_logger("kubeai_tpu.loadbalancer")
 
 LEAST_LOAD = "LeastLoad"
 PREFIX_HASH = "PrefixHash"
@@ -646,8 +649,24 @@ class EndpointGroup:
     # -- passive health / circuit breaking ---------------------------------
 
     def _set_state(self, ep: Endpoint, state: str) -> None:
+        prev = ep.breaker_state
         ep.breaker_state = state
         _M_ENDPOINT_STATE.set(_STATE_VALUE[state], labels={"endpoint": ep.address})
+        if prev == state:
+            return
+        # Every breaker/health-ladder transition through the one choke
+        # point: leaving CLOSED is a WARNING (capacity just shrank, and
+        # the ring surfaces it at /debug/logs), re-admission is INFO.
+        fn = log.warning if state != BREAKER_CLOSED else log.info
+        fn(
+            "endpoint breaker %s -> %s", prev, state,
+            extra={
+                "model": self.name,
+                "endpoint": ep.address,
+                "role": ep.role,
+                "weight": round(ep.weight, 3),
+            },
+        )
 
     def _probe_cooldown(self, ep: Endpoint) -> float:
         """Cooldown before *ep* may half-open, with a deterministic

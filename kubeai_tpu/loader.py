@@ -33,6 +33,9 @@ import sys
 import tempfile
 
 from kubeai_tpu.controller.model_source import parse_model_source
+from kubeai_tpu.obs.logs import get_logger, setup_logging
+
+log = get_logger("kubeai_tpu.loader")
 
 
 def _atomic_dest(dest: str):
@@ -43,7 +46,7 @@ def _atomic_dest(dest: str):
 def load(src_url: str, dest: str) -> None:
     src = parse_model_source(src_url)
     if os.path.isdir(dest) and os.listdir(dest):
-        print(f"destination {dest} already populated; nothing to do")
+        log.info("destination %s already populated; nothing to do", dest)
         return
     tmp = _atomic_dest(dest)
     try:
@@ -66,7 +69,7 @@ def load(src_url: str, dest: str) -> None:
             shutil.rmtree(dest)
         os.rename(tmp, dest)
         tmp = None
-        print(f"loaded {src_url} -> {dest}")
+        log.info("loaded %s -> %s", src_url, dest)
     finally:
         if tmp and os.path.isdir(tmp):
             shutil.rmtree(tmp, ignore_errors=True)
@@ -75,9 +78,9 @@ def load(src_url: str, dest: str) -> None:
 def evict(dest: str) -> None:
     if os.path.isdir(dest):
         shutil.rmtree(dest)
-        print(f"evicted {dest}")
+        log.info("evicted %s", dest)
     else:
-        print(f"{dest} already absent")
+        log.info("%s already absent", dest)
 
 
 def stage_remote(url: str, base_dir: str, prefix: str = "") -> str:
@@ -106,14 +109,14 @@ def warm_compile_cache(dest: str, engine_args: list[str] | None = None) -> dict 
     from kubeai_tpu.engine.coldstart import setup_compile_cache, warm_from_checkpoint
 
     if setup_compile_cache() is None:
-        print("KUBEAI_COMPILE_CACHE is not set; skipping compile warm")
+        log.info("KUBEAI_COMPILE_CACHE is not set; skipping compile warm")
         return None
     try:
         stats = warm_from_checkpoint(dest, engine_args)
     except Exception as e:
-        print(f"compile warm failed (non-fatal): {e}")
+        log.warning("compile warm failed (non-fatal): %s", e)
         return None
-    print(f"warmed compile cache for {dest}: {stats}")
+    log.info("warmed compile cache for %s: %s", dest, stats)
     return stats
 
 
@@ -129,6 +132,7 @@ def main(argv=None):
     parser.add_argument("src_or_dir")
     parser.add_argument("dest", nargs="?")
     args, engine_args = parser.parse_known_args(argv)
+    setup_logging("loader")
     if engine_args and not args.warm_compile_cache:
         # Trailing args are ONLY the warm step's engine flags; without
         # it they are typos (a misspelled --evict must not silently

@@ -12,7 +12,6 @@ CLI (the operator container entrypoint, ref: cmd/main.go):
 from __future__ import annotations
 
 import argparse
-import logging
 import os
 import time
 import uuid
@@ -34,6 +33,8 @@ from kubeai_tpu.obs.incidents import (
     standard_sources,
     uninstall_recorder,
 )
+from kubeai_tpu.obs.logs import get_logger, setup_logging
+from kubeai_tpu.obs.otel import maybe_start_exporter, uninstall_exporter
 from kubeai_tpu.obs.slo import SLOMonitor
 from kubeai_tpu.config.system import System, load_system_config
 from kubeai_tpu.controller.adapters import AdapterReconciler
@@ -47,7 +48,7 @@ from kubeai_tpu.proxy.server import OpenAIServer
 from kubeai_tpu.runtime.local import LocalRuntime
 from kubeai_tpu.runtime.store import Store
 
-log = logging.getLogger("kubeai_tpu.manager")
+log = get_logger("kubeai_tpu.manager")
 
 
 class Manager:
@@ -191,6 +192,8 @@ class Manager:
         self.local_runtime = LocalRuntime(self.store, namespace) if local_runtime else None
 
     def start(self):
+        # OTLP export bridge (no-op unless KUBEAI_OTLP_ENDPOINT is set).
+        self._otel = maybe_start_exporter("kubeai-operator")
         self.lb.start()
         if self.parked_pool is not None:
             self.parked_pool.start()
@@ -236,6 +239,11 @@ class Manager:
         if self.parked_pool is not None:
             self.parked_pool.stop()
         self.lb.stop()
+        otel = getattr(self, "_otel", None)
+        if otel is not None:
+            otel.stop()
+            uninstall_exporter(otel)
+            self._otel = None
 
 
 def main(argv=None):
@@ -263,7 +271,7 @@ def main(argv=None):
         help="comma-separated curated catalog entries to apply at boot (see kubeai_tpu.catalog)",
     )
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    setup_logging("operator")
 
     system = load_system_config(args.config) if args.config else System().default_and_validate()
     store = None

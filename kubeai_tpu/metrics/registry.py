@@ -10,7 +10,9 @@ the reference so dashboards/scrapers port over.
 
 from __future__ import annotations
 
+import os
 import threading
+import time
 from bisect import bisect_left
 
 
@@ -170,8 +172,15 @@ class Histogram(_Metric):
         super().__init__(name, help_)
         self.buckets = sorted(buckets)
         self._obs: dict[tuple, list] = {}  # key -> [bucket_counts, sum, count]
+        # key -> {bucket_idx: (trace_id, value, wall_ts)} — one sampled
+        # exemplar per bucket, latest observation wins.
+        self._exemplars: dict[tuple, dict[int, tuple[str, float, float]]] = {}
 
-    def observe(self, value: float, labels: dict[str, str] | None = None):
+    def observe(self, value: float, labels: dict[str, str] | None = None,
+                exemplar: str | None = None):
+        """*exemplar*, when given, is a trace id linking this
+        observation's bucket to its /debug/requests timeline (rendered
+        in OpenMetrics exemplar syntax behind KUBEAI_METRICS_EXEMPLARS)."""
         key = self._key(labels)
         # First bucket whose upper bound is >= value ("le" semantics);
         # len(buckets) is the +Inf slot.
@@ -181,6 +190,10 @@ class Histogram(_Metric):
             entry[0][idx] += 1
             entry[1] += value
             entry[2] += 1
+            if exemplar:
+                self._exemplars.setdefault(key, {})[idx] = (
+                    str(exemplar), value, time.time()
+                )
 
     def snapshot(self) -> dict[tuple, tuple[list[int], float, int]]:
         """Point-in-time copy: key -> (per-bucket counts with the +Inf
@@ -188,17 +201,27 @@ class Histogram(_Metric):
         with self._lock:
             return {k: (list(c), s, n) for k, (c, s, n) in self._obs.items()}
 
-    def collect(self) -> list[str]:
+    def collect(self, exemplars: bool = False) -> list[str]:
         with self._lock:
             lines = [f"# HELP {self.name} {self.help}", f"# TYPE {self.name} histogram"]
             for key, (counts, total, n) in sorted(self._obs.items()):
                 labels = dict(key)
+                ex = self._exemplars.get(key, {}) if exemplars else {}
                 cum = 0
-                for b, c in zip(self.buckets + [float("inf")], counts):
+                for i, (b, c) in enumerate(zip(self.buckets + [float("inf")], counts)):
                     cum += c
                     lb = dict(labels)
                     lb["le"] = "+Inf" if b == float("inf") else repr(b)
-                    lines.append(f"{self.name}_bucket{_fmt_labels(lb)} {cum}")
+                    line = f"{self.name}_bucket{_fmt_labels(lb)} {cum}"
+                    if i in ex:
+                        # OpenMetrics exemplar syntax: a slow bucket
+                        # resolves to /debug/requests?id=<trace_id>.
+                        tid, val, ts = ex[i]
+                        line += (
+                            f' # {{trace_id="{_escape_label_value(tid)}"}}'
+                            f" {val} {round(ts, 3)}"
+                        )
+                    lines.append(line)
                 lines.append(f"{self.name}_sum{_fmt_labels(labels)} {total}")
                 lines.append(f"{self.name}_count{_fmt_labels(labels)} {n}")
             return lines
@@ -249,12 +272,20 @@ class Registry:
                 raise TypeError(f"metric {name} already registered as {type(m).__name__}")
             return m
 
-    def render(self) -> str:
+    def render(self, exemplars: bool | None = None) -> str:
+        """*exemplars* defaults to the KUBEAI_METRICS_EXEMPLARS=1 env
+        gate (checked per render — a scrape, not a hot path) so both
+        servers pick the behavior up without re-wiring."""
+        if exemplars is None:
+            exemplars = os.environ.get("KUBEAI_METRICS_EXEMPLARS", "") == "1"
         with self._lock:
             metrics = list(self._metrics.values())
         lines: list[str] = []
         for m in metrics:
-            lines.extend(m.collect())
+            if exemplars and isinstance(m, Histogram):
+                lines.extend(m.collect(exemplars=True))
+            else:
+                lines.extend(m.collect())
         return "\n".join(lines) + "\n"
 
 
@@ -273,6 +304,12 @@ def parse_prometheus_text(text: str) -> dict[str, list[tuple[dict[str, str], flo
         line = line.strip()
         if not line or line.startswith("#"):
             continue
+        # OpenMetrics exemplar suffix (` # {trace_id="..."} v ts`, emitted
+        # behind KUBEAI_METRICS_EXEMPLARS): strip it, or the rsplit on
+        # "}" below would split inside the exemplar's label set and the
+        # whole sample line would be silently dropped.
+        if " # {" in line:
+            line = line.split(" # {", 1)[0].rstrip()
         try:
             if "{" in line:
                 name, rest = line.split("{", 1)

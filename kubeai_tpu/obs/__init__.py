@@ -29,6 +29,19 @@ from kubeai_tpu.obs.incidents import (
     publish_trigger,
     uninstall_recorder,
 )
+from kubeai_tpu.obs.logs import (
+    LogRing,
+    bind_log_context,
+    clear_log_context,
+    get_logger,
+    handle_logs_request,
+    install_log_ring,
+    installed_log_ring,
+    set_log_context,
+    setup_logging,
+    trace_extra,
+    uninstall_log_ring,
+)
 from kubeai_tpu.obs.recorder import (
     DEBUG_PATHS,
     FlightRecorder,
@@ -75,6 +88,17 @@ __all__ = [
     "install_recorder",
     "publish_trigger",
     "uninstall_recorder",
+    "LogRing",
+    "bind_log_context",
+    "clear_log_context",
+    "get_logger",
+    "handle_logs_request",
+    "install_log_ring",
+    "installed_log_ring",
+    "set_log_context",
+    "setup_logging",
+    "trace_extra",
+    "uninstall_log_ring",
     "DEBUG_PATHS",
     "FlightRecorder",
     "debug_index_response",

@@ -192,6 +192,29 @@ def _tenant_entries(section: dict, captured_at: float, limit: int = 6) -> list:
     return out
 
 
+def _log_entries(section: dict, limit: int = 25) -> list:
+    """Recent WARNING+ structured log records interleaved into the
+    timeline — each stamped with its own emit time, so the error log
+    lands in sequence with the breaker flip it explains."""
+    out = []
+    for e in (section.get("records") or [])[:limit]:
+        line = f"[{e.get('level')}] {e.get('logger')}: {e.get('message')}"
+        tags = " ".join(
+            f"{k}={e[k]}"
+            for k in ("trace_id", "request_id", "model", "tenant", "qos_class")
+            if e.get(k)
+        )
+        if tags:
+            line += f" ({tags})"
+        out.append(_entry(e.get("ts"), "log", line))
+    evicted = section.get("evicted") or 0
+    if evicted and out:
+        out.append(_entry(
+            out[-1][0], "log", f"(+{evicted} older records evicted from the ring)"
+        ))
+    return out
+
+
 def _routing_entries(section: dict, captured_at: float) -> list:
     out = []
     for model, snap in sorted(section.items()):
@@ -315,6 +338,7 @@ def render_incident(doc: dict) -> str:
         "routing": lambda s: _routing_entries(s, t0),
         "tenants": lambda s: _tenant_entries(s, t0),
         "history": lambda s: _history_entries(s, t0),
+        "logs": lambda s: _log_entries(s),
     }
     for name, fn in handlers.items():
         sec = sections.get(name)

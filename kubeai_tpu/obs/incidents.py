@@ -822,6 +822,7 @@ def standard_sources(
     surfaces — ONE wiring shared by the Manager and the incident drill
     so the captured sections can't drift between them. Every source is
     a zero-arg callable evaluated at capture time."""
+    from kubeai_tpu.obs.logs import logs_incident_source
     from kubeai_tpu.obs.recorder import default_recorder
     from kubeai_tpu.obs.tenants import default_accountant
 
@@ -840,6 +841,10 @@ def standard_sources(
         # capture names the hitter, and any other trigger's snapshot
         # shows who was driving the traffic when it fired.
         "tenants": default_accountant.report,
+        # Recent WARNING+ structured log records, trace-correlated with
+        # the "requests" section's timelines — the error log that
+        # explains the trigger travels WITH the snapshot.
+        "logs": logs_incident_source(limit=2 * trace_limit),
     }
     if hasattr(lb, "routing_snapshot"):
         sources["routing"] = lb.routing_snapshot

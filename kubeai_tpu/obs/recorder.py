@@ -53,6 +53,14 @@ class FlightRecorder:
     def record_timeline(self, timeline: dict) -> None:
         with self._lock:
             self._timelines.append(timeline)
+        # Subscribers (the OTLP exporter) see every recorded timeline;
+        # hooks must be O(1) non-blocking (the exporter's is a bounded
+        # enqueue) and a raising hook loses only its own copy.
+        for fn in list(_timeline_hooks):
+            try:
+                fn(timeline)
+            except Exception:
+                pass
 
     def submit(self, tr: RequestTrace, observe=None) -> None:
         """Enqueue a finished RequestTrace for off-thread assembly
@@ -262,6 +270,23 @@ def assemble_request_trace(tr: RequestTrace) -> dict:
 
 default_recorder = FlightRecorder()
 
+# Process-global timeline subscribers: every FlightRecorder instance
+# (the default one, per-test ones) feeds them, so an installed OTLP
+# exporter sees spans no matter which recorder assembled them.
+_timeline_hooks: list = []
+
+
+def add_timeline_hook(fn) -> None:
+    if fn not in _timeline_hooks:
+        _timeline_hooks.append(fn)
+
+
+def remove_timeline_hook(fn) -> None:
+    try:
+        _timeline_hooks.remove(fn)
+    except ValueError:
+        pass
+
 
 # ---------------------------------------------------------------------------
 # Shared /debug HTTP surface (mounted by both the operator's OpenAI
@@ -382,6 +407,8 @@ DEBUG_INDEX: tuple[tuple[str, str, str], ...] = (
      "SLO monitor report: attainment + burn rate per objective over the rolling window"),
     ("/debug/history", "both",
      "embedded time-series history: tiered metric trajectories with gap markers (?series=&since=&step=)"),
+    ("/debug/logs", "both",
+     "recent WARNING+ structured log records with trace correlation (?level=&since=&trace=&limit=)"),
     ("/debug/pipeline", "engine",
      "windowed decode stall attribution (dispatch/host_overlap/fetch_wait/emit) + live MFU/roofline"),
     ("/debug/profile", "engine",

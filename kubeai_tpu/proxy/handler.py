@@ -16,7 +16,6 @@ ref: internal/manager/otel.go:16-80).
 from __future__ import annotations
 
 import http.client
-import logging
 import threading
 import time
 
@@ -26,6 +25,7 @@ from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
 from kubeai_tpu.obs import SpanBuilder, extract_context
+from kubeai_tpu.obs.logs import bind_log_context, get_logger, set_log_context
 from kubeai_tpu.obs.tenants import (
     CANARY_HEADER,
     TENANT_HEADER,
@@ -54,7 +54,7 @@ from kubeai_tpu.proxy.recovery import (
     sse_events,
 )
 
-log = logging.getLogger("kubeai_tpu.proxy")
+log = get_logger("kubeai_tpu.proxy")
 
 RETRYABLE_CODES = {500, 502, 503, 504}
 # Retry-After hint (seconds) on backpressure responses: long enough to
@@ -130,12 +130,22 @@ class ModelProxy:
         # X-Request-ID, else generated): even parse failures get a
         # recorded timeline.
         tb = SpanBuilder(extract_context(headers), component="proxy")
+        # Log-context binding: this handler thread serves exactly one
+        # request, so every record emitted below carries the ids
+        # automatically. set (not bind) REPLACES any stale context left
+        # by the thread's previous request.
+        set_log_context(
+            trace_id=tb.ctx.trace_id,
+            span_id=tb.ctx.span_id,
+            request_id=tb.ctx.request_id,
+        )
         # Tenant attribution (obs/tenants.py): derived from credentials
         # BEFORE parsing so even a 400 is attributed; only the hash of
         # the credential survives this point. Canary probes carry the
         # trusted exclusion marker and are metered by the accountant as
         # excluded, never as traffic.
         tenant = extract_tenant(headers)
+        bind_log_context(tenant=tenant)
         is_canary = any(k.lower() == CANARY_HEADER.lower() for k in headers)
         meter = RequestMeter(tenant, canary=is_canary)
         tb.attrs["tenant"] = tenant
@@ -174,10 +184,10 @@ class ModelProxy:
             tb.ctx.request_id = req.id
             tb.model = req.model_name
             req.trace = tb
-            log.info(
-                "request id=%s trace=%s model=%s path=%s",
-                req.id, tb.ctx.trace_id, req.model_name, path,
+            bind_log_context(
+                request_id=req.id, model=req.model_name, qos_class=req.priority
             )
+            log.info("request accepted path=%s", path)
 
             labels = {"request_model": req.model_name, "request_type": "http"}
             self.active.add(1, labels=labels)
@@ -517,7 +527,9 @@ class ModelProxy:
                     observe=observe,
                 )
             return ProxyResult(resp.status, resp_headers, body_iter)
-        log.info(
+        # WARNING (not info): terminal failures land in the /debug/logs
+        # ring and every incident snapshot, trace-correlated.
+        log.warning(
             "request id=%s model=%s failed after %d attempts: %s",
             req.id, req.model_name, attempts, last_err,
         )
@@ -983,7 +995,7 @@ class ModelProxy:
                 failed_addrs, forwarded,
             )
             if resp is None:
-                log.info("replay to %s failed: %s", addr, err)
+                log.warning("replay to %s failed: %s", addr, err)
                 continue
             return resp, conn, done, addr, t_conn, replays
 

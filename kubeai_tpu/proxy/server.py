@@ -9,7 +9,6 @@ proxied upstream body through unchanged (SSE included).
 from __future__ import annotations
 
 import json
-import logging
 import threading
 import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -27,7 +26,9 @@ from kubeai_tpu.obs import (
     handle_debug_request,
     handle_history_request,
     handle_incident_request,
+    handle_logs_request,
     handle_tenant_request,
+    install_log_ring,
 )
 from kubeai_tpu.proxy.apiutils import (
     APIError,
@@ -36,7 +37,9 @@ from kubeai_tpu.proxy.apiutils import (
 )
 from kubeai_tpu.qos import handle_qos_request
 
-log = logging.getLogger("kubeai_tpu.openaiserver")
+from kubeai_tpu.obs.logs import get_logger
+
+log = get_logger("kubeai_tpu.openaiserver")
 
 INFERENCE_PATHS = (
     "/openai/v1/chat/completions",
@@ -78,6 +81,9 @@ class OpenAIServer:
 
     def start(self):
         set_build_info("operator")
+        # /debug/logs must capture WARNING+ records from server start,
+        # not from its first GET.
+        install_log_ring()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
         log.info("openai server on :%d", self.port)
@@ -287,6 +293,7 @@ def _make_handler(srv: OpenAIServer):
                     # stack also carries the engine queue breakdown).
                     or handle_qos_request(path, query)
                     or handle_history_request(path, query)
+                    or handle_logs_request(path, query)
                     or handle_debug_request(path, query)
                 )
                 if resp is None:

@@ -19,12 +19,13 @@ from __future__ import annotations
 
 import argparse
 import json
-import logging
 import os
 
 import numpy as np
 
-log = logging.getLogger("kubeai_tpu.finetune")
+from kubeai_tpu.obs.logs import get_logger, setup_logging
+
+log = get_logger("kubeai_tpu.finetune")
 
 PEFT_NAMES = {
     "wq": "q_proj", "wk": "k_proj", "wv": "v_proj", "wo": "o_proj",
@@ -279,7 +280,7 @@ def main(argv=None):
              "continue (preempted-job recovery)",
     )
     args = parser.parse_args(argv)
-    logging.basicConfig(level=logging.INFO)
+    setup_logging("finetune")
 
     rev = {v: k for k, v in PEFT_NAMES.items()}
     targets = tuple(rev[t.strip()] for t in args.targets.split(","))

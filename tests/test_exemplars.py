@@ -1,0 +1,146 @@
+"""Exemplar-linked latency histograms: bucket-level trace_id exemplars
+in the registry, OpenMetrics rendering behind KUBEAI_METRICS_EXEMPLARS,
+parse robustness, and the e2e acceptance — a /metrics exemplar's
+trace_id resolves to a live /debug/requests timeline."""
+
+import json
+import re
+import time
+import urllib.request
+
+import pytest
+
+from kubeai_tpu.metrics.registry import (
+    Registry,
+    default_registry,
+    parse_prometheus_text,
+)
+
+
+def test_histogram_keeps_one_exemplar_per_bucket():
+    reg = Registry()
+    h = reg.histogram("x_seconds", "h", buckets=[0.1, 1.0])
+    h.observe(0.05, exemplar="t-first")
+    h.observe(0.07, exemplar="t-latest")  # same bucket: latest wins
+    h.observe(0.5, exemplar="t-mid")
+    h.observe(0.02)  # no exemplar: must not clobber the stored one
+    lines = h.collect(exemplars=True)
+    le01 = next(ln for ln in lines if 'le="0.1"' in ln)
+    le1 = next(ln for ln in lines if 'le="1.0"' in ln and 'le="0.1"' not in ln)
+    assert '# {trace_id="t-latest"} 0.07' in le01
+    assert '# {trace_id="t-mid"} 0.5' in le1
+    # +Inf bucket is cumulative but carries no exemplar of its own here.
+    inf = next(ln for ln in lines if 'le="+Inf"' in ln)
+    assert "#" not in inf
+    # Default collect() renders clean Prometheus text.
+    assert all("#" not in ln or ln.startswith("#") for ln in h.collect())
+
+
+def test_render_gated_by_env(monkeypatch):
+    reg = Registry()
+    h = reg.histogram("y_seconds", "h", buckets=[1.0])
+    h.observe(0.5, exemplar="tt")
+    monkeypatch.delenv("KUBEAI_METRICS_EXEMPLARS", raising=False)
+    assert "# {" not in reg.render()
+    monkeypatch.setenv("KUBEAI_METRICS_EXEMPLARS", "1")
+    assert '# {trace_id="tt"}' in reg.render()
+    # Explicit override beats the env.
+    assert "# {" not in reg.render(exemplars=False)
+
+
+def test_parse_prometheus_text_strips_exemplars(monkeypatch):
+    reg = Registry()
+    h = reg.histogram("z_seconds", "h", buckets=[1.0])
+    h.observe(0.5, exemplar="tt")
+    c = reg.counter("z_total", "h")
+    c.inc(2)
+    monkeypatch.setenv("KUBEAI_METRICS_EXEMPLARS", "1")
+    page = reg.render()
+    parsed = parse_prometheus_text(page)
+    # Without stripping, the exemplar suffix breaks the float parse and
+    # the bucket line is silently DROPPED — the autoscaler's scrapes
+    # would lose exactly the histograms that carry exemplars.
+    buckets = dict(
+        (lbl["le"], v) for lbl, v in parsed["z_seconds_bucket"]
+    )
+    assert buckets["1.0"] == 1.0 and buckets["+Inf"] == 1.0
+    assert parsed["z_total"] == [({}, 2.0)]
+
+
+def test_label_values_in_exemplars_escaped():
+    reg = Registry()
+    h = reg.histogram("esc_seconds", "h", buckets=[1.0])
+    h.observe(0.5, exemplar='bad"id\\x')
+    line = next(ln for ln in h.collect(exemplars=True) if "# {" in ln)
+    assert '\\"' in line
+
+
+# -- e2e: /metrics exemplar -> /debug/requests -------------------------------
+
+
+@pytest.fixture(scope="module")
+def engine_server():
+    from kubeai_tpu.engine.core import build_test_engine
+    from kubeai_tpu.engine.server import EngineServer
+
+    srv = EngineServer(build_test_engine(), "mex", host="127.0.0.1", port=0)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_metrics_exemplar_resolves_to_debug_requests(engine_server, monkeypatch):
+    srv = engine_server
+    trace_id = "ad" * 16
+    rid = "exemplar-e2e-1"
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{srv.port}/v1/completions",
+        data=json.dumps(
+            {"model": "mex", "prompt": "hello", "max_tokens": 4, "temperature": 0}
+        ).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Request-ID": rid,
+            "traceparent": f"00-{trace_id}-{'cd' * 8}-01",
+        },
+    )
+    with urllib.request.urlopen(req, timeout=120) as r:
+        assert r.status == 200
+        r.read()
+
+    monkeypatch.setenv("KUBEAI_METRICS_EXEMPLARS", "1")
+    deadline = time.monotonic() + 10
+    exemplar_ids = set()
+    while time.monotonic() < deadline:
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/metrics", timeout=10
+        ) as r:
+            page = r.read().decode()
+        exemplar_ids = {
+            m.group(2)
+            for m in re.finditer(
+                r'(kubeai_engine_ttft_seconds|kubeai_engine_tpot_seconds|'
+                r'kubeai_request_e2e_seconds)_bucket\{[^}]*\} \S+ '
+                r'# \{trace_id="([0-9a-f]+)"\}',
+                page,
+            )
+        }
+        if trace_id in exemplar_ids:
+            break
+        time.sleep(0.1)
+    assert trace_id in exemplar_ids, exemplar_ids
+
+    # The exemplar's trace_id resolves to a live timeline.
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{srv.port}/debug/requests?id={rid}", timeout=10
+    ) as r:
+        doc = json.loads(r.read())
+    tls = [t for t in doc["requests"] if t["trace_id"] == trace_id]
+    assert tls and tls[0]["request_id"] == rid
+
+    # All three exemplar-linked histograms carry SOME exemplar now.
+    for name in (
+        "kubeai_engine_ttft_seconds",
+        "kubeai_request_e2e_seconds",
+    ):
+        assert re.search(name + r'_bucket\{[^}]*\} \S+ # \{trace_id="', page), name

@@ -57,9 +57,10 @@ def test_restage_of_populated_dest_is_noop(tmp_path):
         assert f.read() == "fake-weights"
 
 
-def test_evict_missing_dest_is_harmless(tmp_path, capsys):
-    loader.evict(str(tmp_path / "absent"))
-    assert "already absent" in capsys.readouterr().out
+def test_evict_missing_dest_is_harmless(tmp_path, caplog):
+    with caplog.at_level("INFO", logger="kubeai_tpu.loader"):
+        loader.evict(str(tmp_path / "absent"))
+    assert any("already absent" in m for m in caplog.messages)
 
 
 def test_evict_removes_dest(tmp_path):
@@ -118,7 +119,8 @@ def test_cli_warm_passes_engine_args_through(tmp_path, monkeypatch):
     assert os.path.isdir(dest)  # staging still happened
 
 
-def test_warm_compile_cache_requires_cache_env(tmp_path, monkeypatch, capsys):
+def test_warm_compile_cache_requires_cache_env(tmp_path, monkeypatch, caplog):
     monkeypatch.delenv("KUBEAI_COMPILE_CACHE", raising=False)
-    assert loader.warm_compile_cache(str(tmp_path)) is None
-    assert "skipping compile warm" in capsys.readouterr().out
+    with caplog.at_level("INFO", logger="kubeai_tpu.loader"):
+        assert loader.warm_compile_cache(str(tmp_path)) is None
+    assert any("skipping compile warm" in m for m in caplog.messages)

@@ -104,6 +104,36 @@ def test_run_benchmark_summary_and_dataset():
         srv.stop()
 
 
+def test_otlp_smoke_export_block_consistent():
+    """Fast --otlp variant: a small run against the counting server with
+    the in-process stub collector; the summary's `export` block must
+    cross-check clean (received >= exported >= 1 per signal, no silent
+    loss)."""
+    srv = _CountingServer()
+    try:
+        summary = run_benchmark(
+            srv.url, "m", conversations=2, turns=1, max_tokens=4, otlp=True,
+        )
+    finally:
+        srv.stop()
+    exp = summary["export"]
+    assert exp is not None
+    assert exp["consistent"], exp
+    assert exp["exported"]["span"] >= 1
+    assert exp["exported"]["log"] >= 1
+    assert exp["exported"]["metric"] >= 1
+    assert exp["received"]["spans"] >= 1
+    # No --otlp: the block is explicitly null, not missing.
+    srv2 = _CountingServer()
+    try:
+        plain = run_benchmark(
+            srv2.url, "m", conversations=1, turns=1, max_tokens=4,
+        )
+    finally:
+        srv2.stop()
+    assert plain["export"] is None
+
+
 def test_request_rate_staggers_arrivals():
     srv = _CountingServer()
     try:

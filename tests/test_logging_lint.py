@@ -1,0 +1,86 @@
+"""Tier-1 lint over the logging surface: serving hot paths must log
+through the structured context adapter (``obs.logs.get_logger``), never
+``logging.getLogger`` or bare ``print()`` — a record emitted outside
+the adapter silently loses its trace/tenant/QoS correlation, the
+/debug/logs ring, and the OTLP log export."""
+
+import ast
+import pathlib
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+PKG = REPO / "kubeai_tpu"
+
+# Modules on the serving hot path: every log record they emit should
+# carry the request context when one is bound.
+HOT_PATHS = [
+    "proxy/handler.py",
+    "proxy/server.py",
+    "engine/core.py",
+    "engine/server.py",
+    "engine/gang.py",
+    "loadbalancer/group.py",
+    "autoscaler/autoscaler.py",
+    "manager.py",
+    "loader.py",
+]
+
+
+def _tree(rel):
+    path = PKG / rel
+    return ast.parse(path.read_text(), filename=str(path))
+
+
+def test_hot_paths_have_no_bare_print():
+    problems = []
+    for rel in HOT_PATHS:
+        for node in ast.walk(_tree(rel)):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id == "print"
+            ):
+                problems.append(f"kubeai_tpu/{rel}:{node.lineno}: bare print()")
+    assert not problems, "\n".join(problems)
+
+
+def test_hot_paths_use_structured_adapter_not_getlogger():
+    """Module loggers on hot paths come from obs.logs.get_logger — a
+    plain logging.getLogger there emits records the context adapter
+    never sees. (logging.getLogger is still fine inside obs/logs.py and
+    obs/otel.py, which implement the seam.)"""
+    problems = []
+    for rel in HOT_PATHS:
+        uses_adapter = False
+        for node in ast.walk(_tree(rel)):
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and fn.attr == "getLogger":
+                if isinstance(fn.value, ast.Name) and fn.value.id == "logging":
+                    problems.append(
+                        f"kubeai_tpu/{rel}:{node.lineno}: logging.getLogger "
+                        "on a hot path (use obs.logs.get_logger)"
+                    )
+            if isinstance(fn, ast.Name) and fn.id == "get_logger":
+                uses_adapter = True
+        if not uses_adapter:
+            problems.append(
+                f"kubeai_tpu/{rel}: no get_logger() call — hot-path module "
+                "lost its structured logger (lint scan broken?)"
+            )
+    assert not problems, "\n".join(problems)
+
+
+def test_hot_paths_never_call_basicconfig():
+    """CLI bootstrap is setup_logging(role) — a stray basicConfig resets
+    handler/formatter state behind the shared bootstrap's back."""
+    problems = []
+    for rel in sorted(p.relative_to(PKG) for p in PKG.rglob("*.py")):
+        for node in ast.walk(_tree(rel)):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "basicConfig"
+            ):
+                problems.append(f"kubeai_tpu/{rel}:{node.lineno}: basicConfig")
+    assert not problems, "\n".join(problems)

@@ -1,0 +1,304 @@
+"""Context-stamped structured logging: contextvar propagation, the
+formatters, the /debug/logs ring, and the e2e acceptance — a failing
+proxied request's WARNING lands in the ring AND in an incident
+snapshot's embedded logs section sharing the triggering trace's
+trace_id."""
+
+import io
+import json
+import logging
+import socket
+import time
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tests.test_proxy_integration import (
+    await_pods,
+    forge_ready,
+    mk_model,
+)
+from tests.test_proxy_integration import stack as stack  # fixture reuse  # noqa: F401
+
+from kubeai_tpu.api import model_types as mt
+from kubeai_tpu.obs.incident_report import render_incident
+from kubeai_tpu.obs.incidents import IncidentRecorder, standard_sources
+from kubeai_tpu.obs.logs import (
+    JsonFormatter,
+    LogRing,
+    TextFormatter,
+    bind_log_context,
+    clear_log_context,
+    current_log_context,
+    get_logger,
+    handle_logs_request,
+    install_log_ring,
+    record_to_entry,
+    set_log_context,
+    setup_logging,
+    trace_extra,
+    uninstall_log_ring,
+)
+
+
+@pytest.fixture(autouse=True)
+def _clean_context():
+    clear_log_context()
+    yield
+    clear_log_context()
+
+
+# -- context semantics -------------------------------------------------------
+
+
+def test_set_replaces_and_drops_empty():
+    set_log_context(trace_id="t1", request_id="r1", tenant="")
+    assert current_log_context() == {"trace_id": "t1", "request_id": "r1"}
+    # REPLACE semantics: a new request's set_log_context must shed the
+    # previous request's fields entirely.
+    set_log_context(trace_id="t2")
+    assert current_log_context() == {"trace_id": "t2"}
+
+
+def test_bind_merges():
+    set_log_context(trace_id="t1")
+    bind_log_context(model="m1", tenant="")
+    assert current_log_context() == {"trace_id": "t1", "model": "m1"}
+
+
+def test_trace_extra_reads_ctx_and_model():
+    class Ctx:
+        trace_id = "ab" * 16
+        span_id = "cd" * 8
+        request_id = "req-9"
+
+    class Tr:
+        ctx = Ctx()
+        model = "m1"
+
+    extra = trace_extra(Tr(), qos_class="batch")
+    assert extra == {
+        "trace_id": "ab" * 16,
+        "span_id": "cd" * 8,
+        "request_id": "req-9",
+        "model": "m1",
+        "qos_class": "batch",
+    }
+    # None-safe: a request submitted without a trace still logs.
+    assert trace_extra(None) == {}
+
+
+def test_adapter_merges_context_with_explicit_extra_winning():
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    lg = logging.getLogger("kubeai_tpu.test_logs.merge")
+    lg.setLevel(logging.INFO)
+    h = Capture()
+    lg.addHandler(h)
+    try:
+        set_log_context(trace_id="ctx-trace", model="ctx-model")
+        get_logger(lg.name).info("hello", extra={"model": "explicit-model"})
+    finally:
+        lg.removeHandler(h)
+    (rec,) = records
+    assert rec.kubeai_ctx == {"trace_id": "ctx-trace", "model": "explicit-model"}
+    entry = record_to_entry(rec)
+    assert entry["message"] == "hello"
+    assert entry["trace_id"] == "ctx-trace"
+    assert entry["model"] == "explicit-model"
+
+
+# -- formatters --------------------------------------------------------------
+
+
+def _mk_record(msg="boom", ctx=None, level=logging.WARNING):
+    rec = logging.LogRecord("kubeai_tpu.x", level, "f.py", 1, msg, None, None)
+    if ctx is not None:
+        rec.kubeai_ctx = ctx
+    return rec
+
+
+def test_json_formatter_emits_context_fields():
+    out = JsonFormatter(role="engine").format(
+        _mk_record(ctx={"trace_id": "t", "qos_class": "interactive"})
+    )
+    doc = json.loads(out)
+    assert doc["message"] == "boom"
+    assert doc["level"] == "WARNING"
+    assert doc["trace_id"] == "t"
+    assert doc["qos_class"] == "interactive"
+    assert doc["role"] == "engine"
+
+
+def test_text_formatter_appends_kv_block():
+    out = TextFormatter(role="proxy").format(
+        _mk_record(ctx={"endpoint": "e1", "trace_id": "t"})
+    )
+    # Canonical fields come first, free-form attributes after.
+    assert out.endswith("[trace_id=t endpoint=e1]")
+    assert "[proxy]" in out
+
+
+def test_setup_logging_json_mode(monkeypatch):
+    monkeypatch.setenv("KUBEAI_LOG_FORMAT", "json")
+    monkeypatch.setenv("KUBEAI_LOG_LEVEL", "debug")
+    root = logging.getLogger()
+    saved_handlers, saved_level = root.handlers[:], root.level
+    buf = io.StringIO()
+    try:
+        setup_logging("loader", stream=buf)
+        assert root.level == logging.DEBUG
+        set_log_context(request_id="r1")
+        get_logger("kubeai_tpu.test_logs.setup").info("staged")
+        doc = json.loads(buf.getvalue().strip().splitlines()[-1])
+        assert doc["message"] == "staged"
+        assert doc["request_id"] == "r1"
+        assert doc["role"] == "loader"
+    finally:
+        root.handlers[:] = saved_handlers
+        root.setLevel(saved_level)
+
+
+# -- the ring + /debug/logs --------------------------------------------------
+
+
+def test_ring_bounded_with_eviction_accounting():
+    from kubeai_tpu.obs.logs import M_LOG_RECORDS
+
+    labels = {"level": "WARNING", "model": "mring"}
+    before = M_LOG_RECORDS.value(labels=labels)
+    ring = LogRing(capacity=3)
+    for i in range(5):
+        ring.emit(_mk_record(msg=f"w{i}", ctx={"model": "mring"}))
+    snap = ring.snapshot()
+    assert [e["message"] for e in snap["records"]] == ["w4", "w3", "w2"]
+    assert snap["total_seen"] == 5
+    assert snap["evicted"] == 2
+    # Every captured record also counted into the dashboard's
+    # error-log-rate metric, labeled by the context's model.
+    assert M_LOG_RECORDS.value(labels=labels) - before == 5
+
+
+def test_ring_filters_level_since_trace():
+    ring = LogRing(capacity=16, level=logging.INFO)
+    ring.emit(_mk_record(msg="old", ctx={"trace_id": "tA"}))
+    ring._records[-1]["ts"] = time.time() - 3600
+    ring.emit(_mk_record(msg="info-b", ctx={"trace_id": "tB"}, level=logging.INFO))
+    ring.emit(_mk_record(msg="err-b", ctx={"request_id": "tB"}, level=logging.ERROR))
+    assert [e["message"] for e in ring.snapshot(level="error")["records"]] == ["err-b"]
+    recent = ring.snapshot(since=time.time() - 60)["records"]
+    assert {e["message"] for e in recent} == {"info-b", "err-b"}
+    # trace= matches trace_id OR request_id.
+    assert {e["message"] for e in ring.snapshot(trace="tB")["records"]} == {
+        "info-b",
+        "err-b",
+    }
+
+
+def test_handle_logs_request_routing_and_clamps():
+    assert handle_logs_request("/debug/other", "") is None
+    ring = install_log_ring()
+    try:
+        ring.emit(_mk_record(msg="visible", ctx={"trace_id": "zz"}))
+        status, ctype, body = handle_logs_request(
+            "/debug/logs", "trace=zz&limit=999999&level=warning"
+        )
+        assert status == 200 and ctype == "application/json"
+        doc = json.loads(body)
+        assert any(e["message"] == "visible" for e in doc["records"])
+    finally:
+        uninstall_log_ring(ring)
+
+
+# -- e2e: ring + incident embedding share the triggering trace_id -----------
+
+
+def _dead_engine():
+    """A 'ready' endpoint nothing listens on: every proxy attempt fails
+    at connect, which is the deterministic WARNING trigger."""
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+
+    class Dead:
+        pass
+
+    d = Dead()
+    d.port = port
+    return d
+
+
+def test_failed_request_warning_correlates_ring_and_incident(stack):  # noqa: F811
+    store, rec, lb, mc, api, engines = stack
+    store.create(mt.KIND_MODEL, mk_model("mdead", min_replicas=1))
+    pods = await_pods(store, "mdead", 1)
+    forge_ready(store, pods[0].meta.name, _dead_engine())
+
+    ring = install_log_ring()
+    incidents = IncidentRecorder(
+        sources=standard_sources(lb, mc), incident_dir="", debounce_seconds=0.0
+    )
+    rid = "logs-e2e-dead-1"
+    trace_id = "ab" * 16
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{api.port}/openai/v1/completions",
+        data=json.dumps({"model": "mdead", "prompt": "hi"}).encode(),
+        headers={
+            "Content-Type": "application/json",
+            "X-Request-ID": rid,
+            "traceparent": f"00-{trace_id}-{'cd' * 8}-01",
+        },
+    )
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=30)
+    assert ei.value.code == 502
+
+    # The terminal-failure WARNING reached the ring stamped with the
+    # request's trace context (contextvar propagation, no explicit
+    # extra at the call site).
+    status, _, body = handle_logs_request("/debug/logs", f"trace={trace_id}")
+    assert status == 200
+    records = json.loads(body)["records"]
+    assert records, "no ring record for the failing trace"
+    hit = records[0]
+    assert hit["trace_id"] == trace_id
+    assert hit["request_id"] == rid
+    assert hit["level"] == "WARNING"
+    assert "failed after" in hit["message"]
+
+    # The same record is embedded in an incident snapshot, and its
+    # trace_id joins the snapshot's own requests section.
+    inc_id = incidents.publish("endpoint_degraded", model="mdead")
+    assert inc_id is not None
+    assert incidents.wait_idle()
+    doc = incidents.get(inc_id)
+    embedded = doc["sections"]["logs"]["records"]
+    match = [e for e in embedded if e.get("trace_id") == trace_id]
+    assert match, "incident snapshot lost the correlated error log"
+    timelines = doc["sections"]["requests"]["requests"]
+    assert any(t.get("trace_id") == trace_id for t in timelines), (
+        "embedded log's trace_id does not resolve to a captured timeline"
+    )
+    # The rendered report interleaves the log line.
+    text = render_incident(doc)
+    assert "failed after" in text
+    assert trace_id in text
+
+    incidents.stop()
+    uninstall_log_ring(ring)
+
+
+def test_debug_logs_served_by_proxy_server(stack):  # noqa: F811
+    _, _, _, _, api, _ = stack
+    with urllib.request.urlopen(
+        f"http://127.0.0.1:{api.port}/debug/logs?limit=5", timeout=10
+    ) as r:
+        doc = json.loads(r.read())
+    assert doc["min_level"] == "WARNING"
+    assert "records" in doc and "capacity" in doc

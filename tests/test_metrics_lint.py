@@ -181,6 +181,29 @@ def test_class_label_written_only_in_qos():
     assert not violations, "\n".join(violations)
 
 
+OTEL = pathlib.Path("kubeai_tpu") / "obs" / "otel.py"
+
+
+def test_otel_metrics_registered_only_in_otel():
+    """Registration rule: every kubeai_otel_* metric lives in the export
+    bridge module — its exported/dropped counters are excluded from the
+    exporter's own metric batches by name, and a registration elsewhere
+    would silently re-enter the batches it was excluded from."""
+    calls = _registration_calls()
+    violations = [
+        f"{path}:{lineno}: {name} registered outside obs/otel.py"
+        for path, lineno, name, _ in calls
+        if name is not None
+        and name.startswith("kubeai_otel_")
+        and path != OTEL
+    ]
+    assert not violations, "\n".join(violations)
+    assert any(
+        name is not None and name.startswith("kubeai_otel_") and path == OTEL
+        for path, _, name, _ in calls
+    ), "otel metrics vanished from obs/otel.py — lint scan broken?"
+
+
 def test_debug_index_matches_doc_endpoint_table():
     """Endpoint-table drift lint: every DEBUG_INDEX entry must have a
     matching `/debug/...` row in docs/observability.md's endpoint table
