@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill kv-bench
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -38,6 +38,14 @@ disagg-bench: ## unified vs disaggregated A/B at mixed prompt lengths -> BENCH_d
 	@# comparison block schema: benchmarks/BENCH_SCHEMA.md (perf_gate.py
 	@# validates it). See docs/disaggregation.md.
 	JAX_PLATFORMS=cpu $(PY) benchmarks/disagg_bench.py --json BENCH_disagg.json
+
+kv-bench: ## KV restore vs replay resume latency at 512/2k/8k-token prefixes -> BENCH_kv_restore.json
+	@# Parks serialized KV pages on a prefill engine, resumes on a cold
+	@# decode engine with and without the page transfer; the resume-gap
+	@# comparison block is validated by perf_gate.py (schema:
+	@# benchmarks/BENCH_SCHEMA.md). See docs/robustness.md "State restore".
+	JAX_PLATFORMS=cpu $(PY) benchmarks/kv_restore_bench.py --json BENCH_kv_restore.json
+	$(PY) benchmarks/perf_gate.py BENCH_kv_restore.json
 
 loadgen: ## tenant-mix load demo: real proxy+engine, weighted tenant population + mid-run heavy hitter -> /debug/tenants conservation + tenant_flood incident
 	@# Exits nonzero unless >=3 tenants appear at /debug/tenants with

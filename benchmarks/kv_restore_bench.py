@@ -1,0 +1,319 @@
+"""Restore vs replay resume latency at growing prefix lengths.
+
+The claim under test (docs/robustness.md "State restore"): resuming a
+preempted/handed-off stream by importing its serialized KV pages costs
+O(transfer + import), while the replay fallback re-prefills the whole
+prefix — O(prefix). Restore should win once the prefix outgrows the
+break-even point, and the gap should widen with prefix length.
+
+Topology: two REAL in-process engines over one tiny CPU model —
+
+- **A** (role=prefill, small handoff budget) serves the first leg of
+  every request, parks its KV pages at the handoff marker, and serves
+  the parked blob over ``GET /v1/kv/<key>``.
+- **B** (role=decode) serves the resume. The *restore* leg carries the
+  ``X-KV-*`` offer headers, so B fetches the blob from A, checksums,
+  imports, and continues. The *replay* leg resumes the same request
+  WITHOUT the offer — exactly the v1 fallback — so B re-prefills from
+  scratch.
+
+Prefix caching is disabled on both engines: the interesting resume is
+the one landing on a node that does NOT hold the prefix (cross-node
+handoff, post-eviction recovery). A warm same-node replay is cheaper
+than this bench's replay leg — that case is governed by the
+break-even routing gate (``KUBEAI_KV_BREAKEVEN_TOKENS``), not by this
+comparison.
+
+The headline metric is the **resume gap**: time from dispatching the
+resume to the first NEW token (the first event past the already-
+delivered count) — the stall a streaming client actually observes.
+Streams from both legs are also compared event-for-event (temperature
+0, fixed seed), so the bench doubles as a correctness check: the
+restore continuation must be indistinguishable from a full replay.
+
+Emits ``BENCH_kv_restore.json`` (schema: benchmarks/BENCH_SCHEMA.md,
+checked by ``benchmarks/perf_gate.py``). Run via ``make kv-bench``.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import statistics
+import sys
+import time
+import urllib.request
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+HANDOFF_TOKENS = 4
+CLIENT_MAX_TOKENS = 24
+WORDS = ("alpha", "bravo", "delta", "echo", "golf", "hotel", "kilo", "lima")
+
+
+def mk_prompt(tag: str, n_tokens: int, encode) -> str:
+    """A deterministic unique prompt of exactly *n_tokens* tokens
+    (byte vocab: overshoot in words, then trim by characters)."""
+    out = [tag]
+    n = len(tag)
+    i = 0
+    while n < n_tokens:
+        w = WORDS[i % len(WORDS)]
+        out.append(w)
+        n += len(w) + 1
+        i += 1
+    s = " ".join(out)
+    over = len(encode(s)) - n_tokens
+    if over > 0:
+        s = s[:-over]
+    elif over < 0:
+        s = s + "x" * (-over)
+    return s
+
+
+def stream(port: int, body: dict, headers: dict | None = None):
+    """One streamed completion. Returns (events, times, offer): events
+    are (text, finish_reason) tuples per data chunk plus a final
+    "[DONE]"; times are monotonic arrival stamps per data chunk; offer
+    is the parked-KV offer if any chunk carried one."""
+    from kubeai_tpu.engine.kvstate import extract_kv_offer
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    events: list = []
+    times: list[float] = []
+    offer = None
+    with urllib.request.urlopen(req, timeout=600) as resp:
+        for raw in resp:
+            raw = raw.strip()
+            if not raw.startswith(b"data:"):
+                continue
+            now = time.monotonic()
+            payload = raw[len(b"data:"):].strip()
+            if payload == b"[DONE]":
+                events.append("[DONE]")
+                break
+            times.append(now)
+            o = extract_kv_offer(raw)
+            if o is not None:
+                offer = o
+            doc = json.loads(payload)
+            ch = doc["choices"][0]
+            events.append((ch.get("text", ""), ch.get("finish_reason")))
+    return events, times, offer
+
+
+def build_pair(max_seq_len: int, buckets: tuple[int, ...]):
+    """(srv_a, srv_b, cleanup): prefill parker A + decode resumer B over
+    the same tiny model weights (same seed => same KV semantics)."""
+    from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+    from kubeai_tpu.engine.sampling import SamplingParams
+    from kubeai_tpu.engine.server import EngineServer
+    from kubeai_tpu.models.base import ModelConfig
+
+    mc = ModelConfig(
+        vocab_size=272, hidden_size=128, intermediate_size=256,
+        num_layers=2, num_heads=4, num_kv_heads=2, dtype="float32",
+        max_position=max_seq_len,
+    )
+    ec = EngineConfig(
+        max_slots=2, max_seq_len=max_seq_len, prefill_buckets=buckets,
+        decode_chunk=2, prefill_group_cap=1,
+        prefix_cache_min=0,  # model the cold-node resume (see docstring)
+    )
+
+    def mk(role, budget):
+        eng = build_test_engine(engine_config=ec, model_config=mc)
+        srv = EngineServer(
+            eng, "kvb", host="127.0.0.1", port=0,
+            role=role, handoff_budget=budget,
+        )
+        srv.start()
+        eng.generate(
+            eng.tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=600,
+        )
+        return srv
+
+    srv_a = mk("prefill", HANDOFF_TOKENS)
+    srv_b = mk("decode", 0)
+
+    def cleanup():
+        srv_a.stop()
+        srv_b.stop()
+
+    return srv_a, srv_b, cleanup
+
+
+def _counter(name: str, labels=None) -> float:
+    from kubeai_tpu.metrics import default_registry
+
+    m = default_registry.get(name)
+    return m.value(labels=labels) if m is not None else 0.0
+
+
+def run_cycle(srv_a, srv_b, prompt: str, seed: int) -> dict:
+    """Park *prompt* on A, resume on B twice — once importing the
+    parked pages, once replaying — and time both resume gaps."""
+    body = {
+        "model": "kvb", "prompt": prompt, "stream": True,
+        "temperature": 0, "seed": seed, "max_tokens": CLIENT_MAX_TOKENS,
+    }
+
+    def park():
+        # The proxy declares handoff intent; the prefill engine then
+        # caps the stream at its budget and parks the KV at the marker.
+        events, _, offer = stream(
+            srv_a.port, body, {"X-Handoff-Planned": "1"}
+        )
+        if offer is None:
+            raise RuntimeError("prefill leg produced no parked-KV offer")
+        # Every data chunk before the offer-carrying marker was (from
+        # the proxy's view) forwarded to the client.
+        forwarded = sum(1 for e in events[:-1] if e != "[DONE]") - 1
+        return offer, forwarded
+
+    def resume(headers):
+        t0 = time.monotonic()
+        events, times, _ = stream(srv_b.port, body, headers)
+        return events, [t - t0 for t in times]
+
+    imp_before = _counter("kubeai_kv_import_total", {"outcome": "ok"})
+    rx_before = _counter("kubeai_kv_transfer_bytes_total", {"direction": "rx"})
+
+    offer, forwarded = park()
+    restore_ev, restore_t = resume({
+        "X-KV-Key": offer["key"],
+        "X-KV-Source": offer["source"],
+        "X-KV-Tokens": str(offer["tokens"]),
+        "X-Resume-Tokens": str(forwarded),
+    })
+    if _counter("kubeai_kv_import_total", {"outcome": "ok"}) != imp_before + 1:
+        raise RuntimeError(
+            "restore leg silently fell back to replay — the timing "
+            "comparison would be meaningless"
+        )
+    replay_ev, replay_t = resume({"X-Resume-Tokens": str(forwarded)})
+    if restore_ev != replay_ev:
+        raise RuntimeError(
+            f"restore and replay streams diverged for seed {seed}: "
+            f"{restore_ev[:6]} vs {replay_ev[:6]}"
+        )
+    return {
+        "blob_bytes": offer["bytes"],
+        "transfer_rx_bytes": _counter(
+            "kubeai_kv_transfer_bytes_total", {"direction": "rx"}
+        ) - rx_before,
+        "restore_ttft_ms": restore_t[0] * 1000,
+        "replay_ttft_ms": replay_t[0] * 1000,
+        "restore_gap_ms": restore_t[forwarded] * 1000,
+        "replay_gap_ms": replay_t[forwarded] * 1000,
+        "events": len(restore_ev),
+    }
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    parser.add_argument("--json", default="BENCH_kv_restore.json")
+    parser.add_argument("--sizes", default="512,2048,8192",
+                        help="comma-separated prefix lengths in tokens")
+    parser.add_argument("--iterations", type=int, default=3)
+    parser.add_argument("--fast", action="store_true",
+                        help="smoke mode: small prefixes, 1 iteration")
+    args = parser.parse_args(argv)
+    if args.fast:
+        # Smallest prefix still past the break-even routing gate (a
+        # shorter one would — correctly — decline the remote fetch).
+        sizes = [512]
+        iterations = 1
+    else:
+        sizes = [int(s) for s in args.sizes.split(",") if s.strip()]
+        iterations = args.iterations
+
+    from kubeai_tpu.engine.kvstate import breakeven_tokens
+
+    # One bucket per size (prompt-exact) keeps warmup honest: the
+    # replay leg pays the same bucket the park leg compiled.
+    buckets = tuple(sorted(sizes))
+    max_seq_len = max(sizes) + CLIENT_MAX_TOKENS + 64
+    srv_a, srv_b, cleanup = build_pair(max_seq_len, buckets)
+    encode = srv_a.engine.tokenizer.encode
+
+    doc: dict = {
+        "bench": "kv_restore",
+        "config": {
+            "sizes": sizes, "iterations": iterations,
+            "handoff_tokens": HANDOFF_TOKENS,
+            "max_tokens": CLIENT_MAX_TOKENS,
+            "breakeven_tokens": breakeven_tokens(),
+            "prefix_cache": "disabled (cold-node resume)",
+        },
+        "sizes_ms": [],
+    }
+    try:
+        for size in sizes:
+            # Untimed cycle: compiles this size's prefill bucket on both
+            # engines and the pow2 import bucket on B.
+            run_cycle(
+                srv_a, srv_b,
+                mk_prompt(f"kvwarm {size}", size - 32, encode), seed=1,
+            )
+            cycles = [
+                run_cycle(
+                    srv_a, srv_b,
+                    mk_prompt(f"kvbench {size} {i}", size - 32, encode),
+                    seed=100 + i,
+                )
+                for i in range(iterations)
+            ]
+            entry = {
+                "prefix_tokens": size - 32 + HANDOFF_TOKENS,
+                "blob_bytes": cycles[0]["blob_bytes"],
+                "transfer_rx_bytes": cycles[0]["transfer_rx_bytes"],
+                "iterations": iterations,
+            }
+            for k in ("restore_gap_ms", "replay_gap_ms",
+                      "restore_ttft_ms", "replay_ttft_ms"):
+                vals = [c[k] for c in cycles]
+                entry[k] = {
+                    "p50": round(statistics.median(vals), 2),
+                    "min": round(min(vals), 2),
+                }
+            entry["speedup"] = round(
+                entry["replay_gap_ms"]["p50"]
+                / max(entry["restore_gap_ms"]["p50"], 1e-9), 2,
+            )
+            doc["sizes_ms"].append(entry)
+            print(json.dumps(entry), file=sys.stderr)
+    finally:
+        cleanup()
+
+    at_2k = [
+        e for e in doc["sizes_ms"]
+        if 1024 <= e["prefix_tokens"] <= 4096
+    ]
+    doc["comparison"] = {
+        "metric": "resume_gap_ms_p50",
+        "streams_identical": True,  # run_cycle raises on divergence
+        "breakeven_tokens": breakeven_tokens(),
+        "restore_wins_at_2k": bool(at_2k) and all(
+            e["restore_gap_ms"]["p50"] < e["replay_gap_ms"]["p50"]
+            for e in at_2k
+        ),
+        "speedup_by_prefix": {
+            str(e["prefix_tokens"]): e["speedup"] for e in doc["sizes_ms"]
+        },
+    }
+    with open(args.json, "w") as f:
+        json.dump(doc, f, indent=1)
+    print(json.dumps(doc["comparison"], indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -89,10 +89,11 @@ def validate(doc: dict) -> list[str]:
         errors.append("value must be > 0 for a successful run")
     num("p50_ttft_ms")
     num("mfu_pct")
-    for key in ("slo", "roofline", "rate_controlled", "disagg"):
+    for key in ("slo", "roofline", "rate_controlled", "disagg", "kv_restore"):
         if key in doc and not isinstance(doc[key], dict):
             errors.append(f"{key!r} must be an object when present")
     errors.extend(validate_disagg_block(doc.get("disagg")))
+    errors.extend(validate_kv_restore_block(doc.get("kv_restore")))
     return errors
 
 
@@ -116,6 +117,52 @@ def validate_disagg_block(block) -> list[str]:
         errors.append(
             "disagg comparison ran zero successful handoffs — the "
             "disaggregated arm never actually disaggregated"
+        )
+    return errors
+
+
+def validate_kv_restore_block(block) -> list[str]:
+    """Schema check for the KV restore-vs-replay comparison
+    (benchmarks/kv_restore_bench.py; documented in BENCH_SCHEMA.md).
+    The block may ride a round's bench line (``kv_restore`` key) or be
+    the ``comparison`` object of a standalone BENCH_kv_restore.json.
+
+    The acceptance bar: restore must beat replay at the ~2k-token
+    prefix, OR the document must carry the break-even threshold the
+    router enforces instead (KUBEAI_KV_BREAKEVEN_TOKENS) — a run that
+    shows neither has no business claiming the restore path pays."""
+    if block is None or not isinstance(block, dict):
+        return []
+    comp = block.get("comparison", block)
+    errors: list[str] = []
+    if not isinstance(comp, dict):
+        return ["kv_restore.comparison must be an object"]
+    if comp.get("streams_identical") is not True:
+        errors.append(
+            "kv_restore comparison streams_identical must be true — a "
+            "restore that changes the stream is a correctness bug, not "
+            "a perf trade"
+        )
+    speed = comp.get("speedup_by_prefix")
+    if not isinstance(speed, dict) or not speed:
+        errors.append(
+            "kv_restore comparison must carry a non-empty speedup_by_prefix"
+        )
+    else:
+        for k, v in speed.items():
+            if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+                errors.append(
+                    f"kv_restore speedup_by_prefix[{k!r}] must be a "
+                    "positive number"
+                )
+    be = comp.get("breakeven_tokens")
+    be_ok = (
+        not isinstance(be, bool) and isinstance(be, (int, float)) and be > 0
+    )
+    if comp.get("restore_wins_at_2k") is not True and not be_ok:
+        errors.append(
+            "kv_restore: restore lost to replay at the 2k prefix and no "
+            "positive breakeven_tokens routing threshold is recorded"
         )
     return errors
 
@@ -247,6 +294,24 @@ def main(argv=None) -> int:
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"perf-gate: cannot load {candidate_path}: {e}", file=sys.stderr)
         return 2
+    if candidate.get("bench") == "kv_restore":
+        # Standalone BENCH_kv_restore.json: schema/claim gate only —
+        # there is no cross-round trajectory to compare against.
+        errors = validate_kv_restore_block(candidate)
+        if errors:
+            print(
+                f"perf-gate: {candidate_path} failed kv_restore validation:",
+                file=sys.stderr,
+            )
+            for e in errors:
+                print(f"  - {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({
+            "candidate": candidate_path,
+            "verdict": "pass (kv_restore standalone: schema + claim ok)",
+            "comparison": candidate.get("comparison"),
+        }, indent=2))
+        return 0
     errors = validate(candidate)
     if errors:
         print(f"perf-gate: {candidate_path} failed schema validation:", file=sys.stderr)

@@ -92,8 +92,8 @@ def _await(cond, timeout=10.0, msg="condition"):
     raise AssertionError(f"timed out awaiting {msg}")
 
 
-def _counter(name: str) -> float:
-    return default_registry.get(name).value()
+def _counter(name: str, labels: dict | None = None) -> float:
+    return default_registry.get(name).value(labels=labels)
 
 
 def sse_shape(port: int, body: dict, headers: dict | None = None,
@@ -253,6 +253,8 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
         # -- phase 2: batch flood seizes the engine, interactive re-runs ---
         pre_before = _counter("kubeai_qos_preemptions_total")
         res_before = _counter("kubeai_qos_resumes_total")
+        exp_before = _counter("kubeai_kv_export_total", {"outcome": "ok"})
+        imp_before = _counter("kubeai_kv_import_total", {"outcome": "ok"})
         flood_shapes: list[list] = []
         flood_errors: list[str] = []
         flood_lock = threading.Lock()
@@ -331,6 +333,86 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
             "streams_byte_identical": len(flood_shapes),
         }
 
+        # -- check 2b: restore path engaged, forced replay is its equal ----
+        # Phase 2 ran with KV restore live: preemptions parked serialized
+        # page state and resumes imported it (docs/robustness.md "State
+        # restore") — prove the path actually engaged, then re-run the
+        # same contention with the import failpoint corrupting every
+        # blob. The corrupted cycle MUST be indistinguishable from the
+        # restore cycle: zero hard failures, byte-identical streams —
+        # the graceful-degradation contract the wire-format checksums
+        # are there to protect.
+        from kubeai_tpu import faults
+
+        kv_exports = _counter("kubeai_kv_export_total", {"outcome": "ok"}) - exp_before
+        kv_imports = _counter("kubeai_kv_import_total", {"outcome": "ok"}) - imp_before
+        assert kv_exports >= 1, (
+            "preemptions happened but no KV state was parked "
+            "(kubeai_kv_export_total{outcome='ok'} never moved)"
+        )
+        assert kv_imports >= 1, (
+            "resumes happened but none imported KV state — the restore "
+            "path never engaged and every resume silently replayed"
+        )
+        cor_before = _counter("kubeai_kv_import_total", {"outcome": "corrupt"})
+        pre2_before = _counter("kubeai_qos_preemptions_total")
+        replay_shapes: list[list] = []
+        replay_errors: list[str] = []
+        replay_stop = threading.Event()
+
+        def replay_flood(i: int):
+            while not replay_stop.is_set():
+                try:
+                    shape = sse_shape(api.port, batch_body, batch_headers)
+                    with flood_lock:
+                        replay_shapes.append(shape)
+                except Exception as e:
+                    replay_errors.append(f"replay flood {i}: {e}")
+                    return
+
+        faults.arm_spec("engine.kv_import", "corrupt")
+        try:
+            replay_threads = [
+                threading.Thread(target=replay_flood, args=(i,), daemon=True)
+                for i in range(2)
+            ]
+            for t in replay_threads:
+                t.start()
+            _await(
+                lambda: _counter("kubeai_engine_active_slots") >= 2,
+                timeout=30, msg="forced-replay flood occupying both slots",
+            )
+            interactive_bench()
+            replay_stop.set()
+            for t in replay_threads:
+                t.join(timeout=180)
+        finally:
+            faults.clear_fault("engine.kv_import")
+        assert not any(t.is_alive() for t in replay_threads), (
+            "forced-replay streams hung"
+        )
+        assert not replay_errors, (
+            f"corrupt-import cycle had HARD failures: {replay_errors}"
+        )
+        assert replay_shapes, "no forced-replay stream completed"
+        bad = [i for i, s in enumerate(replay_shapes) if s != reference]
+        assert not bad, (
+            f"forced-replay streams {bad} diverged from the reference — "
+            "the corrupt-blob fallback is client-visible"
+        )
+        pre2 = _counter("kubeai_qos_preemptions_total") - pre2_before
+        forced = _counter("kubeai_kv_import_total", {"outcome": "corrupt"}) - cor_before
+        assert pre2 >= 1, "forced-replay cycle produced no preemption"
+        assert forced >= 1, (
+            "corrupt failpoint armed but kubeai_kv_import_total"
+            "{outcome='corrupt'} never moved — rejections are not counted"
+        )
+        summary["restore"] = {
+            "kv_exports": int(kv_exports), "kv_imports": int(kv_imports),
+            "forced_replay_streams": len(replay_shapes),
+            "corrupt_rejections": int(forced),
+        }
+
         # -- check 3: surfaces ----------------------------------------------
         with urllib.request.urlopen(
             f"http://127.0.0.1:{api.port}/debug/qos", timeout=10
@@ -377,6 +459,9 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
                 f"{p99_flood * 1000:.0f}ms under {floods}-stream batch flood, "
                 f"{int(preemptions)} preemptions / {int(resumes)} resumes, "
                 f"{len(flood_shapes)} streams byte-identical, "
+                f"restore {int(kv_exports)} exports / {int(kv_imports)} "
+                f"imports, {len(replay_shapes)} forced-replay streams "
+                f"identical ({int(forced)} corrupt blobs rejected), "
                 f"storm incident {storms[0]['id']}"
             )
         return summary

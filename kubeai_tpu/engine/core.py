@@ -33,6 +33,7 @@ from __future__ import annotations
 import queue
 import threading
 import time
+import uuid
 from contextlib import contextmanager
 from dataclasses import dataclass, field
 from functools import partial
@@ -48,7 +49,8 @@ from kubeai_tpu.engine.sampling import (
     apply_penalties,
     sample,
 )
-from kubeai_tpu.faults import fault
+from kubeai_tpu.faults import FaultError, fault
+from kubeai_tpu.engine import kvstate
 from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.models import llama
@@ -197,6 +199,12 @@ class FinishInfo:
     reason: str  # "stop" | "length"
     prompt_tokens: int
     completion_tokens: int
+    # KV restore offer ({key, source, tokens, bytes}) attached when the
+    # finish parked the request's page state (preemption / handoff cap).
+    # The server copies it into the marker chunk as `kubeai_kv` so the
+    # proxy can stamp X-KV-* on the resume dispatch. None = no offer;
+    # the resume regenerates by deterministic replay as before.
+    kv: dict | None = None
 
 
 @dataclass
@@ -236,6 +244,21 @@ class Request:
     # replayable batch streams with no planned handoff, so the proxy's
     # resume cursor can regenerate it with zero dup/zero drop.
     preemptible: bool = False
+    # KV parking intent (engine/kvstate.py): "" = never park;
+    # "preempt" parks at a "preempted" finish (batch victim),
+    # "handoff" parks at the budget-capped "length" finish the server
+    # rewrites to "handoff". Set by the server only for streams whose
+    # resume the proxy can actually consume.
+    park_kv: str = ""
+    # Validated restore state (kvstate.RestoreState) attached by the
+    # server before submit: the scheduler admits by page import instead
+    # of prefill. Cleared on ANY restore failure — the same request then
+    # falls through to the normal prefill/replay path.
+    restore: Any = None
+    # Park-store key the restore state came from (same-replica resume):
+    # the scheduler's restore admission reclaims the matching pinned
+    # pages (skipping the payload upload) and drops the blob once used.
+    restore_key: str = ""
 
 
 @dataclass
@@ -251,6 +274,20 @@ class _Slot:
     # (slot-seconds held, x pages reserved = KV-page-seconds) recorded
     # once at release (obs/tenants.py).
     admitted_at: float = field(default_factory=time.monotonic)
+    # Emitted ("token", ...) events, recorded verbatim for park-eligible
+    # requests (req.park_kv set): a restore re-emits exactly these so the
+    # proxy's suppress-N cursor needs no new alignment rules and the
+    # client stream stays byte-identical. None = not recording.
+    event_log: list | None = None
+    # PRNG reconstruction state for a park snapshot: the decode step
+    # evolves each slot's key once per fused step device-side, but the
+    # device array runs 1-2 in-flight chunks AHEAD of the emitted
+    # stream — so the park recomputes the key as kv_key0 (admission
+    # rebase, or the restored key row) evolved kv_steps times, counted
+    # host-side as steps whose tokens were actually emitted.
+    kv_seed: int = 0
+    kv_steps: int = 0
+    kv_key0: Any = None  # np.uint32 raw key data, set on restore
 
     @property
     def holdback(self) -> int:
@@ -322,6 +359,19 @@ class Engine:
         # LoRA dispatch it can't satisfy would kill it again
         # (crash-loop). Survives _init_device_state like _adapters.
         self._adapter_sources: dict[str, str] = {}
+        # KV-page serialization (engine/kvstate.py): the host-RAM park
+        # store of serialized request state (preempt-park-restore,
+        # handoff page transfer, restore-aware recovery) plus the pinned
+        # device pages it mirrors (paging.PagePool park entries). The
+        # advertised address rides restore offers so a peer replica can
+        # fetch the blob over GET /v1/kv/<key>; EngineServer.start()
+        # stamps it.
+        self.kv_park = kvstate.ParkStore()
+        self.kv_advertise = ""
+        self._kv_fp = kvstate.model_fingerprint(
+            self.model_config, self.cfg.page_size
+        )
+        self._kv_park_sweep_at = 0.0
         # Seconds rank 0 waits for a lost follower to reconnect before
         # falling back to rank termination (the pre-recovery blast
         # radius). <= 0 restores the old terminate-immediately behavior.
@@ -431,12 +481,22 @@ class Engine:
             "allocatable KV pool pages",
             pages_total_fn,
         )
+        pages_parked_fn = lambda: float(self._pool.parked_pages())  # noqa: E731
+        self.m_pages_parked = default_registry.callback_gauge(
+            "kubeai_engine_kv_pages_parked",
+            "device pages pinned by parked (preempted/handed-off) request "
+            "state awaiting restore — reclaimable, excluded from "
+            "kubeai_engine_kv_pages_used so parked state never reads as "
+            "live KV pressure",
+            pages_parked_fn,
+        )
         self._gauge_callbacks = [
             (self.m_hbm_used, hbm_used_fn),
             (self.m_hbm_limit, hbm_limit_fn),
             (self.m_pages_used, pages_used_fn),
             (self.m_pages_cached, pages_cached_fn),
             (self.m_pages_total, pages_total_fn),
+            (self.m_pages_parked, pages_parked_fn),
         ]
         # Saturation / goodput instrumentation derived from the scheduler
         # loop (capacity observability: where is this replica's compute
@@ -872,6 +932,44 @@ class Engine:
                 self._cache,
             )
             shapes += 1
+        if self._kv_enabled():
+            # Restore-path jits (park/import/slotset): left lazy, the
+            # FIRST preemption compiles them mid-flood — on the critical
+            # path of the very interactive request the preemption is
+            # freeing a slot for. Import buckets by pow2 page count;
+            # warm the small buckets that short parked decodes hit (a
+            # longer restore still pays one compile, off the TTFT path
+            # of anyone else's request). All writes land on trash pages
+            # or slot 0's pre-serving zero state.
+            self._ensure_kv_jits()
+            self._kv_evolve_jit(
+                jax.random.key_data(jax.random.key(np.uint32(0))), np.int32(0)
+            )
+            shapes += 1
+            L = self.model_config.num_layers
+            P = self._pool.num_pages
+            for n_pad in (1, 2):
+                idx = (
+                    np.arange(L, dtype=np.int32)[None, :] * P
+                    + np.zeros((n_pad, 1), np.int32)
+                ).reshape(-1)
+                payload = np.zeros(
+                    (n_pad * L, *self._cache["kv"].shape[1:]),
+                    self._cache["kv"].dtype,
+                )
+                cache = dict(self._cache)
+                cache["kv"] = self._kv_import_jit(self._cache["kv"], idx, payload)
+                self._cache = cache
+                shapes += 1
+            (
+                self._tok_hist, self._lengths, self._last_tokens, self._keys,
+            ) = self._kv_slotset_jit(
+                self._tok_hist, self._lengths, self._last_tokens, self._keys,
+                np.int32(0), np.zeros((self._tok_hist.shape[1],), np.int32),
+                np.int32(0), np.int32(0),
+                np.zeros(self._keys.shape[1:], np.uint32),
+            )
+            shapes += 1
         jax.block_until_ready(self._adm_toks)
         dur = time.monotonic() - t0
         self._update_recompile_counter()
@@ -999,6 +1097,9 @@ class Engine:
         tenant: str = "",
         priority: str = "standard",
         preemptible: bool = False,
+        park_kv: str = "",
+        restore: Any = None,
+        restore_key: str = "",
     ) -> Request:
         """Enqueue a request; raises queue.Full when saturated (the proxy
         retries another replica, and the server maps it to 429 +
@@ -1024,10 +1125,15 @@ class Engine:
             raise ValueError(f"adapter {adapter!r} is not loaded")
         if not self._running:
             raise RuntimeError("engine is not running")
+        if not self._kv_enabled():
+            # Gangs and KUBEAI_KV_RESTORE=0 never park or import —
+            # resumes take the deterministic-replay path unchanged.
+            park_kv, restore, restore_key = "", None, ""
         req = Request(
             prompt_ids=prompt_ids, params=params, adapter=adapter,
             deadline=deadline, tenant=tenant,
             priority=priority, preemptible=preemptible,
+            park_kv=park_kv, restore=restore, restore_key=restore_key,
         )
         req.trace = RequestTrace(
             ctx=trace_ctx, component="engine", t0_mono=req.arrival
@@ -1549,6 +1655,7 @@ class Engine:
                 fault("engine.step")
                 self._sweep_deadlines()
                 self._sweep_qos_budgets()
+                self._sweep_kv_park()
                 admitted = self._admit_waiting()
                 dispatched = self._dispatch_chunk() if self._n_active > 0 else None
                 # First-token sync AFTER the dispatch: the chunk reads
@@ -1863,6 +1970,19 @@ class Engine:
                     req, "error", error=f"adapter {req.adapter!r} is not loaded"
                 )
                 continue
+            if req.restore is not None:
+                res = self._admit_restored(req, taken)
+                if isinstance(res, int):
+                    # Restored slots join the next decode dispatch
+                    # directly — no prefill call, no first-token sync.
+                    taken.add(res)
+                    continue
+                if res == "defer":
+                    self._deferred.insert(0, req)
+                    self.m_queue.set(self.queue_depth())
+                    break
+                # res is None: restore failed — req.restore was cleared,
+                # fall through to the replay (prefill) admission below.
             plan = self._plan_admission(req, taken)
             if plan is None and req.priority == "interactive" and self._preempt_one(taken):
                 # Seizing a batch slot released its KV pages too — one
@@ -2197,6 +2317,9 @@ class Engine:
             prompt_len=len(ids),
             budget=budget,
         )
+        slot.kv_seed = int(seed)
+        if req.park_kv:
+            slot.event_log = []
         self._slots[slot_idx] = slot
         self._n_active += 1
         self.m_active.set(self._n_active)
@@ -2450,6 +2573,11 @@ class Engine:
         for k in range(acc.shape[0]):
             for i, slot_obj, epoch in snapshot:
                 a = int(acc[k, i])
+                if self._slots[i] is slot_obj:
+                    # One PRNG key evolution per fused step whose tokens
+                    # reach emission — a park snapshot reconstructs the
+                    # slot key from this count (see _Slot.kv_steps).
+                    slot_obj.kv_steps += 1
                 want_top = (
                     t_ids is not None
                     and self._slots[i] is slot_obj
@@ -2586,14 +2714,20 @@ class Engine:
             if pos != -1:
                 tail = text[slot.delivered_chars : pos]
                 slot.delivered_chars = pos
-                req.out.put(("token", token_id, tail, logprob, top))
+                ev = ("token", token_id, tail, logprob, top)
+                if slot.event_log is not None:
+                    slot.event_log.append(ev)
+                req.out.put(ev)
                 self._free(slot_idx, "stop", flush=False)
                 return
 
         emit_upto = max(len(text) - slot.holdback, slot.delivered_chars)
         delta = text[slot.delivered_chars : emit_upto]
         slot.delivered_chars = emit_upto
-        req.out.put(("token", token_id, delta, logprob, top))
+        ev = ("token", token_id, delta, logprob, top)
+        if slot.event_log is not None:
+            slot.event_log.append(ev)
+        req.out.put(ev)
 
         if slot.generated >= slot.budget:
             self._free(slot_idx, "length")
@@ -2608,7 +2742,17 @@ class Engine:
         # in-flight chunk's stale writes clamp to the trash page.
         self._h_active[slot_idx] = False
         self._record_slot_cost(slot, slot_idx)
-        self._release_slot_pages(slot_idx, register=True)
+        # Park-eligible finishes (a preempted batch victim, a handoff-
+        # capped "length") serialize the slot's KV state BEFORE the page
+        # release: the offer rides the finish marker and the resume
+        # imports instead of replaying. Any park failure falls through
+        # to the plain release + deterministic replay.
+        offer = None
+        want = {"preempt": "preempted", "handoff": "length"}.get(slot.req.park_kv)
+        if deliver and reason == want and self._kv_enabled():
+            offer = self._park_slot(slot_idx, slot)
+        if offer is None:
+            self._release_slot_pages(slot_idx, register=True)
         if deliver:
             if flush:
                 # Deliver held-back chars; detok.text() additionally decodes
@@ -2627,12 +2771,379 @@ class Engine:
                 if tail:
                     slot.req.out.put(("token", -1, tail, None, None))
             slot.req.out.put(
-                ("done", FinishInfo(reason, slot.prompt_len, slot.generated))
+                ("done", FinishInfo(reason, slot.prompt_len, slot.generated, kv=offer))
             )
         self._finish_request(
             slot.req, outcome or ("ok" if deliver else "cancelled"),
             finish_reason=reason, completion_tokens=slot.generated,
         )
+
+    # -- KV park / restore (engine/kvstate.py) -----------------------------
+
+    def _kv_enabled(self) -> bool:
+        """Park/restore is single-host only: a gang's KV pool is sharded
+        across processes (no local gather), and its lockstep contract
+        admits no out-of-band device mutation. Gated globally by
+        KUBEAI_KV_RESTORE."""
+        return (
+            kvstate.restore_enabled()
+            and self._publisher is None
+            and not self._multiproc
+        )
+
+    def _ensure_kv_jits(self) -> None:
+        """Lazy jits for the restore path (compiled on first park/import,
+        never in the hot decode loop).
+
+        - evolve: replays the decode step's per-step PRNG evolution
+          (split -> carry [1]; see decode_fn) from a base key, so the
+          park stores the key AS OF the last emitted token — the device
+          keys array runs in-flight chunks ahead of the emitted stream.
+        - import: scatters blob pages into the donated KV pool; page
+          counts bucket to powers of two (pad rows target the trash
+          page, logical 0 of each layer) to bound compilations.
+        - slotset: one donated update for the restored slot's device
+          row (token history, length, last token, PRNG key)."""
+        if getattr(self, "_kv_evolve_jit", None) is not None:
+            return
+
+        def evolve(key_data, n):
+            k = jax.random.wrap_key_data(key_data)
+            k = jax.lax.fori_loop(
+                0, n, lambda _, kk: jax.random.split(kk, 2)[1], k
+            )
+            return jax.random.key_data(k)
+
+        def imp(kv, idx, payload):
+            return kv.at[idx].set(payload)
+
+        def slotset(tok_hist, lengths, last_tokens, keys, slot, hist_row, n, last, key_row):
+            return (
+                tok_hist.at[slot].set(hist_row),
+                lengths.at[slot].set(n),
+                last_tokens.at[slot].set(last),
+                keys.at[slot].set(key_row),
+            )
+
+        self._kv_evolve_jit = jax.jit(evolve)
+        self._kv_import_jit = jax.jit(imp, donate_argnums=(0,))
+        self._kv_slotset_jit = jax.jit(slotset, donate_argnums=(0, 1, 2, 3))
+
+    def _park_slot(self, slot_idx: int, slot: "_Slot") -> dict | None:
+        """Serialize a finishing slot's KV state into the host park store
+        (wire format: engine/kvstate.py) and pin its history pages
+        device-side for a same-replica fast restore. Returns the offer
+        dict the finish marker carries, or None — any failure or
+        inconsistency leaves the pages for the caller's normal release,
+        and the resume takes deterministic replay."""
+        from kubeai_tpu.engine.paging import pages_for
+
+        history = list(self._kv_history[slot_idx])
+        pending = self._kv_pending[slot_idx]
+        row = self._slot_pages[slot_idx]
+        if (
+            pending is None
+            or not row
+            or len(history) - slot.prompt_len != slot.generated - 1
+            or slot.event_log is None
+            or len(slot.event_log) != slot.generated
+        ):
+            # Mid-flight state not yet settled (e.g. preempted before the
+            # first-token sync): replay is authoritative.
+            return None
+        n_hist = pages_for(len(history), self.cfg.page_size)
+        if n_hist > len(row):
+            return None
+        hist_pages = row[:n_hist]
+        try:
+            self._ensure_kv_jits()
+            if slot.kv_key0 is None:
+                base = jax.random.key_data(
+                    jax.random.fold_in(
+                        jax.random.key(np.uint32(slot.kv_seed)), 1
+                    )
+                )
+            else:
+                base = jnp.asarray(slot.kv_key0)
+            key_row = np.asarray(
+                self._kv_evolve_jit(base, np.int32(slot.kv_steps))
+            )
+            P = self._pool.num_pages
+            L = self.model_config.num_layers
+            idx = (
+                np.arange(L, dtype=np.int32)[None, :] * P
+                + np.asarray(hist_pages, np.int32)[:, None]
+            ).reshape(-1)
+            payload = np.asarray(
+                jax.device_get(
+                    jnp.take(self._cache["kv"], jnp.asarray(idx), axis=0)
+                )
+            ).reshape(n_hist, L, *self._cache["kv"].shape[1:])
+            blob = kvstate.encode_state(
+                model_fp=self._kv_fp,
+                request_fp=kvstate.request_fingerprint(
+                    slot.req.prompt_ids, slot.req.params, slot.req.adapter
+                ),
+                history=history,
+                pending=int(pending),
+                prompt_len=slot.prompt_len,
+                generated=slot.generated,
+                committed_text=slot.committed_text,
+                delivered_chars=slot.delivered_chars,
+                key_data=key_row,
+                events=slot.event_log,
+                adapter=slot.req.adapter,
+                payload=payload,
+            )
+            # Failpoint: error aborts the park (resume replays); corrupt
+            # stores a mangled blob the import's checksums must reject.
+            blob = fault("engine.kv_export", payload=blob)
+        except FaultError:
+            kvstate.M_KV_EXPORT.inc(labels={"outcome": "error"})
+            return None
+        except Exception:
+            log.exception("KV export failed; resume will replay")
+            kvstate.M_KV_EXPORT.inc(labels={"outcome": "error"})
+            return None
+        if self.cfg.prefix_cache_min:
+            # Same content registration the plain release does: follow-up
+            # turns still prefix-hit this request's full pages.
+            self._pool.register_chain(
+                history, self._kv_lora_sig[slot_idx], row
+            )
+        key = uuid.uuid4().hex
+        if all(not self._pool.is_parked(p) for p in hist_pages):
+            # Pin the history pages (the slot's reference transfers to
+            # the park entry); release only the unwritten remainder.
+            self._pool.park(key, hist_pages)
+            self._pool.release(row[n_hist:])
+        else:
+            # Some page already pinned under another key (shared-prefix
+            # claim of parked content): blob-only park, restore uploads.
+            self._pool.release(row)
+        self._slot_pages[slot_idx] = []
+        self._page_table[slot_idx, :] = 0
+        for evicted in self.kv_park.put(key, blob, len(history)):
+            self._pool.drop_park(evicted)
+        kvstate.M_KV_EXPORT.inc(labels={"outcome": "ok"})
+        log.info(
+            "parked KV for slot %d: %d tokens, %d pages, %d bytes (%s)",
+            slot_idx, len(history), n_hist, len(blob), slot.req.park_kv,
+            extra=trace_extra(slot.req.trace),
+        )
+        return {
+            "key": key,
+            "source": self.kv_advertise,
+            "tokens": len(history),
+            "bytes": len(blob),
+        }
+
+    def _admit_restored(self, req: "Request", taken: set[int]) -> int | str | None:
+        """Admit a resume that carries validated KV state (Request.
+        restore): place pages (unpark fast path, else payload upload),
+        rebuild the slot's host mirrors + device row, and re-emit the
+        logged pre-park events so the server's resume suppression sees
+        exactly the replay-path stream. Returns the slot index, "defer"
+        when the pool cannot back prompt+budget yet, or None on any
+        failure — the caller then falls through to replay admission
+        (clearing req.restore), which keeps state-transfer failures
+        invisible to the client and the proxy's breaker."""
+        from kubeai_tpu.engine.paging import pages_for
+
+        state = req.restore
+        t0 = time.monotonic()
+        ps = self.cfg.page_size
+        ids = req.prompt_ids
+        row: list[int] | None = None
+        outcome = "error"
+        try:
+            # Failpoint: chaos tests fail the scheduler-side import even
+            # after the serving thread validated the blob.
+            fault("engine.kv_import")
+            n_hist = pages_for(len(state.history), ps)
+            kv_shape = self._cache["kv"].shape
+            L = self.model_config.num_layers
+            if (
+                state.prompt_len != len(ids)
+                or state.history[: len(ids)] != list(ids)
+                or len(state.history) - len(ids) != state.generated - 1
+                or len(state.events) != state.generated
+            ):
+                raise kvstate.KVFormatError("restore state does not match request")
+            if (
+                state.payload.shape != (n_hist, L, *kv_shape[1:])
+                or state.payload.dtype != self._cache["kv"].dtype
+                or tuple(np.asarray(state.key_data).shape)
+                != tuple(self._keys.shape[1:])
+            ):
+                raise kvstate.KVFormatError("restore payload layout mismatch")
+            usable_tokens = (self._pool.num_pages - 1) * ps
+            budget = max(
+                min(
+                    req.params.max_tokens or self.cfg.default_max_tokens,
+                    self.cfg.max_seq_len - len(ids) - 1,
+                    usable_tokens - len(ids),
+                ),
+                0,
+            )
+            if state.generated >= budget:
+                raise ValueError("parked request has no budget left to resume")
+            # Rebuild the detokenizer by replaying the emitted ids; a
+            # mismatch against the parked cursor means the stream could
+            # not continue byte-identically — reject BEFORE any device
+            # mutation.
+            detok = IncrementalDetokenizer(self.tokenizer)
+            committed = ""
+            for t in state.history[len(ids):] + [state.pending]:
+                committed += detok.push(t)
+            if committed != state.committed_text or not (
+                0 <= state.delivered_chars <= len(committed)
+            ):
+                raise kvstate.KVFormatError("detokenizer replay mismatch")
+
+            n_total = pages_for(len(ids) + budget, ps)
+            pinned = self._pool.unpark(req.restore_key) if req.restore_key else None
+            if pinned is not None and len(pinned) != n_hist:
+                self._pool.release(pinned)
+                pinned = None
+            if n_total - (len(pinned) if pinned is not None else 0) > self._pool.available():
+                if pinned is not None:
+                    self._pool.park(req.restore_key, pinned)  # put back untouched
+                return "defer"
+            slot_idx = next(
+                i for i, s in enumerate(self._slots) if s is None and i not in taken
+            )
+            self._ensure_kv_jits()
+            if pinned is not None:
+                row = pinned + self._pool.allocate(n_total - len(pinned))
+            else:
+                row = self._pool.allocate(n_total)
+                # Upload the blob payload into the fresh pages: pad the
+                # page count to a power of two (bounded compile count);
+                # pad rows scatter into each layer's trash page 0.
+                P = self._pool.num_pages
+                n_pad = 1 << max(0, (n_hist - 1).bit_length())
+                idx = (
+                    np.arange(L, dtype=np.int32)[None, :] * P
+                    + np.asarray(row[:n_hist] + [0] * (n_pad - n_hist), np.int32)[:, None]
+                ).reshape(-1)
+                payload = state.payload
+                if n_pad > n_hist:
+                    payload = np.concatenate(
+                        [payload, np.zeros((n_pad - n_hist, *payload.shape[1:]), payload.dtype)]
+                    )
+                payload = np.ascontiguousarray(
+                    payload.reshape(n_pad * L, *payload.shape[2:])
+                )
+                cache = dict(self._cache)
+                cache["kv"] = self._kv_import_jit(self._cache["kv"], idx, payload)
+                self._cache = cache
+            hist_row = np.zeros((self._tok_hist.shape[1],), np.int32)
+            hist_row[: len(state.history)] = state.history
+            (
+                self._tok_hist, self._lengths, self._last_tokens, self._keys,
+            ) = self._kv_slotset_jit(
+                self._tok_hist, self._lengths, self._last_tokens, self._keys,
+                np.int32(slot_idx), hist_row,
+                np.int32(len(state.history)), np.int32(state.pending),
+                np.asarray(state.key_data, np.uint32),
+            )
+        except kvstate.KVFormatError as e:
+            outcome = "corrupt"
+            log.warning("KV restore rejected (%s); falling back to replay", e)
+        except FaultError:
+            log.warning("KV restore failed (injected); falling back to replay")
+        except Exception as e:
+            log.warning("KV restore failed (%s); falling back to replay", e)
+        else:
+            sp = req.params
+            sig = self._lora_sig(req.adapter)
+            lora_row = (
+                self._adapters.row_for(req.adapter) if self._adapters is not None else 0
+            )
+            slot = _Slot(
+                req=req, detok=detok, prompt_len=len(ids), budget=budget,
+            )
+            slot.committed_text = committed
+            slot.delivered_chars = int(state.delivered_chars)
+            slot.generated = int(state.generated)
+            slot.kv_key0 = np.asarray(state.key_data, np.uint32)
+            if req.park_kv:
+                slot.event_log = list(state.events)
+            self._slots[slot_idx] = slot
+            self._n_active += 1
+            self.m_active.set(self._n_active)
+            self._slot_fresh[slot_idx] = []
+            self._slot_budget[slot_idx] = budget
+            self._slot_pages[slot_idx] = row
+            self._page_table[slot_idx, :] = 0
+            self._page_table[slot_idx, : len(row)] = row
+            self._kv_history[slot_idx] = list(state.history)
+            self._kv_pending[slot_idx] = int(state.pending)
+            self._kv_lora_sig[slot_idx] = sig
+            self._slot_epoch[slot_idx] += 1
+            self._h_active[slot_idx] = True
+            self._h_temp[slot_idx] = sp.temperature
+            self._h_top_p[slot_idx] = sp.top_p
+            self._h_top_k[slot_idx] = sp.top_k
+            self._h_presence[slot_idx] = sp.presence_penalty
+            self._h_freq[slot_idx] = sp.frequency_penalty
+            self._h_gen_start[slot_idx] = len(ids)
+            self._h_bias_ids[slot_idx], self._h_bias_vals[slot_idx] = self._bias_rows(sp)
+            self._h_lora_rows[slot_idx] = lora_row
+            self._adm_mask[slot_idx] = False
+            if self.cfg.prefix_cache_min:
+                self._pool.register_chain(state.history, sig, row)
+            # Re-emit the pre-park events verbatim: the serving thread's
+            # resume suppression consumes them exactly as it would the
+            # replay path's regenerated stream.
+            for ev in state.events:
+                req.out.put(ev)
+            self.kv_park.drop(req.restore_key)
+            dur = time.monotonic() - t0
+            kvstate.M_KV_IMPORT.inc(labels={"outcome": "ok"})
+            kvstate.M_KV_RESTORE_SECONDS.observe(dur, labels={"phase": "import"})
+            self._stall.record_kv_transfer(dur * 1000)
+            record_admitted(
+                req.priority, max(time.monotonic() - req.arrival, 0.0)
+            )
+            if req.trace is not None:
+                req.trace.mark("kv_restore")
+                req.trace.attrs["restored_tokens"] = len(state.history)
+            log.info(
+                "restored KV into slot %d: %d tokens (%s)",
+                slot_idx, len(state.history),
+                "unparked" if pinned is not None else "uploaded",
+                extra=trace_extra(req.trace),
+            )
+            req.restore = None
+            return slot_idx
+        # Shared failure epilogue (the except paths fall through here).
+        kvstate.M_KV_IMPORT.inc(labels={"outcome": outcome})
+        if row:
+            self._pool.release(row)
+        req.restore = None
+        kbuf = self._cache["kv"]
+        if getattr(kbuf, "is_deleted", lambda: False)():
+            # The donated pool was consumed by a failed import jit: no
+            # per-request containment possible — escalate to _loop's
+            # device-state recovery.
+            raise RuntimeError("KV import consumed the donated cache")
+        return None
+
+    def _sweep_kv_park(self) -> None:
+        """Reconcile pinned pages against the blob store (scheduler
+        thread — the pool is scheduler-owned, the store expires on its
+        own TTL/byte caps): any park entry whose blob is gone releases
+        its pages. Throttled; the store is the source of truth."""
+        now = time.monotonic()
+        if now - self._kv_park_sweep_at < 5.0:
+            return
+        self._kv_park_sweep_at = now
+        self.kv_park.sweep()
+        for key in self._pool.parked_keys():
+            if self.kv_park.get(key) is None:
+                self._pool.drop_park(key)
 
 
 @dataclass

@@ -26,6 +26,15 @@ Design:
 - **Eviction**: pages with refcount 0 but registered content stay in
   an LRU "cached" set and satisfy future prefix hits; allocation evicts
   the LRU cached page when the free list is empty.
+- **Parked pages** (engine/kvstate.py): a preempted or handed-off
+  request's pages stay device-resident, pinned by the park entry's own
+  reference, so a same-replica restore skips the host->device payload
+  upload. Parked pages are RECLAIMABLE, not pressure: they count toward
+  available() and are excluded from used() — the occupancy gauge and the
+  decode_occupancy autoscaling signal must not read parked state as live
+  KV demand (the host blob remains authoritative; a reclaimed park
+  degrades to the upload path, and a lost blob degrades to replay).
+  Allocation evicts whole park entries LRU after the cached set is dry.
 
 Thread model: called only from the engine scheduler thread.
 """
@@ -62,18 +71,39 @@ class PagePool:
         # kubeai_engine_kv_cached_evictions_total from the scheduler
         # loop (same poll discipline as the jit-recompile counter).
         self.evictions = 0
+        # Parked page rows (key -> pages, LRU oldest first): each entry
+        # pins ONE reference per page, transferred from the slot that
+        # parked it. park_evictions counts entries reclaimed under
+        # allocation pressure (restore then falls back to the blob).
+        self._parked: "OrderedDict[str, list[int]]" = OrderedDict()
+        self._parked_pages: dict[int, str] = {}
+        self.park_evictions = 0
 
     # -- capacity ----------------------------------------------------------
 
     def available(self) -> int:
-        """Pages allocatable right now (free + evictable)."""
-        return len(self._free) + len(self._cached)
+        """Pages allocatable right now (free + evictable). Parked pages
+        count as available only while the park holds their SOLE
+        reference — a parked page also claimed as a shared prefix by a
+        live slot is real pressure until that slot releases it."""
+        return len(self._free) + len(self._cached) + sum(
+            1 for p in self._parked_pages if self._ref[p] == 1
+        )
 
     def used(self) -> int:
         return self.num_pages - 1 - self.available()
 
     def cached_pages(self) -> int:
         return len(self._cached)
+
+    def parked_pages(self) -> int:
+        return len(self._parked_pages)
+
+    def is_parked(self, page: int) -> bool:
+        return page in self._parked_pages
+
+    def parked_keys(self) -> list[str]:
+        return list(self._parked)
 
     # -- digests -----------------------------------------------------------
 
@@ -130,6 +160,21 @@ class PagePool:
             raise RuntimeError(f"KV pool exhausted: need {n}, have {self.available()}")
         out = []
         for _ in range(n):
+            while not self._free and not self._cached:
+                # Free list and cached set are dry: reclaim the LRU park
+                # entry whole (its pages release into free/cached; pages
+                # a live slot also claims stay referenced). The parked
+                # request's host blob still enables restore-by-upload;
+                # a lost blob degrades to deterministic replay.
+                if not self._parked:
+                    raise RuntimeError(
+                        "KV pool exhausted mid-allocation (available() raced)"
+                    )
+                key, pages = self._parked.popitem(last=False)
+                for p in pages:
+                    self._parked_pages.pop(p, None)
+                self.release(pages)
+                self.park_evictions += 1
             if self._free:
                 page = self._free.pop()
             else:
@@ -175,6 +220,42 @@ class PagePool:
     def refcount(self, page: int) -> int:
         return self._ref[page]
 
+    # -- parking -----------------------------------------------------------
+
+    def park(self, key: str, pages: list[int]) -> None:
+        """Pin *pages* under *key*: the caller's reference (one per
+        page) transfers to the park entry instead of being released, so
+        the page contents survive the slot for a later unpark(). The
+        pages may stay content-registered — registered full pages are
+        read-only by construction, so concurrent prefix claims are safe."""
+        assert key and key not in self._parked, f"duplicate park key {key!r}"
+        for p in pages:
+            assert self._ref[p] > 0, f"parking unreferenced page {p}"
+            assert p not in self._parked_pages, f"page {p} parked twice"
+        self._parked[key] = list(pages)
+        for p in pages:
+            self._parked_pages[p] = key
+
+    def unpark(self, key: str) -> list[int] | None:
+        """Take the parked row back (the park's reference transfers to
+        the caller). None = the entry was reclaimed under pressure or
+        never existed — restore must fall back to the serialized blob."""
+        pages = self._parked.pop(key, None)
+        if pages is None:
+            return None
+        for p in pages:
+            self._parked_pages.pop(p, None)
+        return pages
+
+    def drop_park(self, key: str) -> bool:
+        """Release a park entry (TTL expiry, restore consumed the blob
+        elsewhere). True if the key was parked."""
+        pages = self.unpark(key)
+        if pages is None:
+            return False
+        self.release(pages)
+        return True
+
     # -- release -----------------------------------------------------------
 
     def release(self, pages: list[int]) -> None:
@@ -184,6 +265,10 @@ class PagePool:
             self._ref[page] -= 1
             assert self._ref[page] >= 0, f"double release of page {page}"
             if self._ref[page] == 0:
+                assert page not in self._parked_pages, (
+                    f"page {page} hit refcount 0 while parked — the park's "
+                    "pin was released out from under it"
+                )
                 if page in self._digest_of:
                     self._cached[page] = None
                     self._cached.move_to_end(page)

@@ -32,6 +32,7 @@ import uuid
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
 from kubeai_tpu.engine.core import Engine
+from kubeai_tpu.engine import kvstate
 from kubeai_tpu.engine.sampling import SamplingParams
 from kubeai_tpu.faults import FaultError, fault, handle_faults_request
 from kubeai_tpu.metrics import default_registry
@@ -141,6 +142,14 @@ class EngineServer:
         handler = _make_handler(self)
         self.httpd = ThreadingHTTPServer((host, port), handler)
         self.port = self.httpd.server_port
+        # Address peers use to fetch parked KV (GET /v1/kv/<key>). A
+        # wildcard bind is unreachable as a connect target, so fall back
+        # to loopback (right for in-process test stacks); real pods set
+        # KUBEAI_KV_ADVERTISE to their pod IP.
+        host_adv = os.environ.get("KUBEAI_KV_ADVERTISE", "") or (
+            "127.0.0.1" if host in ("", "0.0.0.0", "::") else host
+        )
+        self.kv_advertise = f"{host_adv}:{self.port}"
         self._thread: threading.Thread | None = None
         # Engine-local telemetry flight recorder (only when this process
         # doesn't already run one — in-process test stacks colocate an
@@ -164,6 +173,10 @@ class EngineServer:
             install_history(self._history)
             self._history_sampler.start()
         if self.engine is not None:
+            # Stamp the engine's parked-KV source address so export
+            # offers point resuming peers back at THIS server's
+            # /v1/kv/<key> route.
+            self.engine.kv_advertise = self.kv_advertise
             self.engine.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -269,6 +282,7 @@ class EngineServer:
                 if a.model is None:
                     raise ValueError("attach args must include --model")
                 engine, name = build_engine_from_args(a, warmup=warmup)
+                engine.kv_advertise = self.kv_advertise
                 engine.start()
                 with self._attach_lock:
                     self.model_name = name
@@ -458,6 +472,24 @@ def _make_handler(srv: EngineServer):
                          "parent": srv.model_name}
                     )
                 self._json(200, {"object": "list", "data": models})
+            elif path.startswith("/v1/kv/"):
+                # Parked-KV fetch (docs/robustness.md "State restore"):
+                # the decode/resume replica pulls a preempted or handed-
+                # off request's serialized pages from the replica that
+                # parked them. A miss (expired, evicted, restarted) is
+                # DEFINITIVE — the caller falls back to replay, so this
+                # route never blocks or retries.
+                key = path[len("/v1/kv/"):]
+                entry = srv.engine.kv_park.get(key) if srv.engine is not None else None
+                if entry is None:
+                    return self._error(404, f"no parked KV under {key!r}")
+                blob = entry.blob
+                kvstate.M_KV_TRANSFER.inc(len(blob), labels={"direction": "tx"})
+                self.send_response(200)
+                self.send_header("Content-Type", "application/octet-stream")
+                self.send_header("Content-Length", str(len(blob)))
+                self.end_headers()
+                self.wfile.write(blob)
             else:
                 self._error(404, f"no route {path}")
 
@@ -826,6 +858,24 @@ def _make_handler(srv: EngineServer):
                 # Budget already spent before admission: refuse rather
                 # than enqueue work whose caller has given up.
                 return self._error(504, "deadline exceeded", "timeout_error")
+            # KV page serialization (docs/robustness.md "State restore"):
+            # single-choice streams that the proxy may preempt or hand
+            # off park their pages at the cut, and a resume that arrives
+            # with the proxy's X-KV-* offer imports that state instead
+            # of replaying the prefix. Both legs are best-effort — any
+            # failure below degrades to the PR-14 replay path on the
+            # same stream, invisible to the client.
+            park_kv = ""
+            restore_state = None
+            restore_key = ""
+            if body.get("stream") and n_choices == 1:
+                if handoff_cap:
+                    park_kv = "handoff"
+                elif preemptible:
+                    park_kv = "preempt"
+                restore_state, restore_key = self._acquire_restore(
+                    prompt_ids, params, adapter, deadline,
+                )
             reqs = []
             try:
                 for i in range(n_choices):
@@ -838,6 +888,8 @@ def _make_handler(srv: EngineServer):
                         prompt_ids, p_i, adapter=adapter, trace_ctx=trace_ctx,
                         deadline=deadline, tenant=tenant,
                         priority=priority, preemptible=preemptible,
+                        park_kv=park_kv, restore=restore_state,
+                        restore_key=restore_key,
                     )
                     if r.trace is not None:
                         r.trace.model = srv.model_name
@@ -886,6 +938,67 @@ def _make_handler(srv: EngineServer):
                     reqs, rid, created, chat, want_logprobs, echo_text, top_n,
                     deadline=deadline,
                 )
+
+        def _acquire_restore(self, prompt_ids, params, adapter, deadline):
+            """Resolve the proxy's X-KV-* resume offer into a decoded
+            RestoreState, or (None, "") to fall back to replay. Every
+            failure here is SOFT — the request still runs, it just
+            regenerates the deterministic prefix instead of importing
+            it — so the client stream is identical either way."""
+            eng = srv.engine
+            key = self.headers.get(kvstate.KV_KEY_HEADER, "")
+            if not key or not eng._kv_enabled():
+                return None, ""
+            source = self.headers.get(kvstate.KV_SOURCE_HEADER, "")
+            try:
+                tokens = int(self.headers.get(kvstate.KV_TOKENS_HEADER, "") or 0)
+            except ValueError:
+                tokens = 0
+            t0 = time.monotonic()
+            entry = eng.kv_park.get(key)
+            blob = entry.blob if entry is not None else None
+            if blob is None:
+                if not source or source == eng.kv_advertise:
+                    # Same-replica resume whose park expired or was
+                    # evicted: a definitive miss.
+                    kvstate.M_KV_IMPORT.inc(labels={"outcome": "miss"})
+                    return None, ""
+                if tokens < kvstate.breakeven_tokens():
+                    # Break-even routing: below this prefix length,
+                    # replaying costs less than a cross-replica fetch +
+                    # device upload (docs/robustness.md has the math).
+                    # Not a miss — a deliberate decision, so no counter.
+                    return None, ""
+                remaining = None if deadline is None else deadline - time.monotonic()
+                blob = kvstate.fetch_blob(source, key, remaining)
+                if blob is None:
+                    kvstate.M_KV_IMPORT.inc(labels={"outcome": "miss"})
+                    return None, ""
+            try:
+                # Serving-thread leg of the import failpoint: `corrupt`
+                # mangles the acquired blob (the checksums below must
+                # catch it), `error` aborts the acquire outright.
+                blob = fault("engine.kv_import", payload=blob)
+                state = kvstate.decode_state(
+                    blob,
+                    expect_model_fp=eng._kv_fp,
+                    expect_request_fp=kvstate.request_fingerprint(
+                        prompt_ids, params, adapter
+                    ),
+                )
+            except FaultError:
+                kvstate.M_KV_IMPORT.inc(labels={"outcome": "error"})
+                return None, ""
+            except kvstate.KVFormatError as e:
+                kvstate.M_KV_IMPORT.inc(labels={"outcome": "corrupt"})
+                log.warning(
+                    "parked KV %s rejected (%s); resuming via replay", key, e
+                )
+                return None, ""
+            kvstate.M_KV_RESTORE_SECONDS.observe(
+                time.monotonic() - t0, labels={"phase": "acquire"}
+            )
+            return state, key
 
         def _decode_safe(self, ids) -> str:
             try:
@@ -1193,6 +1306,14 @@ def _make_handler(srv: EngineServer):
                             "id": rid, "object": obj, "created": created,
                             "model": srv.model_name, "choices": [choice],
                         }
+                        if fin.kv:
+                            # Parked-KV offer riding the marker chunk:
+                            # the proxy captures it (and withholds the
+                            # marker), then stamps X-KV-* on the resume
+                            # so the next replica can import instead of
+                            # replaying. Clients that see it ignore an
+                            # unknown extension field.
+                            payload["kubeai_kv"] = fin.kv
                         send_chunk(json.dumps(payload))
                         if remaining == 0 and include_usage:
                             # OpenAI stream_options semantics: usage

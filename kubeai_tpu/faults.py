@@ -45,6 +45,16 @@ Known sites (grep ``fault(`` for ground truth):
                          the replica listening on PORT only — lets a
                          drill running several replicas in ONE process
                          (shared registry) degrade a single straggler
+    engine.kv_export     KV park serialization (payload: the encoded
+                         blob — ``corrupt`` stores a mangled blob the
+                         import's checksums must reject; ``error``
+                         aborts the park, the resume replays)
+    engine.kv_import     KV restore, fired twice per resume: on the
+                         serving thread with the fetched blob as
+                         payload (``corrupt`` mangles it pre-
+                         validation), and on the scheduler thread
+                         before the device import (``error`` proves
+                         the deepest replay fallback)
     gang.publish         before each gang dispatch broadcast
     gang.follower        each follower recv (follower-drop: dead-peer
                          error exercising reconnect-with-backoff)

@@ -223,6 +223,16 @@ class IncidentRecorder:
             "canary_error": slow,
             "canary_corrupt": slow,
         }
+        # Capture settle: a trigger fires at the instant of damage —
+        # a breaker opens INSIDE the failing attempt, before that
+        # request's trace reaches its terminal outcome a few
+        # milliseconds later on the same thread. Snapshotting
+        # immediately races that settling state and records an
+        # incident whose own triggering request still looks "ok". A
+        # short pause before reading the sources lets the surfaces
+        # reach their terminal values; captures are rare (debounced),
+        # so the delay costs nothing operationally.
+        self.settle = env_float("KUBEAI_INCIDENT_SETTLE", 0.05)
         self._clock = clock
         self._wall = wall
         self._election = election
@@ -403,6 +413,8 @@ class IncidentRecorder:
     # -- capture -----------------------------------------------------------
 
     def _capture(self, event: dict) -> None:
+        if self.settle > 0:
+            time.sleep(self.settle)
         t0 = time.monotonic()
         sections: dict[str, object] = {}
         ok: list[str] = []

@@ -269,7 +269,11 @@ class TokenRateWindow:
 #                 result transfer outlasting the overlapped host work)
 #   emit          detokenize / stop-check / client delivery
 #   prefill       prefill dispatch calls (group and chunked)
-STALL_CAUSES = ("dispatch", "host_overlap", "fetch_wait", "emit", "prefill")
+#   kv_transfer   KV restore admissions (engine/kvstate.py): blob
+#                 validation + page upload + slot rebuild on the
+#                 scheduler thread — the import cost restore pays
+#                 instead of the prefill cost replay would
+STALL_CAUSES = ("dispatch", "host_overlap", "fetch_wait", "emit", "prefill", "kv_transfer")
 
 _INTERPRET = {
     "fetch_wait": (
@@ -286,6 +290,12 @@ _INTERPRET = {
     "dispatch": "host-bound on dispatch: argument upload/broadcast dominates",
     "emit": "host-bound on emission: detokenize/stop-check/delivery dominates",
     "prefill": "prefill-bound: prompt processing dominates the window",
+    "kv_transfer": (
+        "restore-bound: KV page import (blob upload + slot rebuild) "
+        "dominates — resumes are arriving faster than pages can be "
+        "imported; check kubeai_kv_restore_seconds and the break-even "
+        "floor (KUBEAI_KV_BREAKEVEN_TOKENS)"
+    ),
 }
 
 
@@ -307,8 +317,8 @@ class PipelineStallTracker:
         self._counter = reg.counter(
             "kubeai_engine_stall_seconds_total",
             "scheduler step wall time by stall cause (dispatch | "
-            "host_overlap | fetch_wait | emit | prefill) — the aggregate "
-            "behind GET /debug/pipeline",
+            "host_overlap | fetch_wait | emit | prefill | kv_transfer) — "
+            "the aggregate behind GET /debug/pipeline",
         )
 
     def record_decode(
@@ -332,6 +342,9 @@ class PipelineStallTracker:
 
     def record_prefill(self, kind: str, dur_ms: float, now: float | None = None) -> None:
         self._record(kind, {"prefill": max(dur_ms, 0.0)}, now)
+
+    def record_kv_transfer(self, dur_ms: float, now: float | None = None) -> None:
+        self._record("kv_restore", {"kv_transfer": max(dur_ms, 0.0)}, now)
 
     def _record(self, kind: str, causes: dict, now: float | None) -> None:
         now = self._clock() if now is None else now

@@ -21,6 +21,12 @@ import time
 
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.disagg.handoff import is_handoff_event as _is_handoff_event
+from kubeai_tpu.engine.kvstate import (
+    KV_KEY_HEADER,
+    KV_SOURCE_HEADER,
+    KV_TOKENS_HEADER,
+    extract_kv_offer as _extract_kv_offer,
+)
 from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
@@ -312,6 +318,10 @@ class ModelProxy:
                 "x-request-id", "traceparent", "x-request-deadline",
                 "x-handoff-planned", "x-kubeai-tenant",
                 "x-priority", "x-preemptible",
+                # Parked-KV resume offer: proxy-internal, stamped only
+                # on resume dispatches — a client-forged offer could
+                # point an engine at an arbitrary fetch target.
+                "x-kv-key", "x-kv-source", "x-kv-tokens",
             )
         }
         headers["X-Request-ID"] = req.id
@@ -751,13 +761,18 @@ class ModelProxy:
                         if handoff is not None and _is_handoff_event(ev):
                             # The prefill engine's budget-cap marker:
                             # never forwarded — the decode stream owns
-                            # the real finish.
+                            # the real finish. Any parked-KV offer on
+                            # the marker rides the resume dispatch so
+                            # the decode replica can import instead of
+                            # replaying the prefix.
+                            req.kv_offer = _extract_kv_offer(ev)
                             cutover = True
                             break
                         if preemptible and _is_preempt_event(ev):
                             # The engine parked this batch stream to
                             # admit interactive work: never forwarded —
                             # the resumed stream owns the real finish.
+                            req.kv_offer = _extract_kv_offer(ev)
                             preempted = True
                             break
                         if meter is not None and meter.observe_event(ev):
@@ -1013,6 +1028,21 @@ class ModelProxy:
         # already received — the engine logs/records it; the proxy
         # suppresses exactly this many events of the fresh stream.
         hdrs["X-Resume-Tokens"] = str(forwarded)
+        # Parked-KV offer captured at the preempt/handoff marker: stamp
+        # it so the resume target can import the serialized pages
+        # instead of replaying the prefix. Skipped when the offer's
+        # source replica has since been marked dead — its park store
+        # died with it, and the fetch would only burn resume latency.
+        # Restore is strictly best-effort: a stale/missing/corrupt
+        # offer degrades to plain replay engine-side.
+        offer = getattr(req, "kv_offer", None)
+        if offer is not None and offer["source"] not in failed_addrs:
+            hdrs[KV_KEY_HEADER] = offer["key"]
+            hdrs[KV_SOURCE_HEADER] = offer["source"]
+            hdrs[KV_TOKENS_HEADER] = str(offer["tokens"])
+        else:
+            for h in (KV_KEY_HEADER, KV_SOURCE_HEADER, KV_TOKENS_HEADER):
+                hdrs.pop(h, None)
         if rem is not None:
             hdrs["X-Request-Deadline"] = f"{max(rem, 0.001):.3f}"
         t_conn = time.monotonic()

@@ -1,0 +1,638 @@
+"""KV-page serialization suite (docs/robustness.md "State restore").
+
+Three layers:
+- Wire format: encode/decode roundtrip, and a rejection test per
+  validated field — magic, version, both fingerprints, truncation,
+  per-page checksums, plus the failpoint's bitwise corruption. The
+  contract under test: decode_state() NEVER returns silently-wrong
+  state.
+- Host stores: the blob ParkStore (TTL + byte-cap eviction) and the
+  PagePool park pins — including the occupancy regression (parked
+  pages must not read as live KV demand in used(), which feeds the
+  kubeai_engine_kv_pages_used gauge and the decode_occupancy
+  autoscaling signal).
+- End to end against a real engine server: handoff park -> restore
+  resume is byte-identical to an uncontended run; every injected
+  import/export failure (corrupt blob, fetch error, scheduler fault)
+  degrades to deterministic replay with the client stream unchanged
+  and ZERO hard failures; the /v1/kv transfer socket serves peers and
+  404s misses.
+"""
+
+import json
+import threading
+import time
+import types
+import urllib.error
+import urllib.request
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+import pytest
+
+from kubeai_tpu import faults
+from kubeai_tpu.engine import kvstate
+from kubeai_tpu.engine.paging import PagePool
+from kubeai_tpu.engine.sampling import SamplingParams
+from kubeai_tpu.metrics import default_registry
+
+
+def counter(name, labels=None):
+    return default_registry.get(name).value(labels=labels)
+
+
+@pytest.fixture(autouse=True)
+def _clean_faults():
+    faults.clear_all()
+    yield
+    faults.clear_all()
+
+
+# ---------------------------------------------------------------------------
+# Wire format
+
+
+def _mk_state(**over):
+    payload = np.arange(2 * 3 * 4 * 2 * 5, dtype=np.float32).reshape(2, 3, 4, 2, 5)
+    kw = dict(
+        model_fp="m" * 32,
+        request_fp="r" * 32,
+        history=[11, 12, 13, 14, 15],
+        pending=9,
+        prompt_len=3,
+        generated=3,
+        committed_text="abc",
+        delivered_chars=1,
+        key_data=np.array([1, 2], np.uint32),
+        events=[
+            ("token", 7, "a", None, None),
+            ("token", 8, "b", -0.5, None),
+            ("token", 9, "c", None, None),
+        ],
+        adapter=None,
+        payload=payload,
+    )
+    kw.update(over)
+    return kvstate.encode_state(**kw), kw
+
+
+class TestWireFormat:
+    def test_roundtrip_preserves_every_field(self):
+        blob, kw = _mk_state(adapter="lora-a")
+        st = kvstate.decode_state(
+            blob, expect_model_fp="m" * 32, expect_request_fp="r" * 32
+        )
+        assert st.history == kw["history"]
+        assert st.pending == 9
+        assert st.prompt_len == 3
+        assert st.generated == 3
+        assert st.committed_text == "abc"
+        assert st.delivered_chars == 1
+        assert st.adapter == "lora-a"
+        assert st.n_bytes == len(blob)
+        assert st.key_data.dtype == np.uint32
+        assert list(st.key_data) == [1, 2]
+        np.testing.assert_array_equal(st.payload, kw["payload"])
+        # Events come back as the same ("token", id, text, lp, top)
+        # tuples the engine re-puts on the restored request's queue.
+        assert st.events == kw["events"]
+
+    def test_rejects_bad_magic(self):
+        blob, _ = _mk_state()
+        with pytest.raises(kvstate.KVFormatError, match="magic"):
+            kvstate.decode_state(b"XXXX" + blob[4:], expect_model_fp="m" * 32)
+
+    def test_rejects_version_skew(self):
+        blob, _ = _mk_state()
+        skewed = blob[:4] + bytes([kvstate.VERSION + 1]) + blob[5:]
+        with pytest.raises(kvstate.KVFormatError, match="version"):
+            kvstate.decode_state(skewed, expect_model_fp="m" * 32)
+
+    def test_rejects_model_fingerprint_mismatch(self):
+        blob, _ = _mk_state()
+        with pytest.raises(kvstate.KVFormatError, match="fingerprint"):
+            kvstate.decode_state(blob, expect_model_fp="x" * 32)
+
+    def test_rejects_request_fingerprint_mismatch(self):
+        blob, _ = _mk_state()
+        with pytest.raises(kvstate.KVFormatError, match="request fingerprint"):
+            kvstate.decode_state(
+                blob, expect_model_fp="m" * 32, expect_request_fp="x" * 32
+            )
+        # No expectation passed = key-only trust (the local unpark path
+        # where the engine already matched the request): accepted.
+        kvstate.decode_state(blob, expect_model_fp="m" * 32)
+
+    def test_rejects_truncated_payload(self):
+        blob, _ = _mk_state()
+        with pytest.raises(kvstate.KVFormatError, match="bytes"):
+            kvstate.decode_state(blob[:-4], expect_model_fp="m" * 32)
+
+    def test_rejects_flipped_payload_byte(self):
+        blob, _ = _mk_state()
+        mangled = blob[:-1] + bytes([blob[-1] ^ 0xFF])
+        with pytest.raises(kvstate.KVFormatError, match="checksum"):
+            kvstate.decode_state(mangled, expect_model_fp="m" * 32)
+
+    def test_rejects_unparseable_header(self):
+        import struct
+
+        junk = b"not-json"
+        blob = kvstate.MAGIC + struct.pack(">BI", kvstate.VERSION, len(junk)) + junk
+        with pytest.raises(kvstate.KVFormatError, match="header"):
+            kvstate.peek_header(blob)
+
+    def test_corrupt_failpoint_blob_is_rejected(self):
+        """The exact bytes the `corrupt` failpoint produces (bitwise
+        inversion) must fail validation — this is the property the
+        chaos runs lean on."""
+        blob, _ = _mk_state()
+        faults.arm_spec("engine.kv_import", "corrupt")
+        mangled = faults.fault("engine.kv_import", payload=blob)
+        assert mangled != blob
+        with pytest.raises(kvstate.KVFormatError):
+            kvstate.decode_state(mangled, expect_model_fp="m" * 32)
+
+
+class TestFingerprints:
+    def _mc(self, **over):
+        mc = dict(
+            vocab_size=100, hidden_size=64, num_layers=2, num_kv_heads=2,
+            head_dim_=8, dtype="float32", kv_cache_dtype="",
+        )
+        mc.update(over)
+        return types.SimpleNamespace(**mc)
+
+    def test_model_fingerprint_tracks_layout_fields(self):
+        base = kvstate.model_fingerprint(self._mc(), 16)
+        assert kvstate.model_fingerprint(self._mc(), 16) == base
+        assert kvstate.model_fingerprint(self._mc(), 32) != base
+        assert kvstate.model_fingerprint(self._mc(num_kv_heads=4), 16) != base
+        assert kvstate.model_fingerprint(self._mc(dtype="bfloat16"), 16) != base
+
+    def test_request_fingerprint_ignores_max_tokens_only(self):
+        """The handoff cap rewrites max_tokens on the prefill leg; the
+        decode resume carries the client's original. Everything else
+        that shapes generation must still refuse a mismatched blob."""
+        ids = [1, 2, 3]
+        p = SamplingParams(temperature=0.0, max_tokens=8)
+        base = kvstate.request_fingerprint(ids, p, None)
+        import dataclasses
+
+        assert kvstate.request_fingerprint(
+            ids, dataclasses.replace(p, max_tokens=400), None
+        ) == base
+        assert kvstate.request_fingerprint([1, 2], p, None) != base
+        assert kvstate.request_fingerprint(
+            ids, dataclasses.replace(p, temperature=0.7), None
+        ) != base
+        assert kvstate.request_fingerprint(
+            ids, dataclasses.replace(p, seed=3), None
+        ) != base
+        assert kvstate.request_fingerprint(ids, p, "lora-a") != base
+
+
+# ---------------------------------------------------------------------------
+# Park store (host blobs)
+
+
+class TestParkStore:
+    def test_put_get_drop(self):
+        ps = kvstate.ParkStore()
+        assert ps.put("a", b"x" * 10, tokens=5) == []
+        e = ps.get("a")
+        assert e is not None and e.blob == b"x" * 10 and e.tokens == 5
+        assert ps.total_bytes() == 10 and len(ps) == 1
+        assert ps.drop("a") and not ps.drop("a")
+        assert ps.get("a") is None and ps.total_bytes() == 0
+
+    def test_ttl_expiry(self, monkeypatch):
+        monkeypatch.setenv("KUBEAI_KV_PARK_TTL", "0.01")
+        ps = kvstate.ParkStore()
+        ps.put("a", b"x", tokens=1)
+        time.sleep(0.03)
+        assert ps.get("a") is None  # lazy expiry on read
+        ps.put("b", b"y", tokens=1)
+        time.sleep(0.03)
+        assert ps.sweep() == ["b"]  # scheduler-side reconciliation
+        assert ps.total_bytes() == 0
+
+    def test_byte_cap_evicts_lru(self, monkeypatch):
+        monkeypatch.setenv("KUBEAI_KV_PARK_BYTES", "100")
+        ps = kvstate.ParkStore()
+        assert ps.put("a", b"x" * 60, tokens=1) == []
+        assert ps.put("b", b"y" * 60, tokens=1) == ["a"]
+        assert ps.put("c", b"z" * 60, tokens=1) == ["b"]
+        assert ps.get("a") is None and ps.get("c") is not None
+        assert ps.total_bytes() == 60
+
+
+# ---------------------------------------------------------------------------
+# Page pool parking + the occupancy regression
+
+
+class TestPagePoolParking:
+    def test_parked_pages_are_not_occupancy(self):
+        """The satellite bugfix: parked pages are reclaimable, so they
+        must count toward available() and be EXCLUDED from used() — the
+        gauge behind decode_occupancy autoscaling must not read parked
+        state as live KV demand."""
+        pool = PagePool(num_pages=10, page_size=4)  # 9 usable
+        row = pool.allocate(4)
+        assert pool.used() == 4 and pool.available() == 5
+        pool.park("k", row)
+        assert pool.parked_pages() == 4
+        assert pool.used() == 0, "parked pages leaked into occupancy"
+        assert pool.available() == 9
+        assert all(pool.is_parked(p) for p in row)
+        assert pool.parked_keys() == ["k"]
+
+    def test_parked_page_claimed_by_live_slot_is_pressure(self):
+        pool = PagePool(num_pages=10, page_size=2)
+        tokens = [1, 2, 3, 4]
+        row = pool.allocate(2)
+        pool.register_chain(tokens, None, row)
+        pool.park("k", row)
+        assert pool.used() == 0
+        # A live slot prefix-claims the parked content: that page is
+        # now real demand until the claimant releases it.
+        claimed = pool.match_prefix(tokens[:3], None)
+        assert claimed == [row[0]]
+        assert pool.used() == 1 and pool.available() == 8
+        pool.release(claimed)
+        assert pool.used() == 0
+
+    def test_unpark_returns_row_and_drop_releases(self):
+        pool = PagePool(num_pages=10, page_size=4)
+        row = pool.allocate(3)
+        pool.park("k", row)
+        assert pool.unpark("missing") is None
+        got = pool.unpark("k")
+        assert got == row and pool.parked_pages() == 0
+        pool.release(got)
+        assert pool.available() == 9
+        row2 = pool.allocate(2)
+        pool.park("k2", row2)
+        assert pool.drop_park("k2") and not pool.drop_park("k2")
+        assert pool.available() == 9
+
+    def test_allocation_pressure_evicts_whole_park_entries(self):
+        pool = PagePool(num_pages=10, page_size=4)
+        parked = pool.allocate(4)
+        pool.park("victim", parked)
+        live = pool.allocate(5)  # drains the free list
+        got = pool.allocate(3)  # must reclaim the park entry
+        assert len(got) == 3
+        assert pool.park_evictions == 1
+        assert pool.parked_pages() == 0 and pool.unpark("victim") is None
+        pool.release(live + got)
+
+    def test_release_of_parked_pin_asserts(self):
+        pool = PagePool(num_pages=10, page_size=4)
+        row = pool.allocate(1)
+        pool.park("k", row)
+        with pytest.raises(AssertionError, match="parked"):
+            pool.release(row)
+
+
+# ---------------------------------------------------------------------------
+# Offer plumbing
+
+
+class TestOffer:
+    def test_extract_valid_offer(self):
+        chunk = b"data: " + json.dumps({
+            "choices": [{"finish_reason": "preempted"}],
+            "kubeai_kv": {"key": "k1", "source": "10.0.0.2:8000",
+                          "tokens": 37, "bytes": 12000},
+        }).encode()
+        offer = kvstate.extract_kv_offer(chunk)
+        assert offer == {"key": "k1", "source": "10.0.0.2:8000",
+                         "tokens": 37, "bytes": 12000}
+
+    def test_non_offer_events(self):
+        assert kvstate.extract_kv_offer(b"data: [DONE]") is None
+        assert kvstate.extract_kv_offer(b'data: {"choices": []}') is None
+        assert kvstate.extract_kv_offer(b"event: ping") is None
+        assert kvstate.extract_kv_offer(b'data: {"kubeai_kv": "junk"}') is None
+        assert kvstate.extract_kv_offer(
+            b'data: {"kubeai_kv": {"key": "", "source": "a:1"}}'
+        ) is None
+
+    def test_fetch_blob_rejects_bad_source(self):
+        assert kvstate.fetch_blob("", "k") is None
+        assert kvstate.fetch_blob("no-port", "k") is None
+        assert kvstate.fetch_blob("host:notaport", "k") is None
+
+
+# ---------------------------------------------------------------------------
+# End to end: a real prefill-role engine server
+
+
+@pytest.fixture(scope="module")
+def kv_srv():
+    from kubeai_tpu.engine.core import EngineConfig, build_test_engine
+    from kubeai_tpu.engine.server import EngineServer
+
+    eng = build_test_engine(
+        engine_config=EngineConfig(
+            max_slots=2, max_seq_len=512, prefill_buckets=(16, 32),
+            decode_chunk=2, max_queue=8,
+        )
+    )
+    srv = EngineServer(
+        eng, "kv1", host="127.0.0.1", port=0, role="prefill", handoff_budget=6
+    )
+    srv.start()
+    eng.generate(
+        eng.tokenizer.encode("warm"),
+        SamplingParams(temperature=0.0, max_tokens=4),
+        timeout=120,
+    )
+    yield eng, srv
+    srv.stop()
+
+
+BODY = {
+    "model": "kv1", "prompt": "the quick brown fox jumps over the lazy dog",
+    "stream": True, "temperature": 0, "max_tokens": 20, "seed": 7,
+}
+
+
+def stream(port, body, headers=None, timeout=60):
+    """POST a streaming request; returns ((text, finish_reason) events
+    + '[DONE]', kv offers seen). The engine serves resumed streams
+    WHOLE (suppression is the proxy's job), so engine-direct identity
+    checks compare full streams."""
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}/v1/completions",
+        data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+    )
+    with urllib.request.urlopen(req, timeout=timeout) as resp:
+        raw = resp.read()
+    out, offers = [], []
+    for block in raw.replace(b"\r\n", b"\n").split(b"\n\n"):
+        if not block.startswith(b"data: "):
+            continue
+        offer = kvstate.extract_kv_offer(block)
+        if offer is not None:
+            offers.append(offer)
+        payload = block[6:].decode()
+        if payload == "[DONE]":
+            out.append("[DONE]")
+            continue
+        c = json.loads(payload)["choices"][0]
+        out.append((c.get("text"), c.get("finish_reason")))
+    return out, offers
+
+
+def park_via_handoff(port, body):
+    """Run the prefill leg of a planned handoff: returns the capped
+    stream's events (marker included) and the parked-KV offer."""
+    events, offers = stream(port, body, headers={"X-Handoff-Planned": "1"})
+    assert events[-1] == "[DONE]"
+    assert events[-2][1] == "handoff", f"expected handoff marker, got {events[-2]}"
+    return events, (offers[0] if offers else None)
+
+
+def resume_headers(offer, forwarded):
+    return {
+        "X-Resume-Tokens": str(forwarded),
+        "X-KV-Key": offer["key"],
+        "X-KV-Source": offer["source"],
+        "X-KV-Tokens": str(offer["tokens"]),
+    }
+
+
+class TestRestoreE2E:
+    def test_handoff_park_then_restore_is_byte_identical(self, kv_srv):
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        assert reference[-1] == "[DONE]" and len(reference) > 8
+
+        exp_before = counter("kubeai_kv_export_total", {"outcome": "ok"})
+        imp_before = counter("kubeai_kv_import_total", {"outcome": "ok"})
+        leg1, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None, "handoff finish carried no kv offer"
+        assert offer["source"] == srv.kv_advertise
+        assert offer["tokens"] > 0 and offer["bytes"] > 0
+        assert counter("kubeai_kv_export_total", {"outcome": "ok"}) == exp_before + 1
+        assert eng.kv_park.get(offer["key"]) is not None
+        assert eng._pool.parked_pages() > 0
+
+        resumed, _ = stream(
+            srv.port, BODY, headers=resume_headers(offer, len(leg1) - 2)
+        )
+        assert counter("kubeai_kv_import_total", {"outcome": "ok"}) == imp_before + 1
+        # The restored stream re-emits the parked prefix verbatim and
+        # continues: identical to the uncontended run, event for event.
+        assert resumed == reference
+        # Restore consumed the park entry (blob and page pins).
+        assert eng.kv_park.get(offer["key"]) is None
+
+    def test_corrupt_import_degrades_to_identical_replay(self, kv_srv):
+        """ISSUE acceptance: with engine.kv_import=corrupt armed, every
+        resume completes via replay (zero hard failures), each
+        rejection is counted outcome="corrupt", and the stream is
+        indistinguishable from the restore path's."""
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        _, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None
+        cor_before = counter("kubeai_kv_import_total", {"outcome": "corrupt"})
+        ok_before = counter("kubeai_kv_import_total", {"outcome": "ok"})
+        faults.arm_spec("engine.kv_import", "corrupt")
+        try:
+            resumed, _ = stream(
+                srv.port, BODY, headers=resume_headers(offer, 5)
+            )
+        finally:
+            faults.clear_fault("engine.kv_import")
+        assert resumed == reference
+        assert (
+            counter("kubeai_kv_import_total", {"outcome": "corrupt"})
+            == cor_before + 1
+        )
+        assert counter("kubeai_kv_import_total", {"outcome": "ok"}) == ok_before
+        # Replay did not consume the park entry; drop it so later tests
+        # start clean.
+        eng.kv_park.drop(offer["key"])
+
+    def test_import_error_fault_degrades_to_identical_replay(self, kv_srv):
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        _, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None
+        err_before = counter("kubeai_kv_import_total", {"outcome": "error"})
+        faults.arm_spec("engine.kv_import", "error:1")
+        try:
+            resumed, _ = stream(
+                srv.port, BODY, headers=resume_headers(offer, 5)
+            )
+        finally:
+            faults.clear_fault("engine.kv_import")
+        assert resumed == reference
+        assert (
+            counter("kubeai_kv_import_total", {"outcome": "error"})
+            == err_before + 1
+        )
+        eng.kv_park.drop(offer["key"])
+
+    def test_export_error_means_no_offer_and_plain_replay(self, kv_srv):
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        err_before = counter("kubeai_kv_export_total", {"outcome": "error"})
+        faults.arm_spec("engine.kv_export", "error:1")
+        try:
+            leg1, offers = stream(
+                srv.port, BODY, headers={"X-Handoff-Planned": "1"}
+            )
+        finally:
+            faults.clear_fault("engine.kv_export")
+        assert leg1[-2][1] == "handoff"
+        assert offers == [], "failed export must not advertise an offer"
+        assert (
+            counter("kubeai_kv_export_total", {"outcome": "error"})
+            == err_before + 1
+        )
+        # The resume falls back to the PR-14 cursor replay and still
+        # reproduces the uncontended stream.
+        resumed, _ = stream(srv.port, BODY, headers={"X-Resume-Tokens": "5"})
+        assert resumed == reference
+
+    def test_missing_park_entry_counts_miss_and_replays(self, kv_srv):
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        _, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None
+        eng.kv_park.drop(offer["key"])  # simulate TTL/eviction loss
+        miss_before = counter("kubeai_kv_import_total", {"outcome": "miss"})
+        resumed, _ = stream(srv.port, BODY, headers=resume_headers(offer, 5))
+        assert resumed == reference
+        assert (
+            counter("kubeai_kv_import_total", {"outcome": "miss"})
+            == miss_before + 1
+        )
+
+    def test_remote_fetch_over_transfer_socket(self, kv_srv, monkeypatch):
+        """Prefill->decode page streaming across replicas: the resume
+        lands with a source that is NOT this server, the blob travels
+        over GET /v1/kv/<key>, and the import proceeds from the upload
+        path (no local page pins for a foreign key)."""
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        _, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None
+        blob = eng.kv_park.get(offer["key"]).blob
+
+        class _KVHandler(BaseHTTPRequestHandler):
+            def do_GET(self):
+                if self.path == "/v1/kv/remote-key-1":
+                    self.send_response(200)
+                    self.send_header("Content-Length", str(len(blob)))
+                    self.end_headers()
+                    self.wfile.write(blob)
+                else:
+                    self.send_error(404)
+
+            def log_message(self, *a):
+                pass
+
+        peer = ThreadingHTTPServer(("127.0.0.1", 0), _KVHandler)
+        t = threading.Thread(target=peer.serve_forever, daemon=True)
+        t.start()
+        try:
+            rx_before = counter(
+                "kubeai_kv_transfer_bytes_total", {"direction": "rx"}
+            )
+            ok_before = counter("kubeai_kv_import_total", {"outcome": "ok"})
+            hdrs = resume_headers(
+                {"key": "remote-key-1",
+                 "source": f"127.0.0.1:{peer.server_port}",
+                 "tokens": max(offer["tokens"], 10_000)},
+                5,
+            )
+            resumed, _ = stream(srv.port, BODY, headers=hdrs)
+            assert resumed == reference
+            assert (
+                counter("kubeai_kv_import_total", {"outcome": "ok"})
+                == ok_before + 1
+            )
+            assert (
+                counter("kubeai_kv_transfer_bytes_total", {"direction": "rx"})
+                == rx_before + len(blob)
+            )
+        finally:
+            peer.shutdown()
+            peer.server_close()
+            eng.kv_park.drop(offer["key"])
+
+    def test_breakeven_gate_skips_short_remote_fetch(self, kv_srv):
+        """Below KUBEAI_KV_BREAKEVEN_TOKENS the remote fetch is not
+        even attempted — replay is the cheaper resume. The offer points
+        at an unroutable source; if the gate failed, the fetch retries
+        would stall the request visibly."""
+        eng, srv = kv_srv
+        reference, _ = stream(srv.port, BODY)
+        t0 = time.monotonic()
+        resumed, _ = stream(
+            srv.port, BODY,
+            headers=resume_headers(
+                {"key": "nope", "source": "203.0.113.1:9", "tokens": 1}, 5
+            ),
+        )
+        assert resumed == reference
+        assert time.monotonic() - t0 < kvstate.fetch_timeout()
+
+    def test_transfer_route_404s_unknown_key(self, kv_srv):
+        eng, srv = kv_srv
+        with pytest.raises(urllib.error.HTTPError) as exc:
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/v1/kv/absent", timeout=10
+            )
+        assert exc.value.code == 404
+
+    def test_transfer_route_serves_parked_blob(self, kv_srv):
+        eng, srv = kv_srv
+        _, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None
+        tx_before = counter(
+            "kubeai_kv_transfer_bytes_total", {"direction": "tx"}
+        )
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{srv.port}/v1/kv/{offer['key']}", timeout=10
+        ) as r:
+            blob = r.read()
+        assert blob == eng.kv_park.get(offer["key"]).blob
+        assert blob[:4] == kvstate.MAGIC
+        assert (
+            counter("kubeai_kv_transfer_bytes_total", {"direction": "tx"})
+            == tx_before + len(blob)
+        )
+        eng.kv_park.drop(offer["key"])
+
+    def test_restore_disabled_kills_offers(self, kv_srv, monkeypatch):
+        eng, srv = kv_srv
+        monkeypatch.setenv("KUBEAI_KV_RESTORE", "0")
+        leg1, offers = stream(
+            srv.port, BODY, headers={"X-Handoff-Planned": "1"}
+        )
+        assert leg1[-2][1] == "handoff"
+        assert offers == []
+
+    def test_parked_state_visible_in_gauges(self, kv_srv):
+        """Engine-level occupancy regression: a park keeps pages pinned
+        (parked gauge > 0) but pages_used — the decode_occupancy input
+        — must not include them once the slot is gone."""
+        eng, srv = kv_srv
+        _, offer = park_via_handoff(srv.port, BODY)
+        assert offer is not None
+        pool = eng._pool
+        parked = pool.parked_pages()
+        assert parked > 0
+        # All slots are free now, so every non-parked page is free or
+        # cached: occupancy must read ZERO, not the park pin count.
+        assert pool.used() == 0
+        assert pool.available() == pool.num_pages - 1
+        eng.kv_park.drop(offer["key"])
