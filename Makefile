@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill kv-bench
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill kv-bench forecast-drill
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -62,6 +62,21 @@ qos-drill: ## QoS isolation proof: batch flood vs interactive p99 TTFT, preempti
 	@# counters report it. Summary under build/qos-drill/. The fast
 	@# variant runs in tier-1 (tests/test_qos.py). See docs/qos.md.
 	JAX_PLATFORMS=cpu $(PY) benchmarks/qos_drill.py
+
+forecast-drill: ## predictive-scaling proof: seeded diurnal history, forecast-ahead scale-up beats the ramp by one cold-start lead -> BENCH_forecast.json
+	@# Replays a compressed diurnal day against a real operator stack.
+	@# Exits nonzero unless the source=forecast scale-up decision lands
+	@# >= one MEASURED cold-start lead before the ramp peak, the A/B
+	@# ramp p99 TTFT improves over reactive-only, the off-schedule
+	@# flood raises traffic_anomaly (forecast section rendered in the
+	@# postmortem), and the poisoned model holds the reactive floor
+	@# while MAPE auto-disable engages. Summary under
+	@# build/forecast-drill/; comparison block validated by perf_gate.py
+	@# (schema: benchmarks/BENCH_SCHEMA.md). The fast variant runs in
+	@# tier-1 (tests/test_forecast.py). See docs/autoscaling.md
+	@# "Predictive scaling".
+	JAX_PLATFORMS=cpu $(PY) benchmarks/forecast_drill.py --json BENCH_forecast.json
+	$(PY) benchmarks/perf_gate.py BENCH_forecast.json
 
 gray-drill: ## gray-failure proof: 1-of-3 real replicas turns straggler, scorer soft-ejects it, p99 contained, batch tier still served
 	@# Exits nonzero unless the per-token-slowed replica is soft-ejected

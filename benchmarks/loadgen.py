@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import math
 import os
 import random
 import statistics
@@ -489,6 +490,47 @@ class OtlpSmoke:
         }
 
 
+# ---------------------------------------------------------------------------
+# Arrival-rate shaping (--pattern): deterministic curves over one period
+# so drills and benchmarks can replay realistic traffic instead of a
+# flat Poisson stream. The same multiplier function is what the
+# forecast drill seeds prior "days" of history with — the generator and
+# the replayer cannot drift apart.
+
+PATTERN_PHASES: dict[str, tuple[tuple[str, float, float], ...]] = {
+    "diurnal": (
+        ("trough", 0.0, 0.25), ("ramp", 0.25, 0.5),
+        ("peak", 0.5, 0.75), ("decay", 0.75, 1.0),
+    ),
+    "spike": (("pre", 0.0, 0.45), ("spike", 0.45, 0.55), ("post", 0.55, 1.0)),
+    "step": (("low", 0.0, 0.5), ("high", 0.5, 1.0)),
+}
+
+
+def pattern_multiplier(pattern: str, frac: float) -> float:
+    """Arrival-rate multiplier at *frac* (position in [0,1) within one
+    period) for a named pattern. Deterministic and dependency-free:
+    diurnal is a sinusoid with its minimum mid-trough (frac 0.125) and
+    maximum mid-peak (frac 0.625); spike is a 4x burst in the middle
+    tenth; step halves then 1.5x's the base."""
+    frac = frac % 1.0
+    if pattern == "diurnal":
+        return 1.0 + 0.75 * math.sin(2 * math.pi * (frac - 0.375))
+    if pattern == "spike":
+        return 4.0 if 0.45 <= frac < 0.55 else 1.0
+    if pattern == "step":
+        return 0.5 if frac < 0.5 else 1.5
+    raise ValueError(f"unknown pattern {pattern!r} (want {sorted(PATTERN_PHASES)})")
+
+
+def pattern_phase(pattern: str, frac: float) -> str:
+    frac = frac % 1.0
+    for name, lo, hi in PATTERN_PHASES[pattern]:
+        if lo <= frac < hi:
+            return name
+    return PATTERN_PHASES[pattern][-1][0]
+
+
 def run_benchmark(
     base_url: str,
     model: str,
@@ -514,6 +556,8 @@ def run_benchmark(
     flood_conversations: int = 0,
     priority_mix: list[tuple[str, float]] | None = None,
     otlp: bool = False,
+    pattern: str | None = None,
+    pattern_period_s: float = 60.0,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy). With
@@ -633,14 +677,30 @@ def run_benchmark(
                 flood_spawned.set()
 
         threading.Thread(target=launch_flood, daemon=True, name="loadgen-flood").start()
+    if pattern and pattern not in PATTERN_PHASES:
+        raise ValueError(
+            f"unknown pattern {pattern!r} (want {sorted(PATTERN_PHASES)})"
+        )
+    if pattern and request_rate <= 0:
+        raise ValueError("--pattern requires a positive --request-rate to shape")
+    phase_arrivals: dict[str, int] = {}
     t0 = time.monotonic()
     for i, t in enumerate(threads):
+        if pattern:
+            frac = ((time.monotonic() - t0) / pattern_period_s) % 1.0
+            ph = pattern_phase(pattern, frac)
+            phase_arrivals[ph] = phase_arrivals.get(ph, 0) + 1
         t.start()
         if request_rate > 0 and i < len(threads) - 1:
             # Open-loop Poisson arrivals (exponential inter-arrival),
             # like the reference's benchmark_serving --request-rate. No
             # sleep after the last start — it would inflate elapsed.
-            time.sleep(rng.expovariate(request_rate))
+            # With --pattern the instantaneous rate follows the curve
+            # (an inhomogeneous Poisson process by rate re-sampling).
+            rate = request_rate
+            if pattern:
+                rate *= pattern_multiplier(pattern, frac)
+            time.sleep(rng.expovariate(max(rate, 1e-6)))
     for t in threads:
         t.join()
     if flood_tenant and flood_at is not None:
@@ -802,9 +862,34 @@ def run_benchmark(
                     if qos_after.get(cls) or qos_before.get(cls)
                 }
 
+    # Per-phase arrival accounting for shaped runs: which part of the
+    # curve each conversation landed in, plus the rate the curve
+    # targeted mid-phase — the drill's ground truth for "the ramp
+    # peaked at X".
+    pattern_block = None
+    if pattern:
+        pattern_block = {
+            "name": pattern,
+            "period_s": pattern_period_s,
+            "base_rate_rps": request_rate,
+            "phases": [
+                {
+                    "name": name,
+                    "window_frac": [lo, hi],
+                    "target_rate_rps": round(
+                        request_rate
+                        * pattern_multiplier(pattern, (lo + hi) / 2), 3
+                    ),
+                    "arrivals": phase_arrivals.get(name, 0),
+                }
+                for name, lo, hi in PATTERN_PHASES[pattern]
+            ],
+        }
+
     return {
         "requests": n_requests,
         "failures": failures,
+        "pattern": pattern_block,
         # OTLP export smoke (--otlp): the stub collector's received
         # counts cross-checked against the exporter's counter deltas.
         "export": smoke.finish() if smoke else None,
@@ -921,6 +1006,18 @@ def main():
         help="flood size (default 2x --conversations)",
     )
     parser.add_argument(
+        "--pattern", default=None, choices=sorted(PATTERN_PHASES),
+        help="shape arrivals over --pattern-period instead of a flat "
+             "Poisson stream: diurnal (sinusoid, trough->ramp->peak->"
+             "decay), spike (4x burst mid-period), step (0.5x then "
+             "1.5x); deterministic under --seed; the summary gains a "
+             "per-phase arrival block; requires --request-rate",
+    )
+    parser.add_argument(
+        "--pattern-period", type=float, default=60.0, metavar="S",
+        help="seconds per pattern period (a compressed 'day')",
+    )
+    parser.add_argument(
         "--otlp", action="store_true",
         help="export-bridge smoke: run an in-process OTLP stub collector "
              "and a client-side exporter for the duration of the run; "
@@ -952,6 +1049,9 @@ def main():
         parser.error("--flood-tenant requires --flood-at (when the flood arrives)")
     if args.flood_at is not None and not args.flood_tenant:
         parser.error("--flood-at requires --flood-tenant")
+    if args.pattern and args.request_rate <= 0:
+        parser.error("--pattern requires a positive --request-rate (the "
+                     "curve shapes the Poisson arrival rate)")
 
     dataset = load_sharegpt(args.dataset) if args.dataset else None
     summary = run_benchmark(
@@ -975,6 +1075,8 @@ def main():
             parse_priority_mix(args.priority_mix) if args.priority_mix else None
         ),
         otlp=args.otlp,
+        pattern=args.pattern,
+        pattern_period_s=args.pattern_period,
     )
     print(json.dumps(summary, indent=1))
 

@@ -89,11 +89,12 @@ def validate(doc: dict) -> list[str]:
         errors.append("value must be > 0 for a successful run")
     num("p50_ttft_ms")
     num("mfu_pct")
-    for key in ("slo", "roofline", "rate_controlled", "disagg", "kv_restore"):
+    for key in ("slo", "roofline", "rate_controlled", "disagg", "kv_restore", "forecast"):
         if key in doc and not isinstance(doc[key], dict):
             errors.append(f"{key!r} must be an object when present")
     errors.extend(validate_disagg_block(doc.get("disagg")))
     errors.extend(validate_kv_restore_block(doc.get("kv_restore")))
+    errors.extend(validate_forecast_block(doc.get("forecast")))
     return errors
 
 
@@ -164,6 +165,54 @@ def validate_kv_restore_block(block) -> list[str]:
             "kv_restore: restore lost to replay at the 2k prefix and no "
             "positive breakeven_tokens routing threshold is recorded"
         )
+    return errors
+
+
+def validate_forecast_block(block) -> list[str]:
+    """Schema check for the predictive-scaling comparison
+    (benchmarks/forecast_drill.py; documented in BENCH_SCHEMA.md). The
+    block may ride a round's bench line (``forecast`` key) or be the
+    ``comparison`` object of a standalone BENCH_forecast.json.
+
+    The acceptance bar: the forecast-fused scale-up decision must land
+    at least one cold-start lead BEFORE the ramp peak, the A/B ramp p99
+    TTFT must improve over reactive-only, and the guardrail claims
+    (reactive floor held, poisoned forecast auto-disabled, anomaly
+    incident landed) must all be true — a run missing any of them has
+    no business claiming predictive scaling pays."""
+    if block is None or not isinstance(block, dict):
+        return []
+    comp = block.get("comparison", block)
+    errors: list[str] = []
+    if not isinstance(comp, dict):
+        return ["forecast.comparison must be an object"]
+    nums = {}
+    for key in ("lead_seconds", "decision_lead_seconds",
+                "ramp_p99_ttft_ms_reactive", "ramp_p99_ttft_ms_forecast"):
+        v = comp.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+            errors.append(f"forecast comparison {key!r} must be a positive number")
+        else:
+            nums[key] = v
+    if ("lead_seconds" in nums and "decision_lead_seconds" in nums
+            and nums["decision_lead_seconds"] < nums["lead_seconds"]):
+        errors.append(
+            "forecast: the scale-up decision landed inside the cold-start "
+            "lead — capacity arrives after the peak, the forecast bought "
+            "nothing"
+        )
+    if ("ramp_p99_ttft_ms_reactive" in nums and "ramp_p99_ttft_ms_forecast" in nums
+            and nums["ramp_p99_ttft_ms_forecast"] >= nums["ramp_p99_ttft_ms_reactive"]):
+        errors.append(
+            "forecast: ramp p99 TTFT did not improve over the "
+            "reactive-only arm"
+        )
+    for key in ("floor_respected", "auto_disable_engaged", "anomaly_incident"):
+        if comp.get(key) is not True:
+            errors.append(
+                f"forecast comparison {key!r} must be true — the guardrail "
+                "claims are part of the acceptance bar"
+            )
     return errors
 
 
@@ -309,6 +358,24 @@ def main(argv=None) -> int:
         print(json.dumps({
             "candidate": candidate_path,
             "verdict": "pass (kv_restore standalone: schema + claim ok)",
+            "comparison": candidate.get("comparison"),
+        }, indent=2))
+        return 0
+    if candidate.get("bench") == "forecast":
+        # Standalone BENCH_forecast.json: schema/claim gate only — the
+        # A/B lives inside the document, not across rounds.
+        errors = validate_forecast_block(candidate)
+        if errors:
+            print(
+                f"perf-gate: {candidate_path} failed forecast validation:",
+                file=sys.stderr,
+            )
+            for e in errors:
+                print(f"  - {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({
+            "candidate": candidate_path,
+            "verdict": "pass (forecast standalone: schema + claim ok)",
             "comparison": candidate.get("comparison"),
         }, indent=2))
         return 0

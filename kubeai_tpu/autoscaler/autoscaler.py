@@ -161,6 +161,8 @@ class Autoscaler:
         fleet=None,
         decision_capacity: int = 512,
         clock=time.time,
+        forecaster=None,
+        parked_pool=None,
     ):
         self.store = store
         self.model_client = model_client
@@ -180,6 +182,12 @@ class Autoscaler:
         if engine_queue_scrape is None and fleet is not None:
             engine_queue_scrape = fleet.scrape_model
         self.engine_queue_scrape = engine_queue_scrape
+        # Predictive signal (obs/forecast.py): when wired, the unified
+        # tick fuses desired = max(reactive, forecast-at-lead-time). The
+        # forecast may only RAISE the reactive floor; the parked pool is
+        # asked to pre-warm ahead of predicted ramps.
+        self.forecaster = forecaster
+        self.parked_pool = parked_pool
         # Per-tick decision audit (GET /debug/autoscaler); *clock* is the
         # wall-clock source for record timestamps, injectable in tests.
         self.decisions = DecisionLog(decision_capacity)
@@ -311,7 +319,10 @@ class Autoscaler:
             avg.next(signal)
             mean = avg.calculate()
             target = max(model.spec.target_requests, 1)
-            desired = math.ceil(mean / target)
+            reactive_desired = math.ceil(mean / target)
+            desired, source, forecast_detail = self._fuse_forecast(
+                name, reactive_desired, target, signal
+            )
             outcome = self.model_client.scale(name, desired)
             if not isinstance(outcome, dict):
                 # A subclassed/stubbed client that doesn't return the
@@ -330,6 +341,9 @@ class Autoscaler:
                 "window_avg": round(mean, 3),
                 "target_requests": target,
                 "desired": desired,
+                "reactive_desired": reactive_desired,
+                "source": source,
+                "forecast": forecast_detail,
                 "clamped": outcome.get("clamped"),
                 "current": outcome.get("current"),
                 "applied": outcome.get("applied"),
@@ -376,6 +390,64 @@ class Autoscaler:
             M_SIGNAL.set(signal, labels={**labels, "source": "combined"})
         self._save_state()
         M_TICK.observe(time.monotonic() - t0)
+
+    def _fuse_forecast(self, name: str, reactive_desired: int, target: int, signal: float):
+        """Fuse the predictive signal: desired = max(reactive,
+        forecast-at-lead-time). Returns (desired, source, detail) where
+        source says which signal won. Guardrails live here: the
+        forecast may only RAISE the reactive floor (a low forecast
+        never scales below what live traffic demands), and a model
+        whose forecast is auto-disabled (MAPE breach) contributes
+        nothing but the audit detail saying why."""
+        fc = self.forecaster
+        if fc is None:
+            return reactive_desired, "reactive", None
+        try:
+            fa = fc.signal_at_lead(name)
+        except Exception:
+            log.exception("forecast signal failed for %s", name)
+            return reactive_desired, "reactive", None
+        if fa is None:
+            return reactive_desired, "reactive", None
+        detail = {
+            "lead_seconds": fa.get("lead_seconds"),
+            "mape": fa.get("mape"),
+            "disabled": bool(fa.get("disabled")),
+            "actual_signal": round(signal, 3),
+        }
+        if fa.get("disabled"):
+            detail["disabled_reason"] = fa.get("disabled_reason")
+            return reactive_desired, "reactive", detail
+        rate = fa.get("rate")
+        if rate is None:
+            return reactive_desired, "reactive", detail
+        forecast_desired = math.ceil(rate / target)
+        detail.update({
+            "rate": round(rate, 3),
+            "lower": round(fa.get("lower", 0.0), 3),
+            "upper": round(fa.get("upper", 0.0), 3),
+            "desired": forecast_desired,
+        })
+        if forecast_desired <= reactive_desired:
+            return reactive_desired, "reactive", detail
+        # The ramp is coming before a cold replica could: pre-warm
+        # parked capacity now so scale-up becomes an attach, not a boot.
+        pool = self.parked_pool
+        if pool is not None:
+            try:
+                pool.request_prewarm(
+                    forecast_desired - reactive_desired,
+                    model=name,
+                    ttl_seconds=fa.get("lead_seconds", 60.0) + 2 * self.interval,
+                    detail={
+                        "forecast_rate": round(rate, 3),
+                        "reactive_desired": reactive_desired,
+                        "forecast_desired": forecast_desired,
+                    },
+                )
+            except Exception:
+                log.exception("parked pre-warm request failed for %s", name)
+        return forecast_desired, "forecast", detail
 
     def _has_role_endpoints(self, name: str) -> bool:
         """Whether the model's serving pods are actually role-planned:

@@ -35,6 +35,7 @@ from kubeai_tpu.api.core_types import KIND_POD, Container, Pod, PodSpec
 from kubeai_tpu.controller.engines.common import MODEL_PORT
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.runtime.store import Conflict, NotFound, ObjectMeta, Store
+from kubeai_tpu.utils import env_float
 
 log = logging.getLogger("kubeai_tpu.parked")
 
@@ -80,6 +81,53 @@ class ParkedPool:
         self._running = False
         self._thread: threading.Thread | None = None
         self._wake = threading.Event()
+        # Forecast-ahead pre-warm: model -> (extra free pods, expiry).
+        # TTL'd so a wrong forecast returns the surplus automatically.
+        self._prewarm: dict[str, tuple[int, float]] = {}
+
+    # -- predictive pre-warm -----------------------------------------------
+
+    def request_prewarm(
+        self,
+        extra: int,
+        model: str = "",
+        ttl_seconds: float = 120.0,
+        detail: dict | None = None,
+    ) -> int:
+        """Ask the pool to hold *extra* additional free pods for an
+        expected ramp (obs/forecast.py via the autoscaler). Extras from
+        all models are summed into reconcile()'s target, capped by
+        KUBEAI_PARKED_PREWARM_MAX; each request refreshes the model's
+        TTL. Returns the pool-wide extra now in effect."""
+        cap = int(env_float("KUBEAI_PARKED_PREWARM_MAX", 4.0))
+        extra = max(int(extra), 0)
+        now = self._clock()
+        with self._lock:
+            prev = self._prewarm.get(model, (0, 0.0))[0]
+            if extra <= 0:
+                self._prewarm.pop(model, None)
+            else:
+                self._prewarm[model] = (min(extra, cap), now + ttl_seconds)
+            total = self._prewarm_extra(now)
+        if extra > prev and self.decision_log is not None:
+            self.decision_log.append({
+                "t": now,
+                "action": "parked_prewarm",
+                "source": "forecast",
+                "model": model,
+                "extra": extra,
+                "pool_extra": total,
+                "ttl_seconds": ttl_seconds,
+                "detail": detail or {},
+            })
+        self._wake.set()
+        return total
+
+    def _prewarm_extra(self, now: float) -> int:
+        cap = int(env_float("KUBEAI_PARKED_PREWARM_MAX", 4.0))
+        for m in [m for m, (_, exp) in self._prewarm.items() if exp <= now]:
+            del self._prewarm[m]
+        return min(sum(n for n, _ in self._prewarm.values()), cap)
 
     # -- lifecycle ---------------------------------------------------------
 
@@ -115,7 +163,9 @@ class ParkedPool:
     def reconcile(self) -> None:
         free = self._free_pods()
         M_PARKED_PODS.set(len(free))
-        want = int(getattr(self.system, "parked_replicas", 0))
+        with self._lock:
+            extra = self._prewarm_extra(self._clock())
+        want = int(getattr(self.system, "parked_replicas", 0)) + extra
         for _ in range(want - len(free)):
             self._create()
         for pod in sorted(free, key=lambda p: p.meta.name)[want:]:

@@ -42,6 +42,7 @@ from kubeai_tpu.obs import (
     extract_context,
     handle_canary_request,
     handle_debug_request,
+    handle_forecast_request,
     handle_history_request,
     handle_incident_request,
     handle_logs_request,
@@ -443,6 +444,9 @@ def _make_handler(srv: EngineServer):
                     # depths, deficits, preemption + resume counters.
                     or handle_qos_request(path, query)
                     or handle_history_request(path, query)
+                    # Forecasting is operator-side; this answers an
+                    # honest "not installed here" 404 on engines.
+                    or handle_forecast_request(path, query)
                     or handle_logs_request(path, query)
                     or handle_debug_request(path, query)
                 )

@@ -20,6 +20,11 @@ from kubeai_tpu.autoscaler.autoscaler import Autoscaler
 from kubeai_tpu.autoscaler.fleet import FleetCollector
 from kubeai_tpu.autoscaler.leader import Election
 from kubeai_tpu.obs.canary import CanaryProber, install_canary, uninstall_canary
+from kubeai_tpu.obs.forecast import (
+    Forecaster,
+    install_forecaster,
+    uninstall_forecaster,
+)
 from kubeai_tpu.obs.history import (
     HistoryStore,
     RegistrySampler,
@@ -157,6 +162,17 @@ class Manager:
             self.history, election=self.election
         )
         self.fleet.history = self.history
+        # Predictive telemetry over the history store: forecast curves
+        # feed the autoscaler a forecast-at-lead-time floor (raise-only),
+        # the parked pool a pre-warm signal, and the incident bus the
+        # traffic_anomaly trigger. Leader-gated like the sampler.
+        self.forecaster = Forecaster(
+            self.history,
+            election=self.election,
+            decision_log=self.autoscaler.decisions,
+        )
+        self.autoscaler.forecaster = self.forecaster
+        self.autoscaler.parked_pool = self.parked_pool
         self.incidents = IncidentRecorder(
             sources=standard_sources(
                 self.lb,
@@ -166,6 +182,7 @@ class Manager:
                 slo=self.slo,
                 canary=self.canary,
                 history=self.history,
+                forecaster=self.forecaster,
             ),
             election=self.election,
             # By-ADDR pages (not the flat list): the counter watch
@@ -178,6 +195,7 @@ class Manager:
         install_recorder(self.incidents)
         install_canary(self.canary)
         install_history(self.history)
+        install_forecaster(self.forecaster)
         self.messengers = [
             Messenger(
                 stream.requests_url,
@@ -202,6 +220,7 @@ class Manager:
         self.autoscaler.start()
         self.slo.start()
         self.history_sampler.start()
+        self.forecaster.start()
         self.incidents.start()
         self.canary.start()
         if self.local_runtime:
@@ -230,6 +249,8 @@ class Manager:
         # (tests build several per process) must survive this stop.
         uninstall_canary(self.canary)
         uninstall_recorder(self.incidents)
+        self.forecaster.stop()
+        uninstall_forecaster(self.forecaster)
         self.history_sampler.stop()
         uninstall_history(self.history)
         self.slo.stop()

@@ -13,6 +13,14 @@ from kubeai_tpu.obs.canary import (
     install_canary,
     uninstall_canary,
 )
+from kubeai_tpu.obs.forecast import (
+    Forecaster,
+    derive_lead_seconds,
+    handle_forecast_request,
+    install_forecaster,
+    installed_forecaster,
+    uninstall_forecaster,
+)
 from kubeai_tpu.obs.history import (
     HistoryStore,
     RegistrySampler,
@@ -76,6 +84,12 @@ __all__ = [
     "handle_canary_request",
     "install_canary",
     "uninstall_canary",
+    "Forecaster",
+    "derive_lead_seconds",
+    "handle_forecast_request",
+    "install_forecaster",
+    "installed_forecaster",
+    "uninstall_forecaster",
     "HistoryStore",
     "RegistrySampler",
     "handle_history_request",

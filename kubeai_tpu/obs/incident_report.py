@@ -305,6 +305,44 @@ def _history_entries(section: dict, t0: float, limit: int = 12) -> list:
     return out
 
 
+def _forecast_entries(section: dict, t0: float) -> list:
+    """Predicted band vs what actually arrived, per model/signal: the
+    observed sparkline drawn against the forecast's recent curve, so a
+    traffic_anomaly report shows the violation inline. Stamped at the
+    recent window's start, like the history sparklines."""
+    from kubeai_tpu.obs.history import sparkline
+
+    out = []
+    for model, entry in sorted((section.get("models") or {}).items()):
+        for signal, s in sorted((entry.get("signals") or {}).items()):
+            recent = s.get("recent") or []
+            if not recent:
+                continue
+            since = recent[0][0]
+            obs_cells = [r[1] for r in recent]
+            pred_cells = [r[2] for r in recent]
+            lo_now, hi_now = recent[-1][3], recent[-1][4]
+            acc = s.get("accuracy") or {}
+            mape = acc.get("mape")
+            out.append(_entry(
+                since, "forecast",
+                f"{model}/{signal} predicted {sparkline(pred_cells)} "
+                f"band now [{lo_now:.4g}..{hi_now:.4g}]",
+            ))
+            out.append(_entry(
+                since, "forecast",
+                f"{model}/{signal} observed  {sparkline(obs_cells)} "
+                f"anomaly_score={s.get('anomaly_score')}"
+                + (f" mape={mape:.3f}" if isinstance(mape, (int, float)) else ""),
+            ))
+        if entry.get("disabled"):
+            out.append(_entry(
+                t0, "forecast",
+                f"{model} forecast AUTO-DISABLED: {entry.get('disabled_reason')}",
+            ))
+    return out
+
+
 def render_incident(doc: dict) -> str:
     """The human-readable correlated timeline for one incident doc."""
     t0 = doc.get("t", 0.0)
@@ -338,6 +376,7 @@ def render_incident(doc: dict) -> str:
         "routing": lambda s: _routing_entries(s, t0),
         "tenants": lambda s: _tenant_entries(s, t0),
         "history": lambda s: _history_entries(s, t0),
+        "forecast": lambda s: _forecast_entries(s, t0),
         "logs": lambda s: _log_entries(s),
     }
     for name, fn in handlers.items():

@@ -222,6 +222,11 @@ class IncidentRecorder:
             "gang_reform": slow,
             "canary_error": slow,
             "canary_corrupt": slow,
+            # An out-of-forecast-interval episode is sustained by
+            # definition (the forecaster requires N consecutive ticks
+            # before publishing) and typically outlives the general
+            # window; re-fires inside one episode are the same anomaly.
+            "traffic_anomaly": slow,
         }
         # Capture settle: a trigger fires at the instant of damage —
         # a breaker opens INSIDE the failing attempt, before that
@@ -828,6 +833,7 @@ def standard_sources(
     slo=None,
     canary=None,
     history=None,
+    forecaster=None,
     trace_limit: int = 30,
 ) -> dict:
     """The canonical snapshot-source set over the operator's debug
@@ -875,6 +881,11 @@ def standard_sources(
         # KUBEAI_INCIDENT_CONTEXT_SECONDS of the curated key-series set,
         # so every snapshot answers "what changed before it broke".
         sources["history"] = history.context_block
+    if forecaster is not None:
+        # Predicted band vs what actually arrived: a traffic_anomaly
+        # snapshot carries the curve that was violated, and every other
+        # trigger's snapshot shows whether the traffic was expected.
+        sources["forecast"] = lambda: forecaster.report(points=32)
     return sources
 
 

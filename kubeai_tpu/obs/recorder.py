@@ -407,6 +407,8 @@ DEBUG_INDEX: tuple[tuple[str, str, str], ...] = (
      "SLO monitor report: attainment + burn rate per objective over the rolling window"),
     ("/debug/history", "both",
      "embedded time-series history: tiered metric trajectories with gap markers (?series=&since=&step=)"),
+    ("/debug/forecast", "both",
+     "predictive telemetry: per-model forecast curves, prediction intervals, accuracy, anomaly state (?model=; operator-side)"),
     ("/debug/logs", "both",
      "recent WARNING+ structured log records with trace correlation (?level=&since=&trace=&limit=)"),
     ("/debug/pipeline", "engine",

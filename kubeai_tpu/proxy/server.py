@@ -24,6 +24,7 @@ from kubeai_tpu.obs import (
     debug_index_response,
     handle_canary_request,
     handle_debug_request,
+    handle_forecast_request,
     handle_history_request,
     handle_incident_request,
     handle_logs_request,
@@ -293,6 +294,7 @@ def _make_handler(srv: OpenAIServer):
                     # stack also carries the engine queue breakdown).
                     or handle_qos_request(path, query)
                     or handle_history_request(path, query)
+                    or handle_forecast_request(path, query)
                     or handle_logs_request(path, query)
                     or handle_debug_request(path, query)
                 )

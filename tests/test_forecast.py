@@ -1,0 +1,475 @@
+"""Predictive telemetry (kubeai_tpu/obs/forecast.py): seasonal fit over
+the history store with injected clocks, gap honesty (widen the interval,
+never fabricate a zero trough), forecast scoring + MAPE auto-disable
+with hysteresis, anomaly-robust fitting (a flood must not teach the
+next refit to expect itself), sustained-ticks anomaly publication,
+autoscaler fusion guardrails (raise-only floor, parked pre-warm), the
+/debug/forecast contract, and the fast forecast drill e2e.
+"""
+
+import json
+import math
+import threading
+
+import pytest
+
+from kubeai_tpu.obs.forecast import (
+    Forecaster,
+    derive_lead_seconds,
+    handle_forecast_request,
+    install_forecaster,
+    installed_forecaster,
+    uninstall_forecaster,
+)
+from kubeai_tpu.obs.history import HistoryStore
+
+MODEL = "m1"
+SERIES = "kubeai_inference_requests_active{request_model=m1,request_type=http}"
+
+
+class FakeWall:
+    def __init__(self, t=1_000_000.0):
+        self.t = t
+
+    def __call__(self):
+        return self.t
+
+    def advance(self, dt):
+        self.t += dt
+        return self.t
+
+
+def curve_value(t, season, peak=10.0):
+    """Deterministic diurnal-ish seasonal signal in [~0.6, peak]."""
+    frac = (t % season) / season
+    return peak * (0.55 + 0.45 * math.sin(2 * math.pi * (frac - 0.25)))
+
+
+def seed(store, until, season, seasons=3, cadence=10.0, value=None, skip=None):
+    """Write `seasons` prior seasons of samples ending just before
+    `until`. `value` overrides the curve with a constant; `skip`
+    excludes a (lo, hi) wall-time window (paired with mark_gap)."""
+    t = until - seasons * season
+    while t < until:
+        if skip is None or not (skip[0] <= t < skip[1]):
+            v = value if value is not None else curve_value(t, season)
+            store.record(SERIES, v, t=t)
+        t += cadence
+    return store
+
+
+def make_forecaster(store, wall, **kw):
+    kw.setdefault("interval_seconds", 5.0)
+    kw.setdefault("season_seconds", 800.0)
+    kw.setdefault("bins", 16)  # step = max(800/16, 5) = 50 s
+    kw.setdefault("horizon_seconds", 400.0)
+    kw.setdefault("lead_seconds", 100.0)
+    kw.setdefault("fit_seasons", 3)
+    return Forecaster(store, wall=wall, clock=wall, **kw)
+
+
+def fresh_stack(t0=1_000_000.0, **fkw):
+    wall = FakeWall(t0)
+    store = HistoryStore(history_dir="", wall=wall)
+    fc = make_forecaster(store, wall, **fkw)
+    return wall, store, fc
+
+
+class TestSeasonalFit:
+    def test_discovers_model_from_request_series(self):
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season)
+        assert fc.models() == [MODEL]
+
+    def test_forecast_tracks_the_seeded_season(self):
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season)
+        fc.tick()
+        sig = fc.signal_at_lead(MODEL)
+        assert sig is not None and not sig["disabled"]
+        want = curve_value(wall.t + fc.lead, fc.season)
+        # Seasonal-naive over a clean periodic seed: the lead-time point
+        # tracks the curve within the (floored) residual band.
+        assert sig["rate"] == pytest.approx(want, abs=2.5)
+        assert sig["lower"] <= sig["rate"] <= sig["upper"]
+
+    def test_horizon_curve_spans_and_orders(self):
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season)
+        fc.tick()
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        curve = rep["curve"]
+        assert curve[-1][0] - curve[0][0] >= fc.horizon - rep["step_seconds"]
+        for t, pred, lo, hi in curve:
+            assert lo <= pred <= hi
+
+    def test_needs_three_observations(self):
+        wall, store, fc = fresh_stack()
+        store.record(SERIES, 1.0, t=wall.t - 60)
+        store.record(SERIES, 1.0, t=wall.t - 50)
+        fc.tick()
+        assert fc.signal_at_lead(MODEL) is None
+
+    def test_follower_computes_nothing(self):
+        class Election:
+            def __init__(self):
+                self.is_leader = threading.Event()
+
+        wall, store, _ = fresh_stack()
+        el = Election()
+        fc = make_forecaster(store, wall, election=el)
+        seed(store, wall.t, fc.season)
+        fc.tick()
+        assert fc.ticks == 0 and fc.signal_at_lead(MODEL) is None
+        el.is_leader.set()
+        fc.tick()
+        assert fc.ticks == 1 and fc.signal_at_lead(MODEL) is not None
+
+
+class TestGapHonesty:
+    def test_gap_widens_interval(self):
+        t0 = 1_000_000.0
+        wall_a, store_a, fc_a = fresh_stack(t0)
+        seed(store_a, t0, fc_a.season)
+        fc_a.tick()
+        clean = fc_a.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+
+        wall_b, store_b, fc_b = fresh_stack(t0)
+        gap = (t0 - 900.0, t0 - 500.0)
+        seed(store_b, t0, fc_b.season, skip=gap)
+        store_b.mark_gap("restart", since=gap[0], t=gap[1])
+        fc_b.tick()
+        gappy = fc_b.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+
+        assert gappy["interval_widen"] > clean["interval_widen"] == 1.0
+        width = lambda rep: rep["curve"][-1][3] - rep["curve"][-1][2]
+        assert width(gappy) > width(clean)
+
+    def test_gap_never_fabricates_zero_trough(self):
+        # Samples exist ONLY outside the gap; a naive fit would read the
+        # gap's empty buckets as zero traffic and predict a trough.
+        wall, store, fc = fresh_stack()
+        gap = (wall.t - 400.0, wall.t - 100.0)
+        seed(store, wall.t, fc.season, value=6.0, skip=gap)
+        store.mark_gap("sampler_stall", since=gap[0], t=gap[1])
+        fc.tick()
+        sig = fc.signal_at_lead(MODEL)
+        assert sig["rate"] == pytest.approx(6.0, abs=1.0)
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        # No curve point dives toward the fabricated zero.
+        assert min(p[1] for p in rep["curve"]) > 4.0
+
+    def test_unscorable_gap_bucket_is_skipped_not_an_error(self):
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season, value=5.0)
+        fc.tick()
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        assert rep["accuracy"]["pending"] > 0
+        # Three forecast buckets mature with NO samples, all gap-covered
+        # (a restart): they must be dropped unscored, not counted as
+        # zero-traffic forecast misses.
+        g0 = wall.t
+        wall.advance(3 * 50.0)
+        store.mark_gap("restart", since=g0, t=wall.t)
+        store.record(SERIES, 5.0, t=wall.t - 2.0)
+        fc.tick()
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        assert rep["accuracy"]["scored"] == 0
+        assert rep["accuracy"]["mape"] is None
+
+
+class TestScoringAndDisable:
+    def _run_ticks(self, wall, store, fc, n, value):
+        for _ in range(n):
+            wall.advance(50.0)  # one fit bucket per tick
+            t = wall.t - 50.0
+            while t < wall.t:
+                store.record(SERIES, value, t=t)
+                t += 10.0
+            fc.tick()
+
+    def test_accurate_forecasts_score_low_mape(self):
+        wall, store, fc = fresh_stack()
+        log = []
+        fc.decision_log = log
+        seed(store, wall.t, fc.season, value=5.0)
+        fc.tick()
+        self._run_ticks(wall, store, fc, 8, value=5.0)
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        assert rep["accuracy"]["scored"] >= 4
+        assert rep["accuracy"]["mape"] < 0.3
+        assert rep["accuracy"]["interval_coverage"] > 0.9
+        scored = [r for r in log if r.get("action") == "forecast_scored"]
+        assert scored and scored[-1]["in_interval"]
+        assert scored[-1]["signal_kind"] == "requests"
+
+    def test_mape_disable_engages_and_reenables_with_hysteresis(self):
+        wall, store, fc = fresh_stack()
+        log = []
+        fc.decision_log = log
+        fc.min_scored = 4
+        fc.mape_disable = 0.5
+        # History promises 10 in-flight; reality delivers zero.
+        seed(store, wall.t, fc.season, value=10.0)
+        fc.tick()
+        self._run_ticks(wall, store, fc, 10, value=0.0)
+        assert any(r.get("action") == "forecast_auto_disable" for r in log)
+        sig = fc.signal_at_lead(MODEL)
+        assert sig["disabled"] and "rate" not in sig
+        assert "MAPE" in sig["disabled_reason"]
+        from kubeai_tpu.metrics.registry import default_registry
+        g = default_registry.get("kubeai_forecast_auto_disabled")
+        assert g.value(labels={"model": MODEL}) == 1.0
+        # Traffic returns to the promised regime: fresh forecasts score
+        # ~0 APE and the rolling MAPE decays. Re-enable requires
+        # < 0.75 * threshold (hysteresis), so a handful of good ticks
+        # is not enough — drive until the rolling window flips it.
+        for _ in range(400):
+            if not fc.signal_at_lead(MODEL)["disabled"]:
+                break
+            self._run_ticks(wall, store, fc, 1, value=10.0)
+        assert not fc.signal_at_lead(MODEL)["disabled"]
+        reen = [r for r in log if r.get("action") == "forecast_reenable"]
+        assert reen and reen[-1]["mape"] < 0.75 * fc.mape_disable
+        assert g.value(labels={"model": MODEL}) == 0.0
+
+    def test_stale_curve_yields_no_signal(self):
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season, value=5.0)
+        fc.tick()
+        assert fc.signal_at_lead(MODEL) is not None
+        wall.advance(4 * fc.interval + 2.0)
+        assert fc.signal_at_lead(MODEL) is None
+
+
+class TestAnomaly:
+    def _drive(self, wall, store, fc, n, value):
+        """n 50 s fit buckets of `value` traffic, ticking TWICE per
+        bucket — production ticks several times per fit bucket (15 s
+        interval vs 10 min buckets), which is what lets the streak
+        outrun the refit's legitimate per-bucket adaptation."""
+        for _ in range(n):
+            wall.advance(25.0)
+            fc.tick()
+            wall.advance(25.0)
+            store.record(SERIES, value, t=wall.t - 1.0)
+            fc.tick()
+
+    def test_sustained_flood_publishes_once_per_episode(self, monkeypatch):
+        published = []
+        monkeypatch.setattr(
+            "kubeai_tpu.obs.forecast.publish_trigger",
+            lambda trigger, **kw: published.append((trigger, kw)),
+        )
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season, value=2.0)
+        fc.tick()
+        self._drive(wall, store, fc, 6, 20.0)  # well past the trigger count
+        assert [p[0] for p in published] == ["traffic_anomaly"]
+        detail = published[0][1]["detail"]
+        assert detail["sustained_ticks"] == fc.anomaly_ticks
+        assert detail["observed"] > detail["upper"]
+        assert published[0][1]["key"] == f"traffic_anomaly:{MODEL}"
+        # Episode ends (back in band) -> a NEW flood publishes again.
+        self._drive(wall, store, fc, 6, 2.0)
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        assert rep["anomaly_streak"] == 0
+        self._drive(wall, store, fc, fc.anomaly_ticks, 20.0)
+        assert len(published) == 2
+
+    def test_fit_does_not_assimilate_the_flood_it_is_flagging(self, monkeypatch):
+        """Regression: level/trend learn from winsorized observations
+        and sigma is a robust MAD. Without that, one refit chases the
+        flood, the band swallows it, and the anomaly streak resets
+        before the sustained-ticks publisher can fire."""
+        monkeypatch.setattr(
+            "kubeai_tpu.obs.forecast.publish_trigger", lambda *a, **k: None
+        )
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season, value=2.0)
+        fc.tick()
+        self._drive(wall, store, fc, 6, 20.0)
+        rep = fc.report(model=MODEL)["models"][MODEL]["signals"]["requests"]
+        # The flood is 10x the level: the fit may drift some (seasonal
+        # bins are honest means) but the band must never swallow the
+        # flood — the streak keeps climbing through every refit.
+        assert rep["level"] < 10.0  # nowhere near the 20.0 flood
+        assert rep["anomaly_score"] >= fc.anomaly_threshold
+        assert rep["anomaly_streak"] >= fc.anomaly_ticks
+
+    def test_missing_traffic_scores_below_band(self, monkeypatch):
+        published = []
+        monkeypatch.setattr(
+            "kubeai_tpu.obs.forecast.publish_trigger",
+            lambda trigger, **kw: published.append(kw),
+        )
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season, value=10.0)
+        fc.tick()
+        self._drive(wall, store, fc, fc.anomaly_ticks, 0.0)
+        assert published and published[0]["detail"]["observed"] == 0.0
+        assert published[0]["detail"]["lower"] > 0.0
+
+
+class _StubForecaster:
+    def __init__(self, out):
+        self.out = out
+
+    def signal_at_lead(self, model):
+        return self.out
+
+
+class _StubPool:
+    def __init__(self):
+        self.calls = []
+
+    def request_prewarm(self, extra, model="", ttl_seconds=0.0, detail=None):
+        self.calls.append((extra, model, ttl_seconds, detail))
+        return extra
+
+
+def fuse(forecaster, reactive_desired, target=1, signal=0.0, pool=None):
+    """Drive Autoscaler._fuse_forecast against a stub self."""
+    from types import SimpleNamespace
+
+    from kubeai_tpu.autoscaler.autoscaler import Autoscaler
+
+    stub = SimpleNamespace(
+        forecaster=forecaster, parked_pool=pool, interval=1.0
+    )
+    return Autoscaler._fuse_forecast(stub, MODEL, reactive_desired, target, signal)
+
+
+class TestAutoscalerFusion:
+    def test_no_forecaster_is_pure_reactive(self):
+        assert fuse(None, 3) == (3, "reactive", None)
+
+    def test_forecast_only_raises_the_reactive_floor(self):
+        fc = _StubForecaster(
+            {"lead_seconds": 60.0, "mape": 0.1, "disabled": False,
+             "rate": 0.5, "lower": 0.0, "upper": 1.0}
+        )
+        desired, source, detail = fuse(fc, reactive_desired=4, target=1)
+        assert (desired, source) == (4, "reactive")
+        assert detail["desired"] == 1  # audited, not applied
+
+    def test_forecast_wins_and_prewarms_parked_pool(self):
+        fc = _StubForecaster(
+            {"lead_seconds": 60.0, "mape": 0.1, "disabled": False,
+             "rate": 9.2, "lower": 7.0, "upper": 11.0}
+        )
+        pool = _StubPool()
+        desired, source, detail = fuse(fc, reactive_desired=2, target=2, pool=pool)
+        assert (desired, source) == (5, "forecast")
+        extra, model, ttl, pdetail = pool.calls[0]
+        assert extra == 3 and model == MODEL and ttl > 60.0
+        assert pdetail["reactive_desired"] == 2
+
+    def test_disabled_forecast_degrades_to_reactive_with_audit(self):
+        fc = _StubForecaster(
+            {"lead_seconds": 60.0, "mape": 2.0, "disabled": True,
+             "disabled_reason": "rolling MAPE 2.00 > 0.60"}
+        )
+        desired, source, detail = fuse(fc, reactive_desired=1)
+        assert (desired, source) == (1, "reactive")
+        assert detail["disabled"] and "MAPE" in detail["disabled_reason"]
+
+    def test_broken_forecaster_never_breaks_the_tick(self):
+        class Exploding:
+            def signal_at_lead(self, model):
+                raise RuntimeError("boom")
+
+        assert fuse(Exploding(), 2) == (2, "reactive", None)
+
+
+class TestParkedPrewarm:
+    def test_ttl_expiry_returns_the_surplus(self):
+        from kubeai_tpu.controller.parked import ParkedPool
+
+        wall = FakeWall(500.0)
+        log = []
+        pool = ParkedPool(None, None, decision_log=log, clock=wall)
+        assert pool.request_prewarm(2, model=MODEL, ttl_seconds=30.0) == 2
+        rec = [r for r in log if r.get("action") == "parked_prewarm"][0]
+        assert rec["source"] == "forecast" and rec["extra"] == 2
+        wall.advance(31.0)
+        assert pool._prewarm_extra(wall()) == 0
+
+    def test_pool_extra_is_capped(self, monkeypatch):
+        from kubeai_tpu.controller.parked import ParkedPool
+
+        monkeypatch.setenv("KUBEAI_PARKED_PREWARM_MAX", "3")
+        pool = ParkedPool(None, None, clock=FakeWall(0.0))
+        pool.request_prewarm(9, model="a", ttl_seconds=60.0)
+        assert pool.request_prewarm(9, model="b", ttl_seconds=60.0) == 3
+
+
+class TestDebugSurface:
+    def test_not_installed_answers_404(self):
+        assert installed_forecaster() is None
+        status, ctype, body = handle_forecast_request("/debug/forecast")
+        assert status == 404 and b"no forecaster" in body
+
+    def test_installed_report_roundtrip(self):
+        wall, store, fc = fresh_stack()
+        seed(store, wall.t, fc.season)
+        fc.tick()
+        install_forecaster(fc)
+        try:
+            status, ctype, body = handle_forecast_request(
+                "/debug/forecast", "model=m1&points=8"
+            )
+            assert status == 200 and ctype == "application/json"
+            doc = json.loads(body)
+            assert doc["active"] and MODEL in doc["models"]
+            sig = doc["models"][MODEL]["signals"]["requests"]
+            assert sig["accuracy"]["mape"] is None  # nothing matured yet
+            assert len(sig["curve"]) <= 10
+        finally:
+            uninstall_forecaster(fc)
+        assert handle_forecast_request("/debug/forecast")[0] == 404
+
+    def test_other_paths_pass_through(self):
+        assert handle_forecast_request("/debug/other") is None
+
+
+class TestLeadDerivation:
+    def test_env_override_wins(self, monkeypatch, tmp_path):
+        monkeypatch.setenv("KUBEAI_FORECAST_LEAD", "42.5")
+        assert derive_lead_seconds() == 42.5
+
+    def test_profile_file_fallback(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("KUBEAI_FORECAST_LEAD", raising=False)
+        prof = tmp_path / "BENCH_cold_start.json"
+        prof.write_text(json.dumps({"parked_attach_s": 7.5, "serial_s": 90.0}))
+        assert derive_lead_seconds(profile_path=str(prof)) == 7.5
+
+    def test_timeline_beats_the_profile(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("KUBEAI_FORECAST_LEAD", raising=False)
+
+        class Timeline:
+            def snapshot(self):
+                return {"ready_s": 12.0}
+
+        assert derive_lead_seconds(timeline=Timeline()) == 12.0
+
+    def test_default_when_nothing_measured(self, monkeypatch, tmp_path):
+        monkeypatch.delenv("KUBEAI_FORECAST_LEAD", raising=False)
+        missing = tmp_path / "nope.json"
+        assert derive_lead_seconds(profile_path=str(missing), default=33.0) == 33.0
+
+
+# ---------------------------------------------------------------------------
+# The full e2e: real stack, seeded diurnal day, forecast-ahead scale-up,
+# poisoned-model guardrails, trough-flood anomaly incident.
+
+
+def test_forecast_drill_fast():
+    from benchmarks.forecast_drill import run
+
+    summary = run(fast=True, verbose=False)
+    assert summary["passed"]
+    assert summary["decision_lead_seconds"] >= summary["lead_seconds"]
+    assert summary["poison"]["floor_respected"]
+    assert summary["poison"]["auto_disable_engaged"]
+    assert summary["anomaly"]["incident"]

@@ -205,3 +205,96 @@ def test_round_robin_model_validates():
     )
     default_model(m)
     validate_model(m)  # must not raise
+
+
+# -- arrival-rate patterns (--pattern) ----------------------------------------
+
+
+def test_pattern_multiplier_is_deterministic_and_shaped():
+    from benchmarks.loadgen import pattern_multiplier
+
+    # Diurnal sinusoid: trough bottoms mid-trough, peaks mid-peak, and
+    # averages ~1.0 over the period (same total load as a flat run).
+    assert pattern_multiplier("diurnal", 0.125) == pytest.approx(0.25)
+    assert pattern_multiplier("diurnal", 0.625) == pytest.approx(1.75)
+    mean = sum(pattern_multiplier("diurnal", i / 1000) for i in range(1000)) / 1000
+    assert mean == pytest.approx(1.0, abs=0.01)
+    # Spike: 4x burst confined to the middle tenth, half-open window.
+    assert pattern_multiplier("spike", 0.44) == 1.0
+    assert pattern_multiplier("spike", 0.45) == 4.0
+    assert pattern_multiplier("spike", 0.549) == 4.0
+    assert pattern_multiplier("spike", 0.55) == 1.0
+    # Step: halves then 1.5x's the base at the midpoint.
+    assert pattern_multiplier("step", 0.0) == 0.5
+    assert pattern_multiplier("step", 0.499) == 0.5
+    assert pattern_multiplier("step", 0.5) == 1.5
+    # frac wraps modulo one period.
+    assert pattern_multiplier("step", 1.25) == 0.5
+    with pytest.raises(ValueError):
+        pattern_multiplier("sawtooth", 0.1)
+
+
+def test_pattern_phase_windows():
+    from benchmarks.loadgen import PATTERN_PHASES, pattern_phase
+
+    assert pattern_phase("diurnal", 0.1) == "trough"
+    assert pattern_phase("diurnal", 0.25) == "ramp"  # boundary is half-open
+    assert pattern_phase("diurnal", 0.6) == "peak"
+    assert pattern_phase("diurnal", 0.9) == "decay"
+    assert pattern_phase("diurnal", 1.1) == "trough"  # wraps
+    assert pattern_phase("spike", 0.5) == "spike"
+    assert pattern_phase("step", 0.75) == "high"
+    # Every pattern's windows tile [0, 1) without holes.
+    for name, phases in PATTERN_PHASES.items():
+        assert phases[0][1] == 0.0 and phases[-1][2] == 1.0
+        for (_, _, hi), (_, lo, _) in zip(phases, phases[1:]):
+            assert hi == lo
+
+
+def test_run_benchmark_pattern_summary_block():
+    from benchmarks.loadgen import PATTERN_PHASES, run_benchmark
+
+    srv = _CountingServer()
+    try:
+        summary = run_benchmark(
+            srv.url, "m", conversations=6, turns=1, max_tokens=4,
+            request_rate=40.0, pattern="diurnal", pattern_period_s=2.0,
+            seed=7,
+        )
+    finally:
+        srv.stop()
+    block = summary["pattern"]
+    assert block["name"] == "diurnal"
+    assert block["period_s"] == 2.0
+    assert [p["name"] for p in block["phases"]] == [
+        n for n, _, _ in PATTERN_PHASES["diurnal"]
+    ]
+    # Every conversation lands in exactly one phase bucket.
+    assert sum(p["arrivals"] for p in block["phases"]) == 6
+    rates = {p["name"]: p["target_rate_rps"] for p in block["phases"]}
+    assert rates["peak"] > rates["trough"]
+
+
+def test_run_benchmark_pattern_validation():
+    from benchmarks.loadgen import run_benchmark
+
+    # Both checks fire before any request is sent.
+    with pytest.raises(ValueError, match="unknown pattern"):
+        run_benchmark(
+            "http://127.0.0.1:9", "m", conversations=1, turns=1,
+            request_rate=1.0, pattern="sawtooth",
+        )
+    with pytest.raises(ValueError, match="request.rate"):
+        run_benchmark(
+            "http://127.0.0.1:9", "m", conversations=1, turns=1,
+            pattern="diurnal",
+        )
+
+
+def test_plain_run_has_null_pattern_block():
+    srv = _CountingServer()
+    try:
+        summary = run_benchmark(srv.url, "m", conversations=1, turns=1, max_tokens=4)
+    finally:
+        srv.stop()
+    assert summary["pattern"] is None

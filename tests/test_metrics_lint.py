@@ -231,6 +231,98 @@ def test_debug_index_matches_doc_endpoint_table():
     )
 
 
+FORECAST = pathlib.Path("kubeai_tpu") / "obs" / "forecast.py"
+
+
+def test_forecast_metrics_registered_only_in_forecast():
+    """Registration rule: every kubeai_forecast_* metric lives in the
+    forecaster module — its gauges are removed as a set when a model's
+    series are dropped, and a stray registration elsewhere would leak
+    per-model series past that cleanup."""
+    calls = _registration_calls()
+    violations = [
+        f"{path}:{lineno}: {name} registered outside obs/forecast.py"
+        for path, lineno, name, _ in calls
+        if name is not None
+        and name.startswith("kubeai_forecast_")
+        and path != FORECAST
+    ]
+    assert not violations, "\n".join(violations)
+    assert any(
+        name is not None and name.startswith("kubeai_forecast_") and path == FORECAST
+        for path, _, name, _ in calls
+    ), "forecast metrics vanished from obs/forecast.py — lint scan broken?"
+
+
+DASHBOARD = REPO / "examples" / "observability" / "engine-grafana-dashboard.json"
+
+
+def _dashboard_metric_names():
+    """kubeai_* metric names referenced by any panel target expr in the
+    shipped Grafana dashboard."""
+    import json
+
+    dash = json.loads(DASHBOARD.read_text())
+    names = set()
+    for panel in dash.get("panels", []):
+        for target in panel.get("targets", []):
+            names.update(re.findall(r"kubeai_[a-z0-9_]+", target.get("expr", "")))
+    return names
+
+
+def test_dashboard_metrics_exist_in_doc_catalog_and_code():
+    """Dashboard drift lint, direction 1: every metric a dashboard panel
+    queries must be registered in code AND have a row in the
+    docs/observability.md catalog — the dashboard has grown panels
+    across many PRs and a renamed metric must break here, not on a
+    blank Grafana panel."""
+    dash_names = _dashboard_metric_names()
+    assert len(dash_names) > 20, "dashboard scan found suspiciously few metrics"
+    code_names = {
+        name for _, _, name, _ in _registration_calls() if name is not None
+    }
+    from kubeai_tpu.metrics.registry import ACTIVE_REQUESTS
+
+    code_names.add(ACTIVE_REQUESTS)
+    doc_text = DOC.read_text()
+    problems = []
+    for name in sorted(dash_names):
+        base = re.sub(r"_(bucket|sum|count)$", "", name)
+        if name not in code_names and base not in code_names:
+            problems.append(f"{name}: queried by a dashboard panel, never registered")
+        if name not in doc_text and base not in doc_text:
+            problems.append(
+                f"{name}: queried by a dashboard panel, no docs/observability.md row"
+            )
+    assert not problems, "\n".join(problems)
+
+
+def test_doc_claimed_panel_inputs_exist_in_dashboard():
+    """Dashboard drift lint, direction 2: a catalog row that claims to
+    feed the shipped dashboard (\"the dashboard's ... input\") must
+    actually be queried by some panel — we don't get to document panels
+    we no longer ship."""
+    dash_names = _dashboard_metric_names()
+    claimed = []
+    for line in DOC.read_text().splitlines():
+        if not line.startswith("|") or "the dashboard's" not in line:
+            continue
+        m = re.match(r"\|\s*`(kubeai_[a-z0-9_]+)`", line)
+        if m:
+            claimed.append(m.group(1))
+    assert claimed, "no catalog rows claim dashboard inputs — lint scan broken?"
+    missing = [
+        name
+        for name in claimed
+        if name not in dash_names
+        and not any(d.startswith(name) for d in dash_names)
+    ]
+    assert not missing, (
+        "docs/observability.md claims these metrics feed the dashboard, "
+        "but no panel queries them: " + ", ".join(missing)
+    )
+
+
 def test_doc_metric_names_exist_in_code():
     code_names = {
         name for _, _, name, _ in _registration_calls() if name is not None
