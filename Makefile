@@ -4,7 +4,7 @@ PY ?= python
 DOCKER ?= docker
 TAG ?= latest
 
-.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill kv-bench forecast-drill
+.PHONY: test test-fast test-unit test-k8s bench bench-tiny bench-trend chaos chaos-soak cold-start dryrun loadgen loadgen-demo native clean charts images images-check fleet-snapshot perf-gate disagg-bench incident-drill incident-report qos-drill gray-drill kv-bench forecast-drill spike-drill
 
 test:
 	$(PY) -m pytest tests/ -q
@@ -16,6 +16,22 @@ test-fast:  ## skip the slow e2e/model-parity suites
 chaos:  ## deterministic chaos + recovery suites (failpoints armed, fake clocks)
 	JAX_PLATFORMS=cpu KUBEAI_DEBUG_FAULTS=1 $(PY) -m pytest \
 	    tests/test_chaos.py tests/test_e2e_chaos.py -q
+
+chaos-soak: ## seeded randomized multi-fault soak: 200 episodes vs a live stack, global invariants, ddmin shrink on violation -> build/chaos/CHAOS.json
+	@# Each episode draws a fault schedule from a seeded PRNG (flaps,
+	@# mid-stream kills, disk faults, reconcile errors...), drives a
+	@# mixed QoS/tenant workload through store->reconciler->LB->proxy->
+	@# real CPU engines, quiesces, and asserts the global invariants:
+	@# byte-identical deterministic streams, KV/slot/thread conservation,
+	@# client==accountant==engine token conservation, breaker recovery,
+	@# per-episode incident capture. A violation prints the seed + a
+	@# ddmin-shrunk minimal schedule and the one-command replay line.
+	@# Exits nonzero on any violation or if coverage floors (>=4 fault
+	@# sites across >=3 subsystems) are missed. The fast fixed-seed
+	@# variant runs in tier-1 (tests/test_chaos_campaign.py). See
+	@# docs/robustness.md "Chaos campaigns". Override: EPISODES=, SEED=.
+	JAX_PLATFORMS=cpu $(PY) benchmarks/chaos_soak.py \
+	    --episodes $(or $(EPISODES),200) --seed $(or $(SEED),1)
 
 bench:
 	$(PY) bench.py
@@ -77,6 +93,19 @@ forecast-drill: ## predictive-scaling proof: seeded diurnal history, forecast-ah
 	@# "Predictive scaling".
 	JAX_PLATFORMS=cpu $(PY) benchmarks/forecast_drill.py --json BENCH_forecast.json
 	$(PY) benchmarks/perf_gate.py BENCH_forecast.json
+
+spike-drill: ## flash-crowd proof: quiet baseline -> 12x arrival burst -> recovery, per-phase p99 TTFT step -> BENCH_spike.json
+	@# Replays one compressed spike day (loadgen --pattern spike with
+	@# the burst multiplier raised to the 0->hundreds regime, rate-
+	@# compressed for CPU engines) through a real 3-replica stack,
+	@# bracketed by identical quiet baselines. Exits nonzero unless the
+	@# burst actually delivered (>=3x base arrival rate in the spike
+	@# window), ZERO requests were shed, quiet p99 TTFT recovered after
+	@# the day drained, and the fleet quiesced. Summary under
+	@# build/spike-drill/; BENCH_spike.json validated by perf_gate.py
+	@# (schema: benchmarks/BENCH_SCHEMA.md). See docs/autoscaling.md.
+	JAX_PLATFORMS=cpu $(PY) benchmarks/spike_drill.py --bench-json BENCH_spike.json
+	$(PY) benchmarks/perf_gate.py BENCH_spike.json
 
 gray-drill: ## gray-failure proof: 1-of-3 real replicas turns straggler, scorer soft-ejects it, p99 contained, batch tier still served
 	@# Exits nonzero unless the per-token-slowed replica is soft-ejected

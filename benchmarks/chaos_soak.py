@@ -1,0 +1,137 @@
+"""Chaos soak runner: N seeded randomized multi-fault episodes against
+the live in-process stack, global invariants checked after each, and a
+CHAOS.json coverage/violation report at the end.
+
+    make chaos-soak                      # 200 episodes, seed 1
+    python benchmarks/chaos_soak.py --fast            # tier-1 variant
+    python benchmarks/chaos_soak.py --seed 7 --episodes 50
+    python benchmarks/chaos_soak.py --induce          # prove the pipeline
+    python benchmarks/chaos_soak.py --seed 7 --replay-episode 23
+
+Every violation is printed with its seed, full schedule, the ddmin-
+reduced minimal schedule, and the one-command replay line above — a
+red soak is a bug report, not a shrug. Knob defaults ride
+KUBEAI_CHAOS_* (docs/robustness.md).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from kubeai_tpu.chaos.campaign import (  # noqa: E402
+    ChaosCampaign,
+    induced_schedule,
+)
+from kubeai_tpu.chaos.report import validate_chaos_doc  # noqa: E402
+from kubeai_tpu.chaos.schedule import Schedule, generate_schedule  # noqa: E402
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser(description=__doc__.split("\n")[0])
+    ap.add_argument("--episodes", type=int, default=None,
+                    help="episode count (default: KUBEAI_CHAOS_EPISODES or 200)")
+    ap.add_argument("--seed", type=int, default=None,
+                    help="campaign seed (default: KUBEAI_CHAOS_SEED or 1)")
+    ap.add_argument("--replicas", type=int, default=None,
+                    help="engine replicas (default: KUBEAI_CHAOS_REPLICAS or 3)")
+    ap.add_argument("--fast", action="store_true",
+                    help="tier-1 variant: 10 episodes, 2 replicas, 6 requests")
+    ap.add_argument("--induce", action="store_true",
+                    help="append one unsurvivable episode to prove the "
+                         "violation -> shrink -> replay pipeline")
+    ap.add_argument("--replay-episode", type=int, default=None, metavar="N",
+                    help="re-run ONLY episode N of --seed (N=-1 replays the "
+                         "induced schedule) and report its invariants")
+    ap.add_argument("--replay-schedule", type=str, default=None, metavar="FILE",
+                    help="re-run a schedule JSON (e.g. a reduced_schedule "
+                         "block from CHAOS.json)")
+    ap.add_argument("--out", type=str,
+                    default=os.path.join("build", "chaos", "CHAOS.json"))
+    args = ap.parse_args()
+
+    kwargs: dict = {}
+    if args.fast:
+        kwargs.update(episodes=10, replicas=2, requests_per_episode=6)
+    for k, v in (("episodes", args.episodes), ("seed", args.seed),
+                 ("replicas", args.replicas)):
+        if v is not None:
+            kwargs[k] = v
+
+    replay: Schedule | None = None
+    if args.replay_schedule:
+        with open(args.replay_schedule) as f:
+            replay = Schedule.from_dict(json.load(f))
+    elif args.replay_episode is not None:
+        if args.replay_episode < 0:
+            replay = induced_schedule(kwargs.get("seed", 1))
+        else:
+            c = ChaosCampaign(**kwargs)
+            replay = generate_schedule(c.seed, args.replay_episode, c.replicas)
+
+    campaign = ChaosCampaign(**kwargs)
+    mode = ("replay" if replay is not None
+            else "induce" if args.induce else "soak")
+    print(f"chaos {mode}: seed={campaign.seed} episodes={campaign.episodes} "
+          f"replicas={campaign.replicas} requests/ep={campaign.requests}")
+
+    with campaign:
+        if replay is not None:
+            print(f"replaying: {replay.describe()}")
+            res = campaign.run_episode(replay)
+            if res["violations"]:
+                print("violations reproduced:")
+                for v in res["violations"]:
+                    print(f"  - {v}")
+                return 1
+            print("clean: no invariant violations on replay")
+            return 0
+        doc = campaign.run(
+            induce=induced_schedule(campaign.seed) if args.induce else None
+        )
+
+    os.makedirs(os.path.dirname(args.out) or ".", exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+    print(f"\n{doc['episodes']} episodes in {doc['duration_s']}s; "
+          f"{len(doc['sites_fired'])} fault sites fired across "
+          f"{len(doc['subsystems_covered'])} subsystems "
+          f"({', '.join(doc['subsystems_covered'])})")
+    print(f"degradation absorbed: {doc['degradation']}")
+    print(f"wrote {args.out}")
+
+    if args.induce:
+        # The pipeline must DETECT the induced violation and shrink it;
+        # a clean run here means the detector is broken.
+        induced = [v for v in doc["violations"] if v["episode"] == -1]
+        if not induced:
+            print("FAIL: induced episode did not trip any invariant")
+            return 1
+        reduced = induced[0]["reduced_schedule"]["events"]
+        print(f"induced violation detected and shrunk to "
+              f"{len(reduced)} event(s) — pipeline OK")
+        natural = [v for v in doc["violations"] if v["episode"] != -1]
+        return 1 if natural else 0
+
+    problems = validate_chaos_doc(
+        doc,
+        min_episodes=campaign.episodes,
+        min_sites=2 if args.fast else 4,
+        min_subsystems=2 if args.fast else 3,
+        require_clean=True,
+    )
+    if problems:
+        print("CHAOS.json failed acceptance:")
+        for p in problems:
+            print(f"  - {p}")
+        return 1
+    print("soak clean: zero invariant violations, schema valid")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

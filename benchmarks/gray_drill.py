@@ -48,6 +48,7 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.loadgen import parse_priority_mix, run_benchmark  # noqa: E402
 from benchmarks.qos_drill import _AlwaysLeader, _await, sse_shape  # noqa: E402
+from tests.leakcheck import assert_quiesced, thread_baseline  # noqa: E402
 
 from kubeai_tpu.api import model_types as mt  # noqa: E402
 from kubeai_tpu.api.core_types import KIND_POD  # noqa: E402
@@ -197,6 +198,9 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
             lambda: len(lb.get_all_addresses(MODEL)) == REPLICAS,
             msg="all endpoints",
         )
+        # Stack fully built: the end-of-drill quiesce check compares
+        # live non-daemon threads against this baseline.
+        threads_baseline = thread_baseline()
         straggler = servers[-1]
         straggler_addr = f"127.0.0.1:{straggler.port}"
 
@@ -423,6 +427,11 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
             "fleet_median_p95_s": health_view["scoring"]["fleet_median_p95_s"],
             "incident_id": incidents[0]["id"],
         }
+        # -- check 4: the stack let go of everything it held ----------------
+        assert_quiesced(
+            engines, lb=lb, model=MODEL, baseline_threads=threads_baseline
+        )
+        summary["quiesced"] = True
         summary["ok"] = True
         summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
         if verbose:

@@ -43,6 +43,8 @@ import urllib.request
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from tests.leakcheck import assert_quiesced, thread_baseline
+
 from kubeai_tpu import faults
 from kubeai_tpu.api import model_types as mt
 from kubeai_tpu.api.core_types import KIND_POD
@@ -219,6 +221,9 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
 
         store.mutate(KIND_POD, pod.meta.name, forge)
         _await(lambda: lb.get_all_addresses(MODEL), msg="endpoint")
+        # Stack fully built: the end-of-drill quiesce check compares
+        # live non-daemon threads against this baseline.
+        threads_baseline = thread_baseline()
 
         # -- phase 1: healthy baseline ------------------------------------
         # First sampler sweep anchors every counter; the sweep after the
@@ -366,6 +371,14 @@ def run(fast: bool = False, incident_dir: str | None = None, verbose: bool = Tru
             "restart_series_recovered": len(carried),
             "restart_gap_marked": True,
         }
+        # -- phase 5: the stack let go of everything it held ---------------
+        # (Injected faults are still armed here — quiescence must hold
+        # anyway: containment that leaks slots or threads isn't
+        # containment.)
+        assert_quiesced(
+            [eng], lb=lb, model=MODEL, baseline_threads=threads_baseline
+        )
+        summary["quiesced"] = True
         summary["ok"] = True
         summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
         if verbose:

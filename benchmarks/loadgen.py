@@ -49,6 +49,9 @@ class ThreadStats:
         self.tenant = tenant  # tenant NAME from --tenant-mix ("" = untagged)
         self.priority = priority  # QoS class from --priority-mix ("" = untagged)
         self.ttfts: list[float] = []
+        # (turn-start monotonic timestamp, ttft_s) twins of ttfts — the
+        # per-phase attribution --pattern runs bucket TTFTs with.
+        self.ttft_marks: list[tuple[float, float]] = []
         self.itls: list[float] = []
         self.turn_latencies: list[float] = []
         # Per-turn (decode_time, token_count) for TPOT.
@@ -218,6 +221,7 @@ def run_conversation(base_url: str, model: str, user_turns: list[str], max_token
                     if t_last is None:
                         t_first = now
                         stats.ttfts.append(now - t_start)
+                        stats.ttft_marks.append((t_start, now - t_start))
                     else:
                         stats.itls.append(now - t_last)
                     t_last = now
@@ -507,17 +511,18 @@ PATTERN_PHASES: dict[str, tuple[tuple[str, float, float], ...]] = {
 }
 
 
-def pattern_multiplier(pattern: str, frac: float) -> float:
+def pattern_multiplier(pattern: str, frac: float, spike_mult: float = 4.0) -> float:
     """Arrival-rate multiplier at *frac* (position in [0,1) within one
     period) for a named pattern. Deterministic and dependency-free:
     diurnal is a sinusoid with its minimum mid-trough (frac 0.125) and
-    maximum mid-peak (frac 0.625); spike is a 4x burst in the middle
-    tenth; step halves then 1.5x's the base."""
+    maximum mid-peak (frac 0.625); spike is a *spike_mult* burst (4x by
+    default; spike_drill turns it up to the 0->hundreds regime) in the
+    middle tenth; step halves then 1.5x's the base."""
     frac = frac % 1.0
     if pattern == "diurnal":
         return 1.0 + 0.75 * math.sin(2 * math.pi * (frac - 0.375))
     if pattern == "spike":
-        return 4.0 if 0.45 <= frac < 0.55 else 1.0
+        return spike_mult if 0.45 <= frac < 0.55 else 1.0
     if pattern == "step":
         return 0.5 if frac < 0.5 else 1.5
     raise ValueError(f"unknown pattern {pattern!r} (want {sorted(PATTERN_PHASES)})")
@@ -558,6 +563,7 @@ def run_benchmark(
     otlp: bool = False,
     pattern: str | None = None,
     pattern_period_s: float = 60.0,
+    pattern_spike_mult: float = 4.0,
 ) -> dict:
     """Run the load test; returns the summary dict. Library entry point
     (benchmarks/routing_compare.py drives it per strategy). With
@@ -684,23 +690,46 @@ def run_benchmark(
     if pattern and request_rate <= 0:
         raise ValueError("--pattern requires a positive --request-rate to shape")
     phase_arrivals: dict[str, int] = {}
+    arrival_offsets: list[float] = []
+    if pattern:
+        # Precompute the whole run's arrival offsets by THINNING an
+        # inhomogeneous Poisson process (candidate stream at the curve's
+        # peak rate, accepted with probability rate(t)/rate_max), then
+        # pace the loop against ABSOLUTE target times below. Cumulative
+        # per-iteration sleeps drift under load — scheduler oversleep
+        # compounds, later phases compress, and a run can wrap its
+        # period so a burst window never sees its burst. Absolute
+        # pacing makes a late start eat into the NEXT gap instead.
+        rate_max = request_rate * max(
+            pattern_multiplier(pattern, f / 1000.0, pattern_spike_mult)
+            for f in range(1000)
+        )
+        t_off = 0.0
+        while len(arrival_offsets) < len(threads):
+            t_off += rng.expovariate(rate_max)
+            frac_off = (t_off / pattern_period_s) % 1.0
+            accept = pattern_multiplier(pattern, frac_off, pattern_spike_mult)
+            if rng.random() * rate_max <= request_rate * accept:
+                arrival_offsets.append(t_off)
     t0 = time.monotonic()
     for i, t in enumerate(threads):
         if pattern:
+            wait = t0 + arrival_offsets[i] - time.monotonic()
+            if wait > 0:
+                time.sleep(wait)
+            # Bucket by the ACTUAL start time, not the target: if the
+            # machine cannot keep up, arrivals honestly land late.
             frac = ((time.monotonic() - t0) / pattern_period_s) % 1.0
             ph = pattern_phase(pattern, frac)
             phase_arrivals[ph] = phase_arrivals.get(ph, 0) + 1
+            t.start()
+            continue
         t.start()
         if request_rate > 0 and i < len(threads) - 1:
             # Open-loop Poisson arrivals (exponential inter-arrival),
             # like the reference's benchmark_serving --request-rate. No
             # sleep after the last start — it would inflate elapsed.
-            # With --pattern the instantaneous rate follows the curve
-            # (an inhomogeneous Poisson process by rate re-sampling).
-            rate = request_rate
-            if pattern:
-                rate *= pattern_multiplier(pattern, frac)
-            time.sleep(rng.expovariate(max(rate, 1e-6)))
+            time.sleep(rng.expovariate(max(request_rate, 1e-6)))
     for t in threads:
         t.join()
     if flood_tenant and flood_at is not None:
@@ -865,22 +894,41 @@ def run_benchmark(
     # Per-phase arrival accounting for shaped runs: which part of the
     # curve each conversation landed in, plus the rate the curve
     # targeted mid-phase — the drill's ground truth for "the ramp
-    # peaked at X".
+    # peaked at X". Each phase also aggregates the TTFTs of the turns
+    # that STARTED inside its window (ttft_marks), so step drills can
+    # read the latency step right off the block (pre vs spike vs post).
     pattern_block = None
     if pattern:
+        phase_ttfts: dict[str, list[float]] = {}
+        for s in stats:
+            for t_turn, ttft in s.ttft_marks:
+                frac = ((t_turn - t0) / pattern_period_s) % 1.0
+                phase_ttfts.setdefault(pattern_phase(pattern, frac), []).append(ttft)
         pattern_block = {
             "name": pattern,
             "period_s": pattern_period_s,
             "base_rate_rps": request_rate,
+            "spike_mult": pattern_spike_mult if pattern == "spike" else None,
             "phases": [
                 {
                     "name": name,
                     "window_frac": [lo, hi],
                     "target_rate_rps": round(
                         request_rate
-                        * pattern_multiplier(pattern, (lo + hi) / 2), 3
+                        * pattern_multiplier(
+                            pattern, (lo + hi) / 2, pattern_spike_mult
+                        ), 3
                     ),
                     "arrivals": phase_arrivals.get(name, 0),
+                    "turns": len(phase_ttfts.get(name, [])),
+                    "ttft_p50_ms": (
+                        round(pct(phase_ttfts.get(name, []), 50) * 1000, 1)
+                        if phase_ttfts.get(name) else None
+                    ),
+                    "ttft_p99_ms": (
+                        round(pct(phase_ttfts.get(name, []), 99) * 1000, 1)
+                        if phase_ttfts.get(name) else None
+                    ),
                 }
                 for name, lo, hi in PATTERN_PHASES[pattern]
             ],
@@ -1018,6 +1066,12 @@ def main():
         help="seconds per pattern period (a compressed 'day')",
     )
     parser.add_argument(
+        "--spike-mult", type=float, default=4.0, metavar="X",
+        help="burst multiplier for --pattern spike (default 4x; "
+             "spike_drill raises it to model the 0->hundreds-of-req/s "
+             "flash crowd)",
+    )
+    parser.add_argument(
         "--otlp", action="store_true",
         help="export-bridge smoke: run an in-process OTLP stub collector "
              "and a client-side exporter for the duration of the run; "
@@ -1077,6 +1131,7 @@ def main():
         otlp=args.otlp,
         pattern=args.pattern,
         pattern_period_s=args.pattern_period,
+        pattern_spike_mult=args.spike_mult,
     )
     print(json.dumps(summary, indent=1))
 

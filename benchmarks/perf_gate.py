@@ -89,12 +89,14 @@ def validate(doc: dict) -> list[str]:
         errors.append("value must be > 0 for a successful run")
     num("p50_ttft_ms")
     num("mfu_pct")
-    for key in ("slo", "roofline", "rate_controlled", "disagg", "kv_restore", "forecast"):
+    for key in ("slo", "roofline", "rate_controlled", "disagg", "kv_restore",
+                "forecast", "spike"):
         if key in doc and not isinstance(doc[key], dict):
             errors.append(f"{key!r} must be an object when present")
     errors.extend(validate_disagg_block(doc.get("disagg")))
     errors.extend(validate_kv_restore_block(doc.get("kv_restore")))
     errors.extend(validate_forecast_block(doc.get("forecast")))
+    errors.extend(validate_spike_block(doc.get("spike")))
     return errors
 
 
@@ -213,6 +215,57 @@ def validate_forecast_block(block) -> list[str]:
                 f"forecast comparison {key!r} must be true — the guardrail "
                 "claims are part of the acceptance bar"
             )
+    return errors
+
+
+def validate_spike_block(block) -> list[str]:
+    """Schema check for the flash-crowd step comparison
+    (benchmarks/spike_drill.py; documented in BENCH_SCHEMA.md). The
+    block may ride a round's bench line (``spike`` key) or be the
+    ``comparison`` object of a standalone BENCH_spike.json.
+
+    The acceptance bar: the burst must actually have burst (achieved
+    spike-window arrival rate ≥ 2x base), nothing may have been shed
+    (failures == 0 — the bounded queues absorb the crowd), and the
+    quiet p99 TTFT must have recovered after the day drained — a spike
+    run that sheds or leaves latency residue proves the opposite of
+    what the artifact claims."""
+    if block is None or not isinstance(block, dict):
+        return []
+    comp = block.get("comparison", block)
+    errors: list[str] = []
+    if not isinstance(comp, dict):
+        return ["spike.comparison must be an object"]
+    nums = {}
+    for key in ("base_rate_rps", "spike_mult", "spike_rate_rps_achieved",
+                "ttft_p99_ms_before", "ttft_p99_ms_spike", "ttft_p99_ms_after"):
+        v = comp.get(key)
+        if isinstance(v, bool) or not isinstance(v, (int, float)) or v <= 0:
+            errors.append(f"spike comparison {key!r} must be a positive number")
+        else:
+            nums[key] = v
+    if "spike_mult" in nums and nums["spike_mult"] < 2:
+        errors.append(
+            "spike comparison spike_mult < 2 — a sub-2x 'burst' is not a "
+            "flash crowd"
+        )
+    if ("base_rate_rps" in nums and "spike_rate_rps_achieved" in nums
+            and nums["spike_rate_rps_achieved"] < 2 * nums["base_rate_rps"]):
+        errors.append(
+            "spike: the achieved spike-window arrival rate never reached "
+            "2x the base rate — the burst never burst"
+        )
+    fails = comp.get("failures")
+    if isinstance(fails, bool) or not isinstance(fails, (int, float)) or fails != 0:
+        errors.append(
+            "spike comparison 'failures' must be 0 — a burst the stack "
+            "shed is the autoscaler's problem, not an absorption proof"
+        )
+    if comp.get("recovered") is not True:
+        errors.append(
+            "spike comparison 'recovered' must be true — quiet p99 TTFT "
+            "must return to baseline once the day drains"
+        )
     return errors
 
 
@@ -358,6 +411,24 @@ def main(argv=None) -> int:
         print(json.dumps({
             "candidate": candidate_path,
             "verdict": "pass (kv_restore standalone: schema + claim ok)",
+            "comparison": candidate.get("comparison"),
+        }, indent=2))
+        return 0
+    if candidate.get("bench") == "spike":
+        # Standalone BENCH_spike.json: schema/claim gate only — the
+        # before/spike/after step lives inside the document.
+        errors = validate_spike_block(candidate)
+        if errors:
+            print(
+                f"perf-gate: {candidate_path} failed spike validation:",
+                file=sys.stderr,
+            )
+            for e in errors:
+                print(f"  - {e}", file=sys.stderr)
+            return 2
+        print(json.dumps({
+            "candidate": candidate_path,
+            "verdict": "pass (spike standalone: schema + claim ok)",
             "comparison": candidate.get("comparison"),
         }, indent=2))
         return 0

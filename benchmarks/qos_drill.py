@@ -43,6 +43,7 @@ import urllib.request
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmarks.loadgen import parse_priority_mix, run_benchmark  # noqa: E402
+from tests.leakcheck import assert_quiesced, thread_baseline  # noqa: E402
 
 from kubeai_tpu.api import model_types as mt  # noqa: E402
 from kubeai_tpu.api.core_types import KIND_POD  # noqa: E402
@@ -199,6 +200,9 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
 
         store.mutate(KIND_POD, pod.meta.name, forge)
         _await(lambda: lb.get_all_addresses(MODEL), msg="endpoint")
+        # Stack fully built: the end-of-drill quiesce check compares
+        # live non-daemon threads against this baseline.
+        threads_baseline = thread_baseline()
 
         convs = 3 if fast else 6
         floods = 5 if fast else 10
@@ -451,6 +455,11 @@ def run(fast: bool = False, verbose: bool = True) -> dict:
             "proxy_requests": qos_view["proxy_requests"],
             "storm_incident_id": storms[0]["id"],
         }
+        # -- check 4: the stack let go of everything it held ----------------
+        assert_quiesced(
+            [eng], lb=lb, model=MODEL, baseline_threads=threads_baseline
+        )
+        summary["quiesced"] = True
         summary["ok"] = True
         summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
         if verbose:

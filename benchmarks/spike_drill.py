@@ -1,0 +1,333 @@
+"""Spike drill: the dedicated flash-crowd scenario (ROADMAP item 4c)
+against a REAL serving stack — store → reconciler → balancer →
+proxy/OpenAI server → real (CPU) engines — driven by
+``loadgen --pattern spike`` with the burst multiplier turned up to the
+0→hundreds-of-req/s regime (compressed to what CPU test engines can
+absorb; the shape, not the absolute rate, is what the drill proves).
+
+The drill:
+
+1. measures a quiet **before** baseline: closed-loop conversations
+   through the full proxy→engine path, p99 TTFT with the fleet idle;
+2. replays one compressed spike "day" open-loop
+   (``--pattern spike --request-rate B --spike-mult M``): a flat pre
+   phase at B req/s, a 10%-of-period burst at B·M req/s, then a flat
+   post phase — loadgen's per-phase pattern block records arrivals and
+   TTFT percentiles for each phase (the **step artifact**: quiet p99 →
+   burst p99 → recovery p99 right off one JSON block);
+3. re-measures the SAME quiet baseline **after** the day drained;
+4. verifies the acceptance bar:
+   - **burst delivered** — the spike phase's achieved arrival rate is
+     at least 3x the base rate (a spike that never spiked proves
+     nothing);
+   - **nothing shed** — zero failures across all three runs: the burst
+     queues, it does not 5xx (engine queue bounds are sized to absorb
+     the whole burst; shedding is the autoscaler drill's territory);
+   - **recovery** — after-p99 TTFT returns to within 50% of before-p99
+     plus a small absolute grace (the scheduler-tick noise floor of
+     tiny CPU engines): the spike leaves no standing queue, no
+     retained slots, no latency residue;
+   - **quiesce** — the fleet let go of everything it held
+     (tests/leakcheck.py suite: drained engines, no breaker in-flight,
+     no leaked threads).
+
+``make spike-drill`` writes BENCH_spike.json (``bench: "spike"`` with a
+``comparison`` block validated by benchmarks/perf_gate.py — see
+benchmarks/BENCH_SCHEMA.md) plus a full summary under
+build/spike-drill/. ``--fast`` shrinks the day for smoke use. Exit 0 =
+every check passed.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmarks.loadgen import run_benchmark  # noqa: E402
+from benchmarks.qos_drill import _await  # noqa: E402
+from tests.leakcheck import assert_quiesced, thread_baseline  # noqa: E402
+
+from kubeai_tpu.api import model_types as mt  # noqa: E402
+from kubeai_tpu.api.core_types import KIND_POD  # noqa: E402
+from kubeai_tpu.api.model_types import Model, ModelSpec  # noqa: E402
+from kubeai_tpu.config.system import System  # noqa: E402
+from kubeai_tpu.controller.controller import ModelReconciler  # noqa: E402
+from kubeai_tpu.engine.core import EngineConfig, build_test_engine  # noqa: E402
+from kubeai_tpu.engine.sampling import SamplingParams  # noqa: E402
+from kubeai_tpu.engine.server import EngineServer  # noqa: E402
+from kubeai_tpu.loadbalancer.balancer import LoadBalancer  # noqa: E402
+from kubeai_tpu.metrics import default_registry  # noqa: E402
+from kubeai_tpu.proxy.handler import ModelProxy  # noqa: E402
+from kubeai_tpu.proxy.modelclient import ModelClient  # noqa: E402
+from kubeai_tpu.proxy.server import OpenAIServer  # noqa: E402
+from kubeai_tpu.runtime.store import ObjectMeta, Store  # noqa: E402
+
+MODEL = "spike-drill-model"
+
+# Same rationale as qos_drill.ABS_GRACE_S: a relative recovery bar
+# alone is meaningless at CPU-test-engine scale where the quiet p99 is
+# a few tens of ms — one scheduler tick of noise would fail it. The
+# grace is noise-floor headroom, not a license for a standing queue
+# (a burst that leaves requests queued blows through it immediately).
+ABS_GRACE_S = 0.35
+
+
+def run(fast: bool = False, verbose: bool = True) -> dict:
+    """Execute the drill; returns the summary dict (with a ``bench``
+    document under ``summary['bench_doc']``). Raises AssertionError on
+    a failed acceptance check."""
+    t_start = time.monotonic()
+    replicas = 2 if fast else 3
+    base_rate = 3.0 if fast else 4.0
+    spike_mult = 8.0 if fast else 12.0
+    period_s = 10.0 if fast else 24.0
+    # Size the day so open-loop arrivals span exactly ~one period: the
+    # mean multiplier over a spike period is 0.9·1 + 0.1·M.
+    conversations = int(base_rate * period_s * (0.9 + 0.1 * spike_mult))
+
+    store = Store()
+    system = System().default_and_validate()
+    system.allow_pod_address_override = True
+    rec = ModelReconciler(store, system)
+    rec.start()
+    lb = LoadBalancer(store, allow_pod_address_override=True)
+    lb.start()
+    mc = ModelClient(store)
+    proxy = ModelProxy(mc, lb, max_retries=2, await_timeout=30)
+    api = OpenAIServer(proxy, mc, host="127.0.0.1", port=0)
+    api.start()
+
+    engines = []
+    servers = []
+    for _ in range(replicas):
+        eng = build_test_engine(
+            engine_config=EngineConfig(
+                max_slots=2, max_seq_len=512, prefill_buckets=(32, 64, 128),
+                # Queue bounds sized to hold the WHOLE burst across the
+                # fleet: the acceptance bar is "queues, does not shed".
+                max_queue=128, decode_chunk=2,
+            )
+        )
+        eng.warmup()
+        srv = EngineServer(eng, MODEL, host="127.0.0.1", port=0)
+        srv.start()
+        engines.append(eng)
+        servers.append(srv)
+
+    summary: dict = {"fast": fast}
+    try:
+        engines[0].generate(
+            engines[0].tokenizer.encode("warm"),
+            SamplingParams(temperature=0.0, max_tokens=4),
+            timeout=180,
+        )
+        store.create(
+            mt.KIND_MODEL,
+            Model(
+                meta=ObjectMeta(name=MODEL),
+                spec=ModelSpec(
+                    url="hf://drill/model", resource_profile="cpu:1",
+                    replicas=replicas, min_replicas=replicas,
+                ),
+            ),
+        )
+        _await(
+            lambda: len(store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL})) == replicas,
+            msg="model pods",
+        )
+        pods = sorted(
+            store.list(KIND_POD, selector={mt.LABEL_MODEL: MODEL}),
+            key=lambda p: p.meta.name,
+        )
+        for pod, srv in zip(pods, servers):
+            def forge(p, port=srv.port):
+                p.status.ready = True
+                p.status.pod_ip = "127.0.0.1"
+                p.meta.annotations[mt.ANNOTATION_MODEL_POD_IP] = "127.0.0.1"
+                p.meta.annotations[mt.ANNOTATION_MODEL_POD_PORT] = str(port)
+            store.mutate(KIND_POD, pod.meta.name, forge)
+        _await(
+            lambda: len(lb.get_all_addresses(MODEL)) == replicas,
+            msg="all endpoints",
+        )
+        threads_baseline = thread_baseline()
+
+        def quiet_bench():
+            return run_benchmark(
+                f"http://127.0.0.1:{api.port}/openai",
+                MODEL,
+                conversations=6 if fast else 12,
+                turns=2,
+                max_tokens=6,
+                temperature=0.0,
+            )
+
+        # JIT-settle: run the quiet bench until the recompile counter
+        # stops moving so no mid-window compile pollutes a measurement.
+        compiles = default_registry.get("kubeai_engine_jit_recompiles_total")
+        prev = -1.0
+        for _ in range(4):
+            quiet_bench()
+            n = compiles.value()
+            if n == prev:
+                break
+            prev = n
+
+        # -- before: quiet fleet baseline ----------------------------------
+        before = quiet_bench()
+        assert before["failures"] == 0, f"baseline failures: {before['failures']}"
+        p99_before = before["ttft_ms"]["p99"] / 1000.0
+        summary["before"] = {
+            "requests": before["requests"],
+            "ttft_p99_ms": before["ttft_ms"]["p99"],
+        }
+
+        # -- the spike day: flat -> burst -> flat, open-loop ---------------
+        day = run_benchmark(
+            f"http://127.0.0.1:{api.port}/openai",
+            MODEL,
+            conversations=conversations,
+            turns=1,
+            max_tokens=6,
+            temperature=0.0,
+            request_rate=base_rate,
+            pattern="spike",
+            pattern_period_s=period_s,
+            pattern_spike_mult=spike_mult,
+            seed=7,
+        )
+        if verbose:
+            print(json.dumps(day["pattern"]), file=sys.stderr)
+        assert day["failures"] == 0, (
+            f"the spike shed traffic: {day['failures']} failures — the "
+            f"queue bounds did not absorb the burst"
+        )
+        phases = {p["name"]: p for p in day["pattern"]["phases"]}
+        for name in ("pre", "spike", "post"):
+            assert phases[name]["arrivals"] >= 1, f"no arrivals in {name} phase"
+            assert phases[name]["ttft_p99_ms"], f"no TTFT samples in {name} phase"
+        spike_window_s = 0.1 * period_s
+        spike_rate_achieved = phases["spike"]["arrivals"] / spike_window_s
+        assert spike_rate_achieved >= 3 * base_rate, (
+            f"the burst never burst: {spike_rate_achieved:.1f} req/s in the "
+            f"spike window vs {base_rate} base"
+        )
+        summary["day"] = {
+            "conversations": conversations,
+            "requests": day["requests"],
+            "elapsed_s": day["elapsed_s"],
+            "pattern": day["pattern"],
+            "spike_rate_rps_achieved": round(spike_rate_achieved, 2),
+        }
+
+        # -- after: the same quiet baseline once the day drained -----------
+        after = quiet_bench()
+        assert after["failures"] == 0, f"after-bench failures: {after['failures']}"
+        p99_after = after["ttft_ms"]["p99"] / 1000.0
+        summary["after"] = {
+            "requests": after["requests"],
+            "ttft_p99_ms": after["ttft_ms"]["p99"],
+        }
+
+        # -- recovery: the spike left no latency residue -------------------
+        bound = p99_before * 1.5 + ABS_GRACE_S
+        recovered = p99_after <= bound
+        assert recovered, (
+            f"p99 TTFT never recovered after the spike: "
+            f"{p99_after * 1000:.1f}ms vs before {p99_before * 1000:.1f}ms "
+            f"(bound {bound * 1000:.1f}ms)"
+        )
+
+        # -- quiesce: the fleet let go of everything it held ---------------
+        assert_quiesced(
+            engines, lb=lb, model=MODEL, baseline_threads=threads_baseline
+        )
+        summary["quiesced"] = True
+
+        summary["bench_doc"] = {
+            "bench": "spike",
+            "metric": "spike_ttft_p99_ms_step",
+            "comparison": {
+                "base_rate_rps": base_rate,
+                "spike_mult": spike_mult,
+                "spike_rate_rps_target": round(base_rate * spike_mult, 2),
+                "spike_rate_rps_achieved": round(spike_rate_achieved, 2),
+                "ttft_p99_ms_before": before["ttft_ms"]["p99"],
+                "ttft_p99_ms_spike": phases["spike"]["ttft_p99_ms"],
+                "ttft_p99_ms_after": after["ttft_ms"]["p99"],
+                "step_ratio": round(
+                    phases["spike"]["ttft_p99_ms"] / before["ttft_ms"]["p99"], 2
+                ),
+                "failures": 0,
+                "recovered": True,
+            },
+            "summary": {
+                "fast": fast,
+                "replicas": replicas,
+                "period_s": period_s,
+                "day_requests": day["requests"],
+                "phases": {
+                    name: {
+                        "arrivals": p["arrivals"],
+                        "target_rate_rps": p["target_rate_rps"],
+                        "ttft_p50_ms": p["ttft_p50_ms"],
+                        "ttft_p99_ms": p["ttft_p99_ms"],
+                    }
+                    for name, p in phases.items()
+                },
+            },
+        }
+        summary["ok"] = True
+        summary["wall_seconds"] = round(time.monotonic() - t_start, 1)
+        if verbose:
+            print(
+                f"spike drill: p99 TTFT {before['ttft_ms']['p99']:.0f}ms -> "
+                f"{phases['spike']['ttft_p99_ms']:.0f}ms under the "
+                f"{spike_mult:g}x burst ({spike_rate_achieved:.0f} req/s "
+                f"achieved) -> {after['ttft_ms']['p99']:.0f}ms recovered, "
+                f"{day['requests']} day requests, 0 shed"
+            )
+        return summary
+    finally:
+        for srv in servers:
+            srv.stop()
+        api.stop()
+        lb.stop()
+        rec.stop()
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser("spike-drill")
+    parser.add_argument("--fast", action="store_true", help="compressed smoke variant")
+    parser.add_argument(
+        "--json", default=os.path.join("build", "spike-drill", "summary.json"),
+        help="full summary path ('' to skip)",
+    )
+    parser.add_argument(
+        "--bench-json", default="BENCH_spike.json",
+        help="standalone bench document for perf_gate ('' to skip)",
+    )
+    args = parser.parse_args(argv)
+    try:
+        summary = run(fast=args.fast)
+    except AssertionError as e:
+        print(f"SPIKE DRILL FAILED: {e}", file=sys.stderr)
+        return 1
+    if args.json:
+        os.makedirs(os.path.dirname(args.json) or ".", exist_ok=True)
+        with open(args.json, "w") as f:
+            json.dump(summary, f, indent=1)
+    if args.bench_json:
+        with open(args.bench_json, "w") as f:
+            json.dump(summary["bench_doc"], f, indent=1)
+    print(json.dumps(summary["bench_doc"]))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

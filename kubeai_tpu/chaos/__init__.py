@@ -1,0 +1,33 @@
+"""Chaos campaign engine: seeded randomized multi-fault soak with
+global invariants and schedule shrinking (docs/robustness.md, "Chaos
+campaigns").
+
+- :mod:`.schedule` — FaultEvent/Schedule, the survivable fault
+  catalog, and the seeded generator (same (seed, episode) -> same
+  schedule, always).
+- :mod:`.campaign` — the episode loop over a live in-process stack
+  plus the global invariant suite.
+- :mod:`.invariants` — reusable quiesce/leak checks (also asserted by
+  the standing drills via tests/leakcheck.py).
+- :mod:`.shrink` — ddmin over fault schedules.
+- :mod:`.report` — CHAOS.json schema validation.
+"""
+
+from .campaign import ChaosCampaign, induced_schedule, stream_request
+from .invariants import quiesce_violations
+from .report import validate_chaos_doc
+from .schedule import FaultEvent, Schedule, generate_schedule, subsystem_of
+from .shrink import ddmin
+
+__all__ = [
+    "ChaosCampaign",
+    "FaultEvent",
+    "Schedule",
+    "ddmin",
+    "generate_schedule",
+    "induced_schedule",
+    "quiesce_violations",
+    "stream_request",
+    "subsystem_of",
+    "validate_chaos_doc",
+]

@@ -49,6 +49,7 @@ from kubeai_tpu.engine.sampling import (
     apply_penalties,
     sample,
 )
+from kubeai_tpu import faults
 from kubeai_tpu.faults import FaultError, fault
 from kubeai_tpu.engine import kvstate
 from kubeai_tpu.engine.tokenizer import IncrementalDetokenizer
@@ -1646,6 +1647,11 @@ class Engine:
         dispatched while a slot was still running an earlier request is
         reconciled via the per-dispatch slot snapshot."""
         log.info("engine loop started (slots=%d)", self.cfg.max_slots)
+        # Adopt this replica's failpoint scope (stamped by EngineServer
+        # before start): engine.step / engine.kv_export / engine.kv_import
+        # on this thread then fire @<port> twins so chaos schedules can
+        # fault ONE replica of a multi-replica in-process fleet.
+        faults.set_thread_scope(getattr(self, "fault_scope", None))
         pending = None  # (payload_device_refs, [(slot_idx, _Slot, epoch), ...])
         while self._running:
             try:

@@ -34,7 +34,7 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from kubeai_tpu.engine.core import Engine
 from kubeai_tpu.engine import kvstate
 from kubeai_tpu.engine.sampling import SamplingParams
-from kubeai_tpu.faults import FaultError, fault, handle_faults_request
+from kubeai_tpu.faults import FaultError, fault, handle_faults_request, set_thread_scope
 from kubeai_tpu.metrics import default_registry
 from kubeai_tpu.metrics.buildinfo import set_build_info
 from kubeai_tpu.obs import (
@@ -178,6 +178,10 @@ class EngineServer:
             # offers point resuming peers back at THIS server's
             # /v1/kv/<key> route.
             self.engine.kv_advertise = self.kv_advertise
+            # Per-replica failpoint scope: the scheduler thread adopts
+            # it so engine.step/kv_export/kv_import fire @<port> twins
+            # just like the handler threads' sites do.
+            self.engine.fault_scope = str(self.port)
             self.engine.start()
         self._thread = threading.Thread(target=self.httpd.serve_forever, daemon=True)
         self._thread.start()
@@ -284,6 +288,7 @@ class EngineServer:
                     raise ValueError("attach args must include --model")
                 engine, name = build_engine_from_args(a, warmup=warmup)
                 engine.kv_advertise = self.kv_advertise
+                engine.fault_scope = str(self.port)
                 engine.start()
                 with self._attach_lock:
                     self.model_name = name
@@ -390,6 +395,10 @@ def _make_handler(srv: EngineServer):
         # ---- routes ----
 
         def do_GET(self):
+            # Scope every failpoint fired on this handler thread to THIS
+            # replica's port: fault("X") also fires "X@<port>", so chaos
+            # schedules can target one replica of an in-process fleet.
+            set_thread_scope(srv.port)
             path, _, query = self.path.partition("?")
             if path in ("/health", "/healthz"):
                 body = {"status": "ok", "model": srv.model_name}
@@ -498,6 +507,7 @@ def _make_handler(srv: EngineServer):
                 self._error(404, f"no route {path}")
 
         def do_POST(self):
+            set_thread_scope(srv.port)  # per-replica failpoint twins
             path = self.path.split("?")[0]
             # Correlation id propagated by the proxy (X-Request-ID): one
             # grep finds a request's proxy AND engine log lines.
@@ -1128,12 +1138,11 @@ def _make_handler(srv: EngineServer):
                 # engine.stream=error:1:skip=N severs the response after
                 # the Nth SSE event left this replica — the chaos seam
                 # for mid-stream replica death (proxy replay under test).
+                # The thread's fault scope (set at do_POST entry) makes
+                # this also fire engine.stream@<port>, the per-replica
+                # twin (engine.stream@<port>=slow:... = one straggler
+                # in a multi-replica single-process drill fleet).
                 fault("engine.stream")
-                # Scoped twin: the fault registry is process-global, so
-                # a drill running SEVERAL replicas in one process needs
-                # a per-replica site to make just one of them misbehave
-                # (engine.stream@<port>=slow:... = one gray straggler).
-                fault(f"engine.stream@{srv.port}")
                 data = f"data: {payload}\n\n".encode()
                 self.wfile.write(f"{len(data):x}\r\n".encode() + data + b"\r\n")
                 self.wfile.flush()

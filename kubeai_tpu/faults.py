@@ -28,6 +28,21 @@ Modes (``spec`` grammar: ``mode[:arg][:key=val...]``):
 - ``corrupt``       mangle a ``bytes`` payload passed to ``fault(...,
   payload=...)`` (bitwise-inverted; length preserved). Non-bytes payloads
   pass through unchanged.
+- ``flap:PERIOD[:DUTY]`` arm/disarm cyclically: raise ``FaultError``
+  during the on-phase of a PERIOD-second cycle (the first DUTY fraction,
+  default 0.5), pass through during the off-phase. The phase anchors at
+  arm time, so a flapping replica is deterministic relative to the arm —
+  the chaos scheduler's partial-failure primitive (a replica that is
+  intermittently dead flushes out breaker half-open × ladder races the
+  steady ``error`` mode can't reach).
+
+Scoped twins: every site also fires a ``name@SCOPE`` twin when the
+calling thread has a fault scope set (``set_thread_scope``). Engine
+server handler threads and the engine scheduler thread set their scope
+to the server's port, so ``engine.stream@8035`` (or any other site
+``@PORT``) degrades ONE replica of a multi-replica in-process fleet
+while its siblings stay healthy. Arming the bare name hits every
+replica; arming ``name@PORT`` hits only that one.
 
 The registry is intentionally tiny and dependency-free; when nothing is
 armed, a failpoint costs one dict lookup on an empty dict.
@@ -41,10 +56,11 @@ Known sites (grep ``fault(`` for ground truth):
     engine.stream        before each SSE event the engine server writes
                          (error:1:skip=N = kill-after-N-tokens: the
                          response socket is severed like a dead replica)
-    engine.stream@PORT   scoped twin of engine.stream, fired per event by
-                         the replica listening on PORT only — lets a
-                         drill running several replicas in ONE process
-                         (shared registry) degrade a single straggler
+    <site>@PORT          scoped twin of ANY engine-side site, fired only
+                         by the replica listening on PORT — lets a drill
+                         running several replicas in ONE process (shared
+                         registry) degrade a single straggler
+                         (engine.stream@PORT, engine.kv_export@PORT, ...)
     engine.kv_export     KV park serialization (payload: the encoded
                          blob — ``corrupt`` stores a mangled blob the
                          import's checksums must reject; ``error``
@@ -59,6 +75,12 @@ Known sites (grep ``fault(`` for ground truth):
     gang.follower        each follower recv (follower-drop: dead-peer
                          error exercising reconnect-with-backoff)
     weights.load         checkpoint loading
+    history.disk         telemetry flight-recorder persistence (the
+                         7-day on-disk ring) — ``error`` makes the
+                         save fail like a full/broken disk; the store
+                         must keep serving from memory
+    incidents.disk       incident-snapshot persistence — same disk-
+                         fault containment contract as history.disk
 """
 
 from __future__ import annotations
@@ -73,6 +95,25 @@ log = logging.getLogger("kubeai_tpu.faults")
 _lock = threading.Lock()
 _active: dict[str, "_Fault"] = {}
 
+# Per-thread fault scope. A thread owned by one replica of an
+# in-process fleet (an engine server's handler thread, the engine's
+# scheduler thread) sets its scope to that replica's port; fault(name)
+# then also fires the "name@scope" twin, so ANY site can be armed
+# against a single replica without the call sites knowing about scoping.
+_tls = threading.local()
+
+
+def set_thread_scope(scope: str | None) -> None:
+    """Set (or clear, with None/"") the calling thread's fault scope.
+    While set, every ``fault(name)`` on this thread also fires the
+    ``name@scope`` twin — the generalization of the old hand-rolled
+    ``engine.stream@PORT`` site to every registered failpoint."""
+    _tls.scope = str(scope) if scope else None
+
+
+def get_thread_scope() -> str | None:
+    return getattr(_tls, "scope", None)
+
 
 class FaultError(ConnectionError, RuntimeError):
     """Raised by an armed ``error`` failpoint. Subclasses ConnectionError
@@ -86,19 +127,20 @@ class FaultError(ConnectionError, RuntimeError):
 
 
 class _Fault:
-    __slots__ = ("name", "mode", "arg", "arg2", "times", "skip", "max_s", "hits", "fired", "release")
+    __slots__ = ("name", "mode", "arg", "arg2", "times", "skip", "max_s", "hits", "fired", "release", "armed_at")
 
     def __init__(self, name: str, mode: str, arg: float | None, times: int | None, skip: int, max_s: float | None, arg2: float | None = None):
         self.name = name
         self.mode = mode
         self.arg = arg
-        self.arg2 = arg2  # second positional (slow: jitter ms)
+        self.arg2 = arg2  # second positional (slow: jitter ms; flap: duty)
         self.times = times  # None = unlimited
         self.skip = skip
         self.max_s = max_s
         self.hits = 0  # triggers observed (incl. skipped)
         self.fired = 0  # triggers that actually acted
         self.release = threading.Event()  # set on clear: unhangs waiters
+        self.armed_at = time.monotonic()  # flap phase anchor
 
     def describe(self) -> dict:
         return {
@@ -155,8 +197,13 @@ def parse_spec(name: str, spec: str) -> _Fault:
     elif mode == "corrupt":
         if arg is not None:
             times = int(arg)
+    elif mode == "flap":
+        if arg is None or arg <= 0:
+            raise ValueError(f"flap fault needs a positive period in seconds: {spec!r}")
+        if arg2 is not None and not (0.0 < arg2 < 1.0):
+            raise ValueError(f"flap duty must be in (0, 1): {spec!r}")
     else:
-        raise ValueError(f"unknown fault mode {mode!r} (error|delay|slow|hang|corrupt)")
+        raise ValueError(f"unknown fault mode {mode!r} (error|delay|slow|hang|corrupt|flap)")
     return _Fault(name, mode, arg, times, skip, max_s, arg2=arg2)
 
 
@@ -164,10 +211,12 @@ def set_fault(name: str, mode: str, *, times: int | None = None, skip: int = 0,
               delay: float | None = None, max_s: float | None = None) -> None:
     """Arm *mode* on failpoint *name* (replacing any armed fault there)."""
     f = _Fault(name, mode, delay, times, skip, max_s)
-    if mode in ("delay", "slow") and delay is None:
-        raise ValueError(f"{mode} fault needs delay= (seconds for delay, ms for slow)")
-    if mode not in ("error", "delay", "slow", "hang", "corrupt"):
+    if mode in ("delay", "slow", "flap") and delay is None:
+        raise ValueError(f"{mode} fault needs delay= (seconds for delay/flap, ms for slow)")
+    if mode not in ("error", "delay", "slow", "hang", "corrupt", "flap"):
         raise ValueError(f"unknown fault mode {mode!r}")
+    if mode == "flap":
+        f.arg = delay
     if mode in ("delay", "slow"):
         f.arg = delay
     with _lock:
@@ -240,9 +289,20 @@ def load_env(env: str | None = None) -> int:
 def fault(name: str, payload=None):
     """The failpoint. Returns *payload* (possibly corrupted); raises
     FaultError / sleeps / hangs per the armed fault. No-op (one dict
-    lookup) when nothing is armed on *name*."""
+    lookup) when nothing is armed on *name*. Also fires the
+    ``name@scope`` twin when the calling thread has a fault scope set
+    (see ``set_thread_scope``) — bare-name arms hit every replica,
+    scoped arms hit one."""
     if not _active:  # fast path: nothing armed anywhere
         return payload
+    payload = _fire(name, payload)
+    scope = getattr(_tls, "scope", None)
+    if scope and "@" not in name:
+        payload = _fire(f"{name}@{scope}", payload)
+    return payload
+
+
+def _fire(name: str, payload):
     with _lock:
         f = _active.get(name)
         if f is None:
@@ -252,11 +312,21 @@ def fault(name: str, payload=None):
             return payload
         if f.times is not None and f.fired >= f.times:
             return payload
+        if f.mode == "flap":
+            # On-phase = the first DUTY fraction of each PERIOD-second
+            # cycle, anchored at arm time. Off-phase passes through
+            # WITHOUT consuming the times budget — the budget counts
+            # injected failures, not wall-clock polls.
+            period = float(f.arg or 1.0)
+            duty = float(f.arg2) if f.arg2 is not None else 0.5
+            phase = ((time.monotonic() - f.armed_at) / period) % 1.0
+            if phase >= duty:
+                return payload
         f.fired += 1
         mode, arg, arg2, max_s, release = f.mode, f.arg, f.arg2, f.max_s, f.release
         fired = f.fired
     # Act OUTSIDE the lock: a hang/delay must not block other failpoints.
-    if mode == "error":
+    if mode in ("error", "flap"):
         raise FaultError(name)
     if mode == "delay":
         time.sleep(float(arg or 0.0))

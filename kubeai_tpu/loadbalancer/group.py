@@ -134,6 +134,14 @@ class Endpoint:
     weight: float = 1.0
     warmup_started: float | None = None
     stats: LatencyStats = field(default_factory=LatencyStats)
+    # Flap defense: when this endpoint last closed its breaker (None =
+    # never ejected, or stable long enough to forget), and how many
+    # times it re-ejected shortly after a readmission. The streak
+    # escalates the probe cooldown geometrically so a replica flapping
+    # faster than the cooldown converges to ejected instead of winning
+    # a probe (and real traffic) every cycle.
+    readmitted_at: float | None = None
+    reopen_streak: int = 0
 
 
 class EndpointGroup:
@@ -150,6 +158,7 @@ class EndpointGroup:
         max_eject_fraction: float | None = None,
         slow_start_window: float | None = None,
         probe_jitter: float | None = None,
+        breaker_cooldown_max: float | None = None,
     ):
         """*breaker_threshold* consecutive failed attempts eject an
         endpoint for *breaker_cooldown* seconds; after the cooldown it
@@ -169,7 +178,11 @@ class EndpointGroup:
         itself entirely (the PR 3 fail-open invariant, now for latency);
         *slow_start_window* — warmup ramp for new/readmitted endpoints;
         *probe_jitter* — spread fraction applied to half-open cooldowns
-        so a burst-ejected fleet doesn't re-probe in lockstep."""
+        so a burst-ejected fleet doesn't re-probe in lockstep;
+        *breaker_cooldown_max* — ceiling for the flap-escalated probe
+        cooldown (re-ejections shortly after readmission double the
+        effective cooldown up to this cap, so a flapping replica is
+        quarantined geometrically instead of oscillating)."""
         self._lock = threading.Lock()
         self._cond = threading.Condition(self._lock)
         self._endpoints: dict[str, Endpoint] = {}
@@ -193,6 +206,9 @@ class EndpointGroup:
             slow_start_window, "KUBEAI_SLOW_START_WINDOW", 10.0
         )
         self.probe_jitter = resolve_knob(probe_jitter, "KUBEAI_PROBE_JITTER", 0.25)
+        self.breaker_cooldown_max = resolve_knob(
+            breaker_cooldown_max, "KUBEAI_BREAKER_COOLDOWN_MAX", 60.0
+        )
         self._last_score = self._clock()
         self._fleet_median_p95: float | None = None
         self._scoring_disabled_reason: str | None = None
@@ -556,6 +572,7 @@ class EndpointGroup:
             self._set_state(ep, BREAKER_SOFT_EJECTED)
             ep.opened_at = now
             ep.probe_started = None
+            self._note_reopen(ep, now)
             self._soft_ejections += 1
             _M_SOFT_EJECTIONS.inc(labels={"endpoint": ep.address})
             publish_trigger(
@@ -668,16 +685,53 @@ class EndpointGroup:
             },
         )
 
+    def _stable_window(self) -> float:
+        """How long an endpoint must hold CLOSED after readmission before
+        a subsequent ejection counts as fresh bad luck instead of a
+        flap continuation (and before the reopen streak resets)."""
+        return 2.0 * self.breaker_cooldown
+
+    def _note_reopen(self, ep: Endpoint, now: float) -> None:
+        """Bookkeep an open/soft-eject transition for flap escalation
+        (lock held). Re-ejection within the stable window of the last
+        readmission extends the streak. Anything else leaves the streak
+        UNCHANGED — in particular a failed half-open probe, where the
+        endpoint spent the whole interval ejected: time spent open
+        proves nothing about stability, so it must not forgive a
+        flapper mid-quarantine. Forgiveness happens only on the success
+        path, after the endpoint HOLDS closed through the stable
+        window (see report_result)."""
+        if (
+            ep.readmitted_at is not None
+            and now - ep.readmitted_at < self._stable_window()
+        ):
+            ep.reopen_streak += 1
+            # One strike per readmission cycle: the follow-on probe
+            # failures of this same quarantine don't double-count.
+            ep.readmitted_at = None
+
     def _probe_cooldown(self, ep: Endpoint) -> float:
         """Cooldown before *ep* may half-open, with a deterministic
         per-endpoint spread: endpoints ejected in the same burst would
         otherwise all re-probe at the same instant across every model
         (synchronized probe storms against a recovering backend). The
         jitter is a stable hash of the address, so tests with a fake
-        clock can predict it and restarts don't reshuffle it."""
-        return self.breaker_cooldown * (
+        clock can predict it and restarts don't reshuffle it.
+
+        A reopen streak (re-ejections shortly after readmission — a
+        FLAPPING replica) doubles the cooldown per strike, capped at
+        breaker_cooldown_max: without this, a replica flapping faster
+        than the base cooldown wins a half-open probe during every
+        healthy phase and keeps re-entering the pick rotation."""
+        base = self.breaker_cooldown * (
             1.0 + self.probe_jitter * endpoint_jitter(ep.address)
         )
+        if ep.reopen_streak > 0:
+            # The cap never shrinks the base cooldown (a group tuned to
+            # a long base, e.g. the drills' 300s, keeps it).
+            cap = max(self.breaker_cooldown_max, base)
+            base = min(base * (2.0 ** min(ep.reopen_streak, 16)), cap)
+        return base
 
     def _breaker_allows(self, ep: Endpoint, now: float) -> bool:
         """Whether the breaker lets a NEW request pick *ep* (lock held).
@@ -734,10 +788,21 @@ class EndpointGroup:
                 if ep.breaker_state != BREAKER_CLOSED:
                     self._set_state(ep, BREAKER_CLOSED)
                     ep.probe_started = None
+                    # Stamp the readmission: a re-ejection inside the
+                    # stable window marks this endpoint as flapping and
+                    # escalates its next cooldown (_note_reopen).
+                    ep.readmitted_at = now
                     # Readmission gets a slow-start ramp, not an
                     # instant full share — a cold/recovering replica
                     # at full LeastLoad weight can re-trip itself.
                     self._start_warmup(ep, now)
+                elif (
+                    ep.readmitted_at is not None
+                    and now - ep.readmitted_at >= self._stable_window()
+                ):
+                    # Held CLOSED through the stable window: forgiven.
+                    ep.readmitted_at = None
+                    ep.reopen_streak = 0
                 return
             ep.consecutive_failures += 1
             if (
@@ -750,6 +815,7 @@ class EndpointGroup:
                 self._set_state(ep, BREAKER_OPEN)
                 ep.opened_at = now
                 ep.probe_started = None
+                self._note_reopen(ep, now)
                 _M_EJECTIONS.inc(labels={"endpoint": ep.address})
                 publish_trigger(
                     "breaker_ejection", model=self.name,
@@ -760,10 +826,13 @@ class EndpointGroup:
                     },
                 )
             elif ep.breaker_state == BREAKER_HALF_OPEN:
-                # The probe failed: straight back to ejected.
+                # The probe failed: straight back to ejected, with the
+                # flap streak noted — repeated probe failures right
+                # after readmissions are the oscillation signature.
                 self._set_state(ep, BREAKER_OPEN)
                 ep.opened_at = now
                 ep.probe_started = None
+                self._note_reopen(ep, now)
                 _M_EJECTIONS.inc(labels={"endpoint": ep.address})
                 # Incident trigger (enqueue-only — safe under _cond): a
                 # failed half-open probe means the endpoint is STILL
@@ -783,6 +852,7 @@ class EndpointGroup:
             ):
                 self._set_state(ep, BREAKER_OPEN)
                 ep.opened_at = now
+                self._note_reopen(ep, now)
                 _M_EJECTIONS.inc(labels={"endpoint": ep.address})
                 publish_trigger(
                     "breaker_ejection", model=self.name,
@@ -814,6 +884,10 @@ class EndpointGroup:
                     ),
                     "weight": round(ep.weight, 3),
                     "warming": ep.warmup_started is not None,
+                    # Flap evidence: >0 means this endpoint re-ejected
+                    # within the stable window of a readmission and its
+                    # probe cooldown is escalated accordingly.
+                    "reopen_streak": ep.reopen_streak,
                 }
                 for name, ep in sorted(self._endpoints.items())
             ]

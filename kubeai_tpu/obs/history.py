@@ -49,6 +49,7 @@ import time
 from collections import deque
 from urllib.parse import parse_qs
 
+from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics.registry import (
     CallbackGauge,
     Counter,
@@ -422,6 +423,10 @@ class HistoryStore:
         final = os.path.join(self.history_dir, f"history-{int(now * 1000):013d}.json")
         tmp = final + ".tmp"
         try:
+            # Failpoint history.disk: FaultError is an OSError, so an
+            # armed disk fault lands in the containment branch below —
+            # the exact full/broken-disk degradation path under test.
+            fault("history.disk")
             os.makedirs(self.history_dir, exist_ok=True)
             with open(tmp, "w") as f:
                 json.dump(doc, f)

@@ -62,6 +62,7 @@ import threading
 import time
 from collections import deque
 
+from kubeai_tpu.faults import fault
 from kubeai_tpu.metrics.registry import Counter, default_registry
 from kubeai_tpu.utils import env_float
 
@@ -473,6 +474,10 @@ class IncidentRecorder:
         final = os.path.join(self.incident_dir, f"incident-{doc['id']}.json")
         tmp = final + ".tmp"
         try:
+            # Failpoint incidents.disk: FaultError is an OSError, so an
+            # armed disk fault exercises the memory-only degradation
+            # below exactly like a full disk during an incident storm.
+            fault("incidents.disk")
             os.makedirs(self.incident_dir, exist_ok=True)
             with open(tmp, "w") as f:
                 json.dump(doc, f)

@@ -1,0 +1,46 @@
+"""Reusable end-of-drill quiesce assertion.
+
+Every standing drill (qos_drill, gray_drill, incident_drill) ends by
+proving the stack it hammered actually LET GO: engines drained, no
+retained slots/queue entries/KV pages, no breaker in-flight
+accounting, no leaked non-daemon threads. The checks live in
+``kubeai_tpu.chaos.invariants`` (the chaos campaign asserts the same
+suite after every episode); this wrapper turns the violation list into
+one AssertionError with every leak named, so a drill that passes its
+own acceptance but leaks resources still fails loudly.
+
+Usage (drills are run with the repo root on sys.path, so ``tests`` is
+importable as a namespace package)::
+
+    from tests.leakcheck import assert_quiesced
+
+    baseline = thread_baseline()     # after the stack is built/settled
+    ...
+    assert_quiesced([eng], lb=lb, model=MODEL, baseline_threads=baseline)
+"""
+
+from __future__ import annotations
+
+from kubeai_tpu.chaos.invariants import nondaemon_threads, quiesce_violations
+
+
+def thread_baseline() -> set[str]:
+    """Capture the non-daemon thread set once the stack under test is
+    fully built — the reference assert_quiesced compares against."""
+    return nondaemon_threads()
+
+
+def assert_quiesced(engines, lb=None, model: str | None = None,
+                    baseline_threads: set[str] | None = None,
+                    drain_timeout: float = 20.0) -> None:
+    """Assert the full leak suite; empty violation list or AssertionError
+    naming every leak."""
+    violations = quiesce_violations(
+        engines, lb=lb, model=model,
+        baseline_threads=baseline_threads,
+        drain_timeout=drain_timeout,
+    )
+    assert not violations, (
+        "stack failed to quiesce after the drill:\n  - "
+        + "\n  - ".join(violations)
+    )

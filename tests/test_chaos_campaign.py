@@ -1,0 +1,148 @@
+"""Tier-1 fast variant of the chaos campaign (benchmarks/chaos_soak.py).
+
+Three layers, cheapest first:
+
+1. Pure schedule/shrinker units — determinism, JSON round-trip, ddmin.
+2. A 10-episode fixed-seed soak against one real stack (2 CPU replicas,
+   6 requests/episode): must come back with ZERO invariant violations
+   and a schema-valid CHAOS doc. This is the drift guard for the full
+   `make chaos-soak` — if the fast seed goes red here, the 200-episode
+   soak is red too.
+3. The violation pipeline proven end to end on an induced unsurvivable
+   schedule: detected -> ddmin-shrunk to the minimal repro (the chaff
+   stripped) -> the reduced schedule still reproduces on replay.
+"""
+
+from __future__ import annotations
+
+import pytest
+
+from kubeai_tpu.chaos.campaign import ChaosCampaign, induced_schedule
+from kubeai_tpu.chaos.report import validate_chaos_doc
+from kubeai_tpu.chaos.schedule import (
+    FaultEvent,
+    Schedule,
+    generate_schedule,
+    subsystem_of,
+)
+from kubeai_tpu.chaos.shrink import ddmin
+
+SEED = 1
+
+
+# -- pure units -----------------------------------------------------------
+
+
+def test_schedule_generation_is_deterministic():
+    a = generate_schedule(SEED, 7, 3)
+    b = generate_schedule(SEED, 7, 3)
+    assert a.to_dict() == b.to_dict()
+    # Different episodes of the same seed draw different chaos.
+    c = generate_schedule(SEED, 8, 3)
+    assert a.to_dict() != c.to_dict()
+
+
+def test_schedule_json_round_trip():
+    sched = generate_schedule(SEED, 3, 2)
+    back = Schedule.from_dict(sched.to_dict())
+    assert back.to_dict() == sched.to_dict()
+    assert back.sites() == sched.sites()
+
+
+def test_scope_placeholders_resolve_to_fleet_ports():
+    sched = generate_schedule(SEED, 0, 2)
+    ports = [8101, 8102]
+    for ev in sched.events:
+        resolved = ev.resolve_site(ports)
+        assert "@r" not in resolved
+        if "@" in ev.site:
+            assert int(resolved.split("@", 1)[1]) in ports
+
+
+def test_generated_schedules_stay_inside_the_catalog():
+    # Every site the generator can draw must be a real subsystem-mapped
+    # failpoint, lethal events must be replica-scoped singletons, and
+    # the episode-wide pre-stream error budget must never reach the
+    # proxy's attempt count (seed 1 episode 29 regression: two benign
+    # error sites composing to 4 consumed all 3 attempts of one request
+    # and surfaced an unearned 502).
+    from kubeai_tpu.chaos.schedule import ATTEMPT_ERROR_BUDGET, _attempts_consumed
+
+    for ep in range(200):
+        sched = generate_schedule(SEED, ep, 3)
+        lethal = [e for e in sched.events
+                  if e.site.startswith("engine.stream")
+                  and ("error" in e.spec or "flap" in e.spec)]
+        assert len(lethal) <= 1
+        consumed = sum(_attempts_consumed(e) for e in sched.events)
+        # Lethal severs spend from the same per-request attempt pool as
+        # benign connect/submit errors (episodes 29 + 98 regressions).
+        assert consumed <= (0 if lethal else ATTEMPT_ERROR_BUDGET), (
+            sched.describe()
+        )
+        for ev in sched.events:
+            assert subsystem_of(ev.site) != "unknown", ev.site
+            if ev.site.split("@")[0] == "engine.step" and "error" in ev.spec:
+                assert "@" in ev.site, "lethal event must be replica-scoped"
+
+
+def test_ddmin_strips_chaff():
+    culprit = FaultEvent("proxy.connect", "error:999", at=0.0)
+    chaff = [FaultEvent("history.disk", "error:2", at=0.0),
+             FaultEvent("incidents.disk", "flap:0.2", at=0.0, duration=0.5),
+             FaultEvent("balancer.reconcile", "error:2", at=0.0)]
+    events = chaff[:2] + [culprit] + chaff[2:]
+    reduced, runs = ddmin(events, lambda evs: culprit in evs, max_runs=30)
+    assert reduced == [culprit]
+    assert runs <= 30
+
+
+def test_validate_chaos_doc_rejects_malformed():
+    assert validate_chaos_doc([]) == ["CHAOS doc is not an object"]
+    problems = validate_chaos_doc({"bench": "chaos"})
+    assert any(p.startswith("missing key") for p in problems)
+
+
+# -- one real stack for the live tests ------------------------------------
+
+
+@pytest.fixture(scope="module")
+def campaign():
+    with ChaosCampaign(episodes=10, seed=SEED, replicas=2,
+                       requests_per_episode=6, verbose=False) as c:
+        yield c
+
+
+def test_fast_soak_runs_clean(campaign):
+    doc = campaign.run()
+    assert doc["violations"] == [], (
+        "fast fixed-seed soak tripped invariants — replay with:\n  "
+        + "\n  ".join(v["replay"] for v in doc["violations"])
+    )
+    assert validate_chaos_doc(doc, min_episodes=10, require_clean=True) == []
+    # 10 episodes must actually exercise the fault plane, not no-op.
+    assert doc["sites_fired"], "no fault site fired in 10 episodes"
+    assert doc["degradation"]["episodes_with_faults_fired"] >= 5
+
+
+def test_induced_violation_detected_shrunk_and_replayable(campaign):
+    sched = induced_schedule(SEED)
+    res = campaign.run_episode(sched)
+    assert res["violations"], "induced unsurvivable schedule ran clean"
+
+    reduced, runs = campaign.shrink(sched)
+    assert 1 <= len(reduced) <= 3, reduced
+    assert any(e.site == "proxy.connect" for e in reduced), (
+        f"shrinker lost the culprit: {[e.site for e in reduced]}"
+    )
+    # The minimal schedule is a real repro: replaying it still violates.
+    replay = Schedule(seed=SEED, episode=-1, events=reduced)
+    assert campaign.run_episode(replay)["violations"]
+
+
+def test_benign_episode_replays_clean(campaign):
+    # Seed replay of a clean episode is the other half of the repro
+    # contract: same seed + episode -> same schedule -> same (clean)
+    # verdict.
+    sched = generate_schedule(SEED, 0, campaign.replicas)
+    assert campaign.run_episode(sched)["violations"] == []

@@ -1,0 +1,110 @@
+"""Drift guard: failpoint sites in code <-> docs/robustness.md matrix.
+
+Three surfaces must agree on the set of fault-injection sites:
+
+1. **Code** — every ``fault("<site>")`` call threaded through
+   ``kubeai_tpu/`` (found by AST walk, so renames and additions are
+   caught without any registration list to maintain).
+2. **Docs** — the Failpoint column of the failure-mode matrix in
+   docs/robustness.md. A site the docs don't map to a failure mode is
+   an undocumented kill switch; a documented site with no code behind
+   it is a runbook lying to the operator.
+3. **Chaos** — ``kubeai_tpu.chaos.schedule.SUBSYSTEM_OF``, the
+   coverage map CHAOS.json reports against. A site missing there would
+   silently count as subsystem "unknown" in soak coverage floors.
+
+Modeled on tests/test_metrics_lint.py (the metrics <-> docs lint).
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+
+ROOT = Path(__file__).resolve().parent.parent
+PKG = ROOT / "kubeai_tpu"
+DOC = ROOT / "docs" / "robustness.md"
+
+# `lowercase.lowercase` optionally `@scope`, the whole backticked token.
+# The case rule keeps incidental tokens like `queue.Full` out, and the
+# full-token anchor keeps file paths like `tests/test_faults_lint.py`
+# from matching on their suffix.
+_SITE_RE = re.compile(r"`([a-z_]+\.[a-z_]+(?:@\w+)?)`")
+
+
+def _code_sites() -> dict[str, list[str]]:
+    """site -> ["path:line", ...] for every fault(<str literal>) call."""
+    sites: dict[str, list[str]] = {}
+    for path in sorted(PKG.rglob("*.py")):
+        tree = ast.parse(path.read_text(), filename=str(path))
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call):
+                continue
+            func = node.func
+            name = (
+                func.id
+                if isinstance(func, ast.Name)
+                else func.attr if isinstance(func, ast.Attribute) else None
+            )
+            if name != "fault" or not node.args:
+                continue
+            arg = node.args[0]
+            if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+                where = f"{path.relative_to(ROOT)}:{node.lineno}"
+                sites.setdefault(arg.value, []).append(where)
+    return sites
+
+
+def _matrix_section() -> str:
+    text = DOC.read_text()
+    start = text.index("## Failure-mode matrix")
+    end = text.index("\n## ", start + 1)
+    return text[start:end]
+
+
+def _doc_sites() -> set[str]:
+    return set(_SITE_RE.findall(_matrix_section()))
+
+
+def test_every_code_failpoint_documented_in_matrix():
+    code = _code_sites()
+    assert code, "AST scan found no fault() sites — the scan itself broke"
+    doc = _doc_sites()
+    missing = {s: code[s] for s in sorted(set(code) - doc)}
+    assert not missing, (
+        "failpoint sites in code missing from the docs/robustness.md "
+        f"failure-mode matrix Failpoint column: {missing} — add a row "
+        "(or extend an existing row's Failpoint cell) for each"
+    )
+
+
+def test_every_documented_failpoint_exists_in_code():
+    code = set(_code_sites())
+    stale = sorted(self_site for self_site in _doc_sites() if self_site not in code)
+    assert not stale, (
+        "docs/robustness.md matrix names failpoint sites with no "
+        f"fault() call behind them: {stale} — fix the docs or restore "
+        "the site"
+    )
+
+
+def test_chaos_subsystem_map_covers_every_site():
+    from kubeai_tpu.chaos.schedule import SUBSYSTEM_OF
+
+    code = set(_code_sites())
+    unmapped = sorted(code - set(SUBSYSTEM_OF))
+    assert not unmapped, (
+        "fault() sites absent from chaos SUBSYSTEM_OF (would report as "
+        f"subsystem 'unknown' in CHAOS.json coverage): {unmapped}"
+    )
+    orphaned = sorted(set(SUBSYSTEM_OF) - code)
+    assert not orphaned, (
+        f"chaos SUBSYSTEM_OF maps sites that no longer exist: {orphaned}"
+    )
+
+
+def test_matrix_intro_promises_this_lint():
+    # The matrix intro tells readers this file keeps the column honest;
+    # keep that pointer itself from drifting.
+    assert "tests/test_faults_lint.py" in _matrix_section()

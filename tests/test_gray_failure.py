@@ -491,3 +491,114 @@ def test_gray_drill_fast():
     assert summary["batch"]["straggler_served"] >= 1
     assert summary["surfaces"]["soft_ejections_total"] >= 1
     assert summary["surfaces"]["incident_id"]
+
+
+# ---------------------------------------------------------------------------
+# Flap defense: breaker half-open x gray-ladder under a flapping replica
+# (the faults.py `flap:PERIOD` primitive's phase arithmetic, driven off
+# the group's fake clock so the oscillation is deterministic). A replica
+# that flaps FASTER than the probe cooldown used to win a half-open
+# probe during every healthy phase and re-enter the pick rotation
+# forever; the reopen-streak cooldown escalation must converge it to
+# ejected with a bounded number of readmissions.
+
+
+class TestFlapEscalation:
+    def _flap_down(self, t, period=3.7, duty=0.5):
+        # Same phase rule as faults.py flap: on-phase (= injecting
+        # failures) during the first DUTY fraction of each cycle. The
+        # period deliberately doesn't divide any cooldown, so probes
+        # sweep across phases instead of phase-locking.
+        return (t / period) % 1.0 < duty
+
+    def test_flapping_replica_converges_to_ejected(self):
+        g, clk = mk_group(
+            n=2, breaker_threshold=1, breaker_cooldown=5.0,
+            outlier_k=0.0, breaker_cooldown_max=160.0,
+        )
+        picks_b = []
+        readmissions = 0
+        prev = BREAKER_CLOSED
+        for _ in range(1200):  # 600 s of 0.5 s steps, 4 requests each
+            t = clk[0]
+            for _ in range(4):
+                addr, done = g.get_best_addr(timeout=1.0)
+                done()
+                ok = True if addr == A else not self._flap_down(t)
+                g.report_result(addr, ok, started_at=t)
+                if addr == B:
+                    picks_b.append(t)
+            st = states(g)[B]
+            if prev != BREAKER_CLOSED and st == BREAKER_CLOSED:
+                readmissions += 1
+            prev = st
+            clk[0] += 0.5
+        ep_b = next(e for e in g._endpoints.values() if e.address == B)
+        # The streak never resets (B can't hold CLOSED through the
+        # stable window while flapping every 3.7 s), so the cooldown
+        # escalates geometrically: readmissions are counted strikes,
+        # not a steady oscillation.
+        assert ep_b.reopen_streak >= 3
+        assert g._probe_cooldown(ep_b) >= 40.0
+        assert readmissions <= 8, f"oscillating: {readmissions} readmissions"
+        # Converged: B attracts almost no traffic in the second half.
+        late_picks = [t for t in picks_b if t >= 300.0]
+        assert len(late_picks) <= 40, f"{len(late_picks)} late flapper picks"
+        assert states(g)[B] in (BREAKER_OPEN, BREAKER_HALF_OPEN)
+
+    def test_stable_recovery_forgives_streak(self):
+        g, clk = mk_group(
+            n=2, breaker_threshold=1, breaker_cooldown=5.0, outlier_k=0.0,
+        )
+        ep_b = next(e for e in g._endpoints.values() if e.address == B)
+        # Two flap cycles: fail, readmit, fail-shortly-after.
+        g.report_result(B, False, started_at=clk[0])
+        clk[0] += 6.0
+        addr, done = g.get_best_addr(timeout=1.0)
+        done()
+        g.report_result(B, True, started_at=clk[0])  # probe success
+        assert states(g)[B] == BREAKER_CLOSED
+        g.report_result(B, False, started_at=clk[0])  # immediate re-fail
+        assert ep_b.reopen_streak == 1
+        escalated = g._probe_cooldown(ep_b)
+        assert escalated == pytest.approx(2 * 5.0)
+        # Now it genuinely recovers: readmit, then hold CLOSED through
+        # the stable window (2 x cooldown) -> streak forgiven, cooldown
+        # back to base.
+        clk[0] += escalated + 1.0
+        addr, done = g.get_best_addr(timeout=1.0)
+        done()
+        g.report_result(B, True, started_at=clk[0])
+        assert states(g)[B] == BREAKER_CLOSED
+        clk[0] += 2 * 5.0 + 1.0
+        g.report_result(B, True, started_at=clk[0])
+        assert ep_b.reopen_streak == 0
+        assert g._probe_cooldown(ep_b) == pytest.approx(5.0)
+
+    def test_latency_flapper_escalates_soft_eject_cooldown(self):
+        # Gray-ladder leg: a replica whose LATENCY flaps (bad windows ->
+        # soft-eject -> probe readmit -> bad windows again) must also
+        # escalate, because soft-eject shares the half-open machinery.
+        g, clk = mk_group()  # scoring on, cooldown 10 s, window 5 s
+        ep_c = next(e for e in g._endpoints.values() if e.address == C)
+        for _ in range(3):  # 1.0 -> 0.5 -> 0.25 -> soft_ejected
+            feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (2.0, 5)})
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+        assert ep_c.reopen_streak == 0
+        clk[0] += 11.0  # past the cooldown: next pick half-opens C
+        # The pick walk evaluates C (lazy soft_ejected -> half_open
+        # transition) but weighted LeastLoad won't route to a floor-
+        # weight endpoint while healthy peers idle — the probe outcome
+        # arrives from the batch tier in practice; report it directly.
+        addr, done = g.get_best_addr(timeout=1.0)
+        done()
+        g.report_result(addr, True, started_at=clk[0])
+        assert states(g)[C] == BREAKER_HALF_OPEN
+        g.report_result(C, True, started_at=clk[0])  # probe success
+        assert states(g)[C] == BREAKER_CLOSED
+        # Still slow: the ladder re-ejects within the stable window.
+        while states(g)[C] == BREAKER_CLOSED:
+            feed_window(g, clk, {A: (0.05, 5), B: (0.05, 5), C: (2.0, 5)})
+        assert states(g)[C] == BREAKER_SOFT_EJECTED
+        assert ep_c.reopen_streak == 1
+        assert g._probe_cooldown(ep_c) == pytest.approx(2 * 10.0)
